@@ -1,0 +1,33 @@
+"""photon_tpu — a TPU-native framework for large-scale GLMs and GAME/GLMix models.
+
+A from-scratch JAX/XLA rebuild of the capabilities of Photon ML
+(reference: dchen40/photon-ml, a fork of linkedin/photon-ml):
+
+- Generalized Linear Models: logistic, linear, Poisson, smoothed-hinge,
+  with L1/L2/elastic-net regularization.
+- Batch second-order optimizers (L-BFGS, OWL-QN, TRON) expressed as
+  jit-compiled ``lax.while_loop`` state machines.
+- GAME (Generalized Additive Mixed Effect) models: a fixed effect plus
+  per-entity random effects trained by coordinate descent, with the
+  fixed effect data-parallel over a device mesh (psum over ICI) and
+  random-effect local solves vmapped + sharded across chips.
+
+Layer map (mirrors the reference's photon-lib / photon-api / photon-client
+split — see SURVEY.md §1):
+
+- :mod:`photon_tpu.core`       — math core (losses, objectives, optimizers,
+                                 normalization, stats)  ≙ photon-lib
+- :mod:`photon_tpu.models`     — GLM + GAME model classes ≙ supervised/model
+- :mod:`photon_tpu.data`       — readers (LIBSVM/Avro), index maps, sparse
+                                 batches, GAME data pipeline ≙ data/avro + data
+- :mod:`photon_tpu.parallel`   — mesh / sharding / collectives ≙ Spark runtime
+- :mod:`photon_tpu.game`       — CoordinateDescent, coordinates, estimator
+                                 ≙ photon-api algorithm/estimators
+- :mod:`photon_tpu.evaluation` — evaluators (AUC, RMSE, …) ≙ evaluation
+- :mod:`photon_tpu.drivers`    — CLI train/score drivers ≙ photon-client
+- :mod:`photon_tpu.ops`        — Pallas TPU kernels for hot ops
+"""
+
+__version__ = "0.1.0"
+
+from photon_tpu.core import losses  # noqa: F401

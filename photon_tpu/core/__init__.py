@@ -1,0 +1,5 @@
+"""Math core: pointwise losses, GLM objectives, optimizers, normalization, stats.
+
+Equivalent of the reference's ``photon-lib`` module
+(photon-lib/src/main/scala/com/linkedin/photon/ml/ — see SURVEY.md §2.1).
+"""

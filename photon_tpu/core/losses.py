@@ -1,0 +1,153 @@
+"""Pointwise GLM loss functions.
+
+Each loss is defined on the *margin* ``z = w . x + offset`` and a label, and
+exposes the value plus first/second derivatives with respect to the margin
+(``d1`` ≙ the reference's ``DzLoss``, ``d2`` ≙ ``DzzLoss``).  This mirrors the
+reference's ``PointwiseLossFunction`` hierarchy
+(photon-lib .../function/glm: LogisticLossFunction, SquaredLossFunction,
+PoissonLossFunction, SmoothedHingeLossFunction — SURVEY.md §2.1), but as pure
+vectorized JAX functions so they fuse into the objective's XLA program.
+
+Label conventions match the reference: binary losses take labels in {0, 1}
+(smoothed hinge converts to ±1 internally), Poisson takes non-negative counts,
+squared loss takes real labels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss l(z, y) with derivatives in the margin z.
+
+    Attributes:
+      name: registry key, e.g. ``"logistic"``.
+      value: ``(margin, label) -> loss`` per example.
+      d1: first derivative of loss w.r.t. margin (the reference's DzLoss).
+      d2: second derivative w.r.t. margin (DzzLoss); always >= 0 for the
+        convex losses here, which TRON's Gauss-Newton Hessian relies on.
+      mean: the inverse link function ``margin -> E[y]`` used for prediction
+        (sigmoid for logistic, identity for linear, exp for Poisson).
+    """
+
+    name: str
+    value: Callable[[Array, Array], Array]
+    d1: Callable[[Array, Array], Array]
+    d2: Callable[[Array, Array], Array]
+    mean: Callable[[Array], Array]
+
+    def value_and_d1(self, margin: Array, label: Array) -> tuple[Array, Array]:
+        return self.value(margin, label), self.d1(margin, label)
+
+
+def _logistic_value(z: Array, y: Array) -> Array:
+    # log(1 + e^z) - y*z, computed stably as max(z,0) + log1p(e^-|z|) - y*z.
+    return jnp.maximum(z, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(z))) - y * z
+
+
+def _logistic_d1(z: Array, y: Array) -> Array:
+    return jax.nn.sigmoid(z) - y
+
+
+def _logistic_d2(z: Array, y: Array) -> Array:
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+LOGISTIC = PointwiseLoss(
+    name="logistic",
+    value=_logistic_value,
+    d1=_logistic_d1,
+    d2=_logistic_d2,
+    mean=jax.nn.sigmoid,
+)
+
+
+def _squared_value(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+SQUARED = PointwiseLoss(
+    name="squared",
+    value=_squared_value,
+    d1=lambda z, y: z - y,
+    d2=lambda z, y: jnp.ones_like(z),
+    mean=lambda z: z,
+)
+
+
+def _poisson_value(z: Array, y: Array) -> Array:
+    # Negative log-likelihood up to a label-only constant: e^z - y*z.
+    return jnp.exp(z) - y * z
+
+
+POISSON = PointwiseLoss(
+    name="poisson",
+    value=_poisson_value,
+    d1=lambda z, y: jnp.exp(z) - y,
+    d2=lambda z, y: jnp.exp(z),
+    mean=jnp.exp,
+)
+
+
+def _hinge_parts(z: Array, y01: Array) -> tuple[Array, Array]:
+    # Convert {0,1} labels to ±1 and form the classification margin t = y*z.
+    y = 2.0 * y01 - 1.0
+    return y, y * z
+
+
+def _smoothed_hinge_value(z: Array, y01: Array) -> Array:
+    # Rennie's smoothed hinge: 1/2 - t for t<=0, (1-t)^2/2 for 0<t<1, 0 for t>=1.
+    _, t = _hinge_parts(z, y01)
+    return jnp.where(t <= 0.0, 0.5 - t, jnp.where(t < 1.0, 0.5 * (1.0 - t) ** 2, 0.0))
+
+
+def _smoothed_hinge_d1(z: Array, y01: Array) -> Array:
+    y, t = _hinge_parts(z, y01)
+    dt = jnp.where(t <= 0.0, -1.0, jnp.where(t < 1.0, t - 1.0, 0.0))
+    return y * dt
+
+
+def _smoothed_hinge_d2(z: Array, y01: Array) -> Array:
+    _, t = _hinge_parts(z, y01)
+    return jnp.where((t > 0.0) & (t < 1.0), 1.0, 0.0)
+
+
+SMOOTHED_HINGE = PointwiseLoss(
+    name="smoothed_hinge",
+    value=_smoothed_hinge_value,
+    d1=_smoothed_hinge_d1,
+    d2=_smoothed_hinge_d2,
+    mean=lambda z: (z > 0.0).astype(z.dtype),
+)
+
+LOSSES: dict[str, PointwiseLoss] = {
+    loss.name: loss for loss in (LOGISTIC, SQUARED, POISSON, SMOOTHED_HINGE)
+}
+
+# Task-type aliases matching the reference's TaskType enum
+# (LOGISTIC_REGRESSION / LINEAR_REGRESSION / POISSON_REGRESSION / SMOOTHED_HINGE...).
+TASK_TO_LOSS: dict[str, PointwiseLoss] = {
+    "logistic_regression": LOGISTIC,
+    "linear_regression": SQUARED,
+    "poisson_regression": POISSON,
+    "smoothed_hinge_loss_linear_svm": SMOOTHED_HINGE,
+}
+
+
+def get_loss(name: str) -> PointwiseLoss:
+    key = name.lower()
+    if key in LOSSES:
+        return LOSSES[key]
+    if key in TASK_TO_LOSS:
+        return TASK_TO_LOSS[key]
+    raise KeyError(f"unknown loss {name!r}; available: {sorted(LOSSES)}")

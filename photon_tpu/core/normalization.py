@@ -1,0 +1,111 @@
+"""Feature normalization applied inside the objective.
+
+Rebuild of the reference's ``NormalizationContext`` / ``NormalizationType``
+(photon-lib .../normalization — SURVEY.md §2.1): optimizers work in the
+normalized feature space while data and the stored model stay in the original
+space.  The identity used is
+
+    (x - shift) * factor . w  ==  x . (factor * w) - (shift * factor) . w
+
+so sparse batches never densify: normalization costs one elementwise product
+on the coefficient vector plus one scalar correction per example.
+
+Types supported (matching the reference enum):
+  NONE, SCALE_WITH_STANDARD_DEVIATION, SCALE_WITH_MAX_MAGNITUDE,
+  STANDARDIZATION (scale with std + shift by mean; requires an intercept).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+NORMALIZATION_TYPES = (
+    "none",
+    "scale_with_standard_deviation",
+    "scale_with_max_magnitude",
+    "standardization",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """factors/shifts in the original feature space; either may be None.
+
+    ``intercept_id``: index of the intercept pseudo-feature.  The intercept is
+    never scaled or shifted (factor 1, shift 0), and shift-based normalization
+    requires it (the margin correction lands there on denormalization).
+    """
+
+    factors: Optional[Array] = None  # [d] multiplicative
+    shifts: Optional[Array] = None  # [d] subtractive
+    intercept_id: Optional[int] = None
+
+    def factors_or_ones(self, dim: int) -> Array:
+        if self.factors is None:
+            return jnp.ones(dim)
+        return self.factors
+
+    def effective_coefficients(self, w: Array) -> tuple[Array, Array]:
+        """Return (factor * w, (shift * factor) . w) for the margin identity."""
+        w_eff = w if self.factors is None else w * self.factors
+        if self.shifts is None:
+            correction = jnp.zeros((), dtype=w.dtype)
+        else:
+            correction = jnp.dot(self.shifts, w_eff)
+        return w_eff, correction
+
+    def model_to_original_space(self, w: Array) -> Array:
+        """Convert coefficients learned in normalized space to the original
+        feature space: w_orig = factor * w, intercept -= (shift*factor) . w."""
+        w_eff, correction = self.effective_coefficients(w)
+        if self.shifts is not None:
+            if self.intercept_id is None:
+                raise ValueError("shift-based normalization requires an intercept")
+            w_eff = w_eff.at[self.intercept_id].add(-correction)
+        return w_eff
+
+    @classmethod
+    def build(
+        cls,
+        norm_type: str,
+        summary: "BasicStatisticalSummary",
+        intercept_id: Optional[int] = None,
+    ) -> Optional["NormalizationContext"]:
+        """Build from a feature summary, mirroring NormalizationContext.apply
+        semantics per NormalizationType."""
+        norm_type = norm_type.lower()
+        if norm_type not in NORMALIZATION_TYPES:
+            raise ValueError(f"unknown normalization type {norm_type!r}")
+        if norm_type == "none":
+            return None
+        if norm_type == "scale_with_standard_deviation":
+            factors = _safe_inverse(jnp.sqrt(summary.variance))
+            shifts = None
+        elif norm_type == "scale_with_max_magnitude":
+            mag = jnp.maximum(jnp.abs(summary.max), jnp.abs(summary.min))
+            factors = _safe_inverse(mag)
+            shifts = None
+        else:  # standardization
+            if intercept_id is None:
+                raise ValueError("standardization requires an intercept feature")
+            factors = _safe_inverse(jnp.sqrt(summary.variance))
+            shifts = summary.mean
+        if intercept_id is not None:
+            factors = factors.at[intercept_id].set(1.0)
+            if shifts is not None:
+                shifts = shifts.at[intercept_id].set(0.0)
+        return cls(factors=factors, shifts=shifts, intercept_id=intercept_id)
+
+
+def _safe_inverse(x: Array) -> Array:
+    return jnp.where(x > 0.0, 1.0 / jnp.where(x > 0.0, x, 1.0), 1.0)
+
+
+# Imported late to avoid a cycle; stats only needs jnp.
+from photon_tpu.core.stats import BasicStatisticalSummary  # noqa: E402

@@ -1,0 +1,174 @@
+"""GLM objective functions: weighted loss + regularization, with derivatives.
+
+This is the rebuild of the reference's objective-function stack —
+``ObjectiveFunction`` / ``DiffFunction`` / ``TwiceDiffFunction`` traits plus
+``DistributedGLMLossFunction`` / ``SingleNodeGLMLossFunction`` and the
+per-partition aggregators (``ValueAndGradientAggregator``,
+``HessianVectorAggregator``, ``HessianDiagonalAggregator``) — SURVEY.md
+§2.1/§2.2/§3.4.  Where the reference folds examples through Breeze/BLAS
+``dot``/``axpy`` per partition and tree-aggregates to the driver, here the
+whole evaluation is one XLA program: ``jax.value_and_grad`` over a batched
+margin computation; Hessian-vector products come from ``jax.jvp`` of the
+gradient (exact for GLM objectives).  Under a sharded mesh the same code runs
+per shard and `psum`s — see :mod:`photon_tpu.parallel`.
+
+The L2 term is added analytically (as in the reference); L1 is *not* part of
+the smooth objective — OWL-QN handles it via its orthant logic, matching the
+reference's split (SURVEY.md §2.1 "Regularization").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.core.losses import PointwiseLoss, get_loss
+from photon_tpu.core.normalization import NormalizationContext
+from photon_tpu.data.batch import Batch, DenseBatch, margins
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    """L1/L2/elastic-net configuration.
+
+    Mirrors the reference's ``RegularizationContext`` /
+    ``RegularizationType`` (NONE/L1/L2/ELASTIC_NET).  ``alpha`` is the
+    elastic-net mixing weight: ``l1 = alpha * weight``,
+    ``l2 = (1 - alpha) * weight``.
+    """
+
+    reg_type: str = "none"  # none | l1 | l2 | elastic_net
+    reg_weight: float = 0.0
+    alpha: float = 0.5
+
+    def __post_init__(self):
+        if self.reg_type not in ("none", "l1", "l2", "elastic_net"):
+            raise ValueError(f"unknown regularization type {self.reg_type!r}")
+
+    @property
+    def l1_weight(self) -> float:
+        if self.reg_type == "l1":
+            return self.reg_weight
+        if self.reg_type == "elastic_net":
+            return self.alpha * self.reg_weight
+        return 0.0
+
+    @property
+    def l2_weight(self) -> float:
+        if self.reg_type == "l2":
+            return self.reg_weight
+        if self.reg_type == "elastic_net":
+            return (1.0 - self.alpha) * self.reg_weight
+        return 0.0
+
+    def replace(self, **kw) -> "RegularizationContext":
+        return dataclasses.replace(self, **kw)
+
+
+NO_REG = RegularizationContext()
+
+
+@dataclasses.dataclass(frozen=True)
+class GlmObjective:
+    """Smooth part of a GLM objective: sum_i weight_i * loss(margin_i, y_i)
+    + (l2/2) ||w||^2, with optional feature normalization applied inside the
+    objective (the model itself stays in the original feature space, as in
+    the reference's NormalizationContext design).
+
+    All methods are pure functions of ``(w, batch)`` and jit/vmap/shard
+    cleanly.  ``l1_weight`` is carried for OWL-QN but never enters the smooth
+    value/gradient.
+    """
+
+    loss: PointwiseLoss
+    l2_weight: float = 0.0
+    l1_weight: float = 0.0
+    normalization: Optional[NormalizationContext] = None
+
+    @classmethod
+    def create(
+        cls,
+        loss: str | PointwiseLoss,
+        reg: RegularizationContext = NO_REG,
+        normalization: Optional[NormalizationContext] = None,
+    ) -> "GlmObjective":
+        if isinstance(loss, str):
+            loss = get_loss(loss)
+        return cls(
+            loss=loss,
+            l2_weight=reg.l2_weight,
+            l1_weight=reg.l1_weight,
+            normalization=normalization,
+        )
+
+    # -- margins under normalization ------------------------------------------
+    def _margins(self, w: Array, batch: Batch) -> Array:
+        if self.normalization is None:
+            return margins(w, batch)
+        # (x - shift) * factor . w  ==  x . (factor * w) - (shift * factor) . w:
+        # keeps sparse batches sparse (SURVEY.md §2.1 Normalization).
+        w_eff, correction = self.normalization.effective_coefficients(w)
+        return margins(w_eff, batch) - correction
+
+    # -- value / gradient ------------------------------------------------------
+    def data_value(self, w: Array, batch: Batch) -> Array:
+        z = self._margins(w, batch)
+        return jnp.sum(batch.weight * self.loss.value(z, batch.label))
+
+    def value(self, w: Array, batch: Batch) -> Array:
+        v = self.data_value(w, batch)
+        if self.l2_weight:
+            v = v + 0.5 * self.l2_weight * jnp.dot(w, w)
+        return v
+
+    def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
+        return jax.value_and_grad(self.value)(w, batch)
+
+    def grad(self, w: Array, batch: Batch) -> Array:
+        return jax.grad(self.value)(w, batch)
+
+    # -- second order ----------------------------------------------------------
+    def hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
+        """Exact Hessian-vector product via jvp of the gradient — the TPU
+        equivalent of the reference's HessianVectorAggregator treeAggregate
+        (SURVEY.md §3.4, 'TRON's Hv = jax.jvp')."""
+        return jax.jvp(lambda u: self.grad(u, batch), (w,), (v,))[1]
+
+    def hessian_diagonal(self, w: Array, batch: Batch) -> Array:
+        """diag(H) = sum_i weight_i * d2_i * x_ij^2 + l2 (HessianDiagonalAggregator);
+        used for per-coefficient variance (VarianceComputationType.SIMPLE)."""
+        z = self._margins(w, batch)
+        d2w = batch.weight * self.loss.d2(z, batch.label)
+        norm = self.normalization
+        factors = None if norm is None else norm.factors_or_ones(w.shape[0])
+        shifts = None if norm is None else norm.shifts
+        # diag_j = f_j^2 * sum_i d2_i (x_ij - s_j)^2
+        #        = f_j^2 * (A_j - 2 s_j B_j + s_j^2 C)   with
+        # A_j = sum d2_i x_ij^2,  B_j = sum d2_i x_ij,  C = sum d2_i —
+        # all three computable without densifying sparse batches.
+        if isinstance(batch, DenseBatch):
+            a = (batch.x * batch.x).T @ d2w
+            b = batch.x.T @ d2w if shifts is not None else None
+        else:
+            a = jnp.zeros_like(w).at[batch.ids].add(d2w[:, None] * batch.vals * batch.vals)
+            b = (
+                jnp.zeros_like(w).at[batch.ids].add(d2w[:, None] * batch.vals)
+                if shifts is not None
+                else None
+            )
+        diag = a
+        if shifts is not None:
+            c = jnp.sum(d2w)
+            diag = a - 2.0 * shifts * b + shifts * shifts * c
+        if factors is not None:
+            diag = diag * factors * factors
+        return diag + self.l2_weight
+
+    # -- prediction ------------------------------------------------------------
+    def predict_mean(self, w: Array, batch: Batch) -> Array:
+        return self.loss.mean(self._margins(w, batch))

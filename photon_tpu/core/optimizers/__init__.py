@@ -1,0 +1,33 @@
+"""Batch second-order optimizers as jit-compiled ``lax.while_loop`` machines.
+
+Rebuild of the reference's optimizer framework (photon-lib .../optimization:
+``Optimizer``, ``LBFGS``, ``OWLQN``, ``TRON``, ``OptimizerConfig``,
+``OptimizationStatesTracker`` — SURVEY.md §2.1).  Where the reference
+delegates L-BFGS/OWL-QN internals to Breeze and runs one driver↔executor
+round-trip per function evaluation, these optimizers are single fused XLA
+programs: the entire optimize() loop — line searches, two-loop recursion,
+CG inner loops — compiles once and runs on-device.  All state updates are
+masked on an ``active`` flag so the loops vmap correctly for GAME's batched
+per-entity solves (converged lanes freeze while others continue).
+"""
+
+from photon_tpu.core.optimizers.base import (  # noqa: F401
+    ConvergenceReason,
+    OptimizationStatesTracker,
+    OptimizerConfig,
+    OptimizerResult,
+)
+from photon_tpu.core.optimizers.lbfgs import lbfgs  # noqa: F401
+from photon_tpu.core.optimizers.owlqn import owlqn  # noqa: F401
+from photon_tpu.core.optimizers.tron import tron  # noqa: F401
+
+
+def get_optimizer(name: str):
+    name = name.lower()
+    if name in ("lbfgs", "l-bfgs"):
+        return lbfgs
+    if name in ("owlqn", "owl-qn"):
+        return owlqn
+    if name == "tron":
+        return tron
+    raise KeyError(f"unknown optimizer {name!r}; available: lbfgs, owlqn, tron")

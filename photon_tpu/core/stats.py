@@ -1,0 +1,112 @@
+"""One-pass feature statistics.
+
+Rebuild of the reference's ``BasicStatisticalSummary`` (photon-lib .../stat —
+SURVEY.md §2.1): per-feature mean / variance / min / max / nnz over a dataset,
+consumed by normalization.  Computed as a single jitted reduction per batch
+with an associative merge, so it streams over sharded data the same way the
+reference's Spark summarizer folds partitions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import Batch, DenseBatch
+
+Array = jax.Array
+
+
+class BasicStatisticalSummary(NamedTuple):
+    """Per-feature moments; all arrays are [d]."""
+
+    count: Array  # scalar: total examples
+    mean: Array
+    variance: Array
+    min: Array
+    max: Array
+    num_nonzeros: Array
+
+    @classmethod
+    def from_batch(cls, batch: Batch, dim: int) -> "BasicStatisticalSummary":
+        return _summarize(batch, dim)
+
+    def merge(self, other: "BasicStatisticalSummary") -> "BasicStatisticalSummary":
+        return _merge(self, other)
+
+
+@jax.jit
+def _merge(a: BasicStatisticalSummary, b: BasicStatisticalSummary) -> BasicStatisticalSummary:
+    n = a.count + b.count
+    wa = jnp.where(n > 0, a.count / jnp.maximum(n, 1), 0.0)
+    wb = jnp.where(n > 0, b.count / jnp.maximum(n, 1), 0.0)
+    mean = wa * a.mean + wb * b.mean
+    # Chan et al. parallel variance merge.
+    delta = b.mean - a.mean
+    m2 = (
+        a.variance * jnp.maximum(a.count - 1, 0)
+        + b.variance * jnp.maximum(b.count - 1, 0)
+        + delta * delta * a.count * b.count / jnp.maximum(n, 1)
+    )
+    var = m2 / jnp.maximum(n - 1, 1)
+    return BasicStatisticalSummary(
+        count=n,
+        mean=mean,
+        variance=var,
+        min=jnp.minimum(a.min, b.min),
+        max=jnp.maximum(a.max, b.max),
+        num_nonzeros=a.num_nonzeros + b.num_nonzeros,
+    )
+
+
+def _summarize(batch: Batch, dim: int) -> BasicStatisticalSummary:
+    if isinstance(batch, DenseBatch):
+        x = batch.x
+        n = jnp.asarray(x.shape[0], jnp.float32)
+        mean = jnp.mean(x, axis=0)
+        var = jnp.var(x, axis=0, ddof=1) if x.shape[0] > 1 else jnp.zeros(dim)
+        return BasicStatisticalSummary(
+            count=n,
+            mean=mean,
+            variance=var,
+            min=jnp.min(x, axis=0),
+            max=jnp.max(x, axis=0),
+            num_nonzeros=jnp.sum(x != 0.0, axis=0).astype(jnp.float32),
+        )
+    # Sparse: scatter-add moments; implicit zeros participate in mean/var/min/max.
+    ids, vals = batch.ids, batch.vals
+    n = jnp.asarray(ids.shape[0], jnp.float32)
+    # Padding entries are (0, 0.0): they add 0 to sums, but would corrupt nnz,
+    # so mask them out of counting.
+    valid = (vals != 0.0)
+    s1 = jnp.zeros(dim).at[ids].add(vals)
+    s2 = jnp.zeros(dim).at[ids].add(vals * vals)
+    nnz = jnp.zeros(dim).at[ids].add(valid.astype(jnp.float32))
+    mean = s1 / n
+    var = (s2 - n * mean * mean) / jnp.maximum(n - 1, 1)
+    var = jnp.maximum(var, 0.0)
+    # min/max over explicit values; features with nnz < n also see implicit 0.
+    big = jnp.float32(jnp.inf)
+    mn = jnp.full(dim, big).at[ids].min(jnp.where(valid, vals, big))
+    mx = jnp.full(dim, -big).at[ids].max(jnp.where(valid, vals, -big))
+    has_implicit_zero = nnz < n
+    mn = jnp.where(has_implicit_zero, jnp.minimum(mn, 0.0), mn)
+    mx = jnp.where(has_implicit_zero, jnp.maximum(mx, 0.0), mx)
+    mn = jnp.where(jnp.isinf(mn), 0.0, mn)
+    mx = jnp.where(jnp.isinf(mx), 0.0, mx)
+    return BasicStatisticalSummary(
+        count=n, mean=mean, variance=var, min=mn, max=mx, num_nonzeros=nnz
+    )
+
+
+def summarize(batches, dim: int) -> BasicStatisticalSummary:
+    """Summarize an iterable of batches with the associative merge."""
+    total = None
+    for b in batches:
+        s = _summarize(b, dim)
+        total = s if total is None else _merge(total, s)
+    if total is None:
+        raise ValueError("no batches to summarize")
+    return total

@@ -1,0 +1,10 @@
+"""Data layer: batches, readers (LIBSVM/Avro), index maps, GAME data pipeline.
+
+Equivalent of the reference's data handling spread across
+photon-lib .../data (LabeledPoint), photon-api .../data (GameDatum,
+FixedEffectDataset, RandomEffectDataset), and photon-client .../data/avro
+(AvroDataReader) — SURVEY.md §2.1–2.3 — redesigned for XLA: static-shape
+padded batches instead of RDDs of sparse Breeze vectors.
+"""
+
+from photon_tpu.data.batch import DenseBatch, SparseBatch, margins  # noqa: F401

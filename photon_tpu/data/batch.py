@@ -1,0 +1,180 @@
+"""Static-shape training batches.
+
+The reference represents a training point as ``data.LabeledPoint(label,
+features: Breeze vector, offset, weight)`` (photon-lib .../data/LabeledPoint —
+SURVEY.md §2.1) and streams RDD partitions of them through per-partition
+aggregators.  XLA wants static shapes and batched math instead, so the rebuild
+uses two batch layouts:
+
+- :class:`DenseBatch` — ``x: [n, d]`` feature matrix.  Right layout for
+  low/moderate-dimensional problems; margins are a single MXU matmul.
+- :class:`SparseBatch` — padded COO-per-row layout ``ids/vals: [n, k]`` with a
+  fixed per-row capacity ``k`` (pad with ``id=0, val=0``).  Margins are a
+  gather + row-sum; gradients come out of ``jax.grad`` as scatter-adds.  This
+  replaces Breeze ``SparseVector`` + BLAS ``dot``/``axpy`` with one fused XLA
+  program, and keeps shapes static for the compiler (SURVEY.md §7 "sparse
+  features on TPU").
+
+Both carry ``label``, ``offset`` (GAME residual-passing depends on it), and
+``weight`` exactly like ``LabeledPoint``.
+
+The padding convention ``id=0, val=0.0`` makes padded entries contribute
+``w[0] * 0.0 = 0`` to margins and zero to scatter-add gradients, so no masks
+are needed in the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+class DenseBatch(NamedTuple):
+    """A batch of examples with dense features."""
+
+    x: Array  # [n, d] float
+    label: Array  # [n] float
+    offset: Array  # [n] float
+    weight: Array  # [n] float
+
+    @property
+    def num_examples(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.x.shape[1]
+
+
+class SparseBatch(NamedTuple):
+    """A batch of examples with padded sparse features.
+
+    ``ids[i, j]`` / ``vals[i, j]`` give the j-th nonzero of example i; rows
+    with fewer than ``k`` nonzeros are padded with ``(0, 0.0)``.
+    """
+
+    ids: Array  # [n, k] int32
+    vals: Array  # [n, k] float
+    label: Array  # [n] float
+    offset: Array  # [n] float
+    weight: Array  # [n] float
+
+    @property
+    def num_examples(self) -> int:
+        return self.ids.shape[0]
+
+
+Batch = Union[DenseBatch, SparseBatch]
+
+
+def margins(w: Array, batch: Batch) -> Array:
+    """Per-example margins ``w . x_i + offset_i``.
+
+    The rebuild's equivalent of the reference aggregators' per-example
+    ``margin = dot(coefficients, features) + offset`` inner loop
+    (ValueAndGradientAggregator — SURVEY.md §3.4), batched.
+    Supports a leading batch dimension on ``w`` being absent only; use vmap
+    for batched models.
+    """
+    if isinstance(batch, DenseBatch):
+        return batch.x @ w + batch.offset
+    # Gather-based sparse dot: padded entries hit w[0] with val 0.
+    return jnp.sum(jnp.take(w, batch.ids, axis=0) * batch.vals, axis=-1) + batch.offset
+
+
+def dense_batch(
+    x: np.ndarray,
+    label: np.ndarray,
+    offset: np.ndarray | None = None,
+    weight: np.ndarray | None = None,
+    dtype=jnp.float32,
+) -> DenseBatch:
+    n = x.shape[0]
+    return DenseBatch(
+        x=jnp.asarray(x, dtype),
+        label=jnp.asarray(label, dtype),
+        offset=jnp.zeros(n, dtype) if offset is None else jnp.asarray(offset, dtype),
+        weight=jnp.ones(n, dtype) if weight is None else jnp.asarray(weight, dtype),
+    )
+
+
+def pad_row_capacity(nnz_per_row: np.ndarray, bucket_sizes: tuple[int, ...] | None = None) -> int:
+    """Pick the padded per-row capacity k: smallest power-of-two-ish bucket
+    >= max nnz, so recompiles are bounded across batches."""
+    max_nnz = int(nnz_per_row.max()) if len(nnz_per_row) else 1
+    if bucket_sizes is None:
+        k = 1
+        while k < max_nnz:
+            k *= 2
+        return k
+    for b in bucket_sizes:
+        if b >= max_nnz:
+            return b
+    raise ValueError(
+        f"max nnz per row ({max_nnz}) exceeds the largest capacity bucket "
+        f"({bucket_sizes[-1]}); truncating would silently drop features"
+    )
+
+
+def sparse_batch_from_rows(
+    rows: list[tuple[np.ndarray, np.ndarray]],
+    label: np.ndarray,
+    offset: np.ndarray | None = None,
+    weight: np.ndarray | None = None,
+    capacity: int | None = None,
+    dtype=jnp.float32,
+) -> SparseBatch:
+    """Build a SparseBatch from per-row (ids, vals) arrays, padding to a fixed
+    capacity (power-of-two bucket by default).
+
+    Raises if any row has more nonzeros than the capacity — silently dropping
+    features would corrupt margins/gradients with no diagnostic.
+    """
+    n = len(rows)
+    nnz = np.array([len(ids) for ids, _ in rows], dtype=np.int64)
+    k = capacity if capacity is not None else pad_row_capacity(nnz)
+    if len(nnz) and int(nnz.max()) > k:
+        raise ValueError(
+            f"row with {int(nnz.max())} nonzeros exceeds capacity {k}; "
+            f"raise `capacity` instead of truncating features"
+        )
+    ids = np.zeros((n, k), dtype=np.int32)
+    vals = np.zeros((n, k), dtype=np.float32)
+    for i, (r_ids, r_vals) in enumerate(rows):
+        m = len(r_ids)
+        ids[i, :m] = r_ids
+        vals[i, :m] = r_vals
+    return SparseBatch(
+        ids=jnp.asarray(ids),
+        vals=jnp.asarray(vals, dtype),
+        label=jnp.asarray(label, dtype),
+        offset=jnp.zeros(n, dtype) if offset is None else jnp.asarray(offset, dtype),
+        weight=jnp.ones(n, dtype) if weight is None else jnp.asarray(weight, dtype),
+    )
+
+
+def with_offset(batch: Batch, offset: Array) -> Batch:
+    """Return the batch with its offset column replaced (GAME residual passing)."""
+    return batch._replace(offset=offset)
+
+
+def pad_batch(batch: Batch, target_n: int) -> Batch:
+    """Pad a batch to ``target_n`` examples with zero-weight rows (so padded
+    rows contribute nothing to any weighted objective or evaluator)."""
+    n = batch.num_examples
+    if n == target_n:
+        return batch
+    if n > target_n:
+        raise ValueError(f"batch has {n} rows > target {target_n}")
+    pad = target_n - n
+
+    def _pad(a: Array) -> Array:
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    return jax.tree.map(_pad, batch)

@@ -1,0 +1,16 @@
+"""Parallelism layer: device mesh, sharded batches, distributed objectives.
+
+The rebuild of the reference's distribution runtime (Spark treeAggregate /
+broadcast / shuffle — SURVEY.md §2.6): per-shard gradients combined with
+``lax.psum`` over ICI under ``shard_map``, parameters replicated in device
+memory (no per-iteration broadcast), and entity-grouping done once host-side
+into static shardings instead of a shuffle.
+"""
+
+from photon_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    create_mesh,
+    replicated_sharding,
+    shard_batch,
+)
+from photon_tpu.parallel.distributed import DistributedGlmObjective  # noqa: F401

@@ -1,0 +1,122 @@
+"""Distributed GLM objective: per-shard evaluation + explicit ICI collectives.
+
+The TPU-native rebuild of the reference's ``DistributedGLMLossFunction``
+(photon-api .../function/glm — SURVEY.md §3.4): where the reference broadcasts
+coefficients, folds each RDD partition through a ``ValueAndGradientAggregator``
+and tree-reduces (gradient, value) pairs to the driver once per optimizer
+iteration, this evaluates the local shard's value/gradient under ``shard_map``
+and combines with ``lax.psum`` over the mesh's data axis — one fused XLA
+program per optimizer *run* (not per iteration), no host round-trips, with the
+coefficient vector resident and replicated in device memory.
+
+The optimizer is oblivious: it receives a ``fun(w) -> (value, grad)`` whose
+collectives are internal, so the same L-BFGS/OWL-QN/TRON code drives
+single-chip and pod-scale training (the reference's Optimizer/ObjectiveFunction
+split, kept).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from photon_tpu.core.objective import GlmObjective
+from photon_tpu.data.batch import Batch
+from photon_tpu.parallel.mesh import DATA_AXIS
+
+Array = jax.Array
+
+
+class DistributedGlmObjective:
+    """Binds a :class:`GlmObjective` to a mesh data axis.
+
+    Methods mirror the single-node objective so optimization problems can be
+    built against either (SURVEY.md §2.2 Distributed/SingleNode split).
+    """
+
+    def __init__(self, obj: GlmObjective, mesh: Mesh, axis_name: str = DATA_AXIS):
+        self.obj = obj
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    # -- spec helpers ---------------------------------------------------------
+    def _batch_specs(self, batch: Batch):
+        return jax.tree.map(
+            lambda leaf: P(self.axis_name, *([None] * (leaf.ndim - 1))), batch
+        )
+
+    # -- distributed evaluations ---------------------------------------------
+    def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
+        ax = self.axis_name
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(), self._batch_specs(batch)),
+            out_specs=(P(), P()),
+            check_rep=False,
+        )
+        def _vg(w, local):
+            # L2 must be added once globally, not once per shard.
+            v, g = jax.value_and_grad(self.obj.data_value)(w, local)
+            v = lax.psum(v, ax)
+            g = lax.psum(g, ax)
+            if self.obj.l2_weight:
+                v = v + 0.5 * self.obj.l2_weight * jnp.dot(w, w)
+                g = g + self.obj.l2_weight * w
+            return v, g
+
+        return _vg(w, batch)
+
+    def value(self, w: Array, batch: Batch) -> Array:
+        return self.value_and_grad(w, batch)[0]
+
+    def hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
+        ax = self.axis_name
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(), P(), self._batch_specs(batch)),
+            out_specs=P(),
+            check_rep=False,
+        )
+        def _hv(w, v, local):
+            hv = jax.jvp(
+                lambda u: jax.grad(self.obj.data_value)(u, local), (w,), (v,)
+            )[1]
+            hv = lax.psum(hv, ax)
+            return hv + self.obj.l2_weight * v
+
+        return _hv(w, v, batch)
+
+    def hessian_diagonal(self, w: Array, batch: Batch) -> Array:
+        ax = self.axis_name
+        l2 = self.obj.l2_weight
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(), self._batch_specs(batch)),
+            out_specs=P(),
+            check_rep=False,
+        )
+        def _hd(w, local):
+            # Strip the l2 added per shard by the local method; re-add once.
+            local_diag = self.obj.hessian_diagonal(w, local) - l2
+            return lax.psum(local_diag, ax) + l2
+
+        return _hd(w, batch)
+
+    # -- optimizer binding ----------------------------------------------------
+    def bind(self, batch: Batch) -> Callable[[Array], tuple[Array, Array]]:
+        return lambda w: self.value_and_grad(w, batch)
+
+    def bind_hvp(self, batch: Batch) -> Callable[[Array, Array], Array]:
+        return lambda w, v: self.hessian_vector(w, v, batch)

@@ -1,0 +1,72 @@
+"""Device mesh construction and batch sharding.
+
+Replaces the reference's Spark partitioning layer (RDD partitions spread over
+executors — SURVEY.md §2.6).  Axes used by the framework:
+
+- ``"data"``  — batch/data parallelism for the fixed effect (≙ RDD partitions
+  + treeAggregate).
+- ``"entity"`` — per-entity sharding of random-effect solves (≙
+  RandomEffectDatasetPartitioner's hash partitioning).  In practice both map
+  onto the same physical chips; a 1-D mesh reused under two names keeps the
+  code paths explicit.
+
+Multi-host: mesh creation uses all addressable JAX devices; under
+``jax.distributed`` the same code spans slices, with `pjit` emitting DCN
+collectives across slice boundaries automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_tpu.data.batch import Batch, pad_batch
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+
+def create_mesh(
+    n_devices: Optional[int] = None, axis_name: str = DATA_AXIS, devices=None
+) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (all by default)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, batch: Batch, axis_name: str = DATA_AXIS):
+    """Shardings for a batch pytree: every leaf sharded on its leading
+    (example) axis."""
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, P(axis_name, *([None] * (leaf.ndim - 1)))),
+        batch,
+    )
+
+
+def shard_batch(batch: Batch, mesh: Mesh, axis_name: str = DATA_AXIS) -> Batch:
+    """Pad the batch to a multiple of the mesh axis size (zero-weight rows)
+    and place it sharded across the axis.
+
+    The padding convention means padded rows are invisible to objectives and
+    evaluators — the analog of the reference's uneven final RDD partition.
+    """
+    n_shards = mesh.shape[axis_name]
+    n = batch.num_examples
+    target = ((n + n_shards - 1) // n_shards) * n_shards
+    padded = pad_batch(batch, target)
+    return jax.device_put(padded, batch_sharding(mesh, padded, axis_name))
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k

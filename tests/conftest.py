@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-device CPU platform.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference tests
+"distributed" code paths with local-mode Spark in one JVM; we test sharded
+code paths with 8 virtual CPU devices in one process
+(``--xla_force_host_platform_device_count=8``).  Must run before jax import.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment may pin jax_platforms to a TPU-tunnel platform ("axon")
+# whose client init needs real hardware; tests run CPU-only.  The env var is
+# overridden by site customization, so set the config directly post-import.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

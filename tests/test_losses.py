@@ -1,0 +1,72 @@
+"""Loss derivative checks vs jax.grad (the reference checks its pointwise
+losses against numeric differentiation — SURVEY.md §4 'unit tests')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.core.losses import LOSSES, get_loss
+
+
+def _labels_for(name, n, rng):
+    if name in ("logistic", "smoothed_hinge"):
+        return rng.integers(0, 2, n).astype(np.float32)
+    if name == "poisson":
+        return rng.poisson(2.0, n).astype(np.float32)
+    return rng.normal(size=n).astype(np.float32)
+
+
+@pytest.mark.parametrize("name", sorted(LOSSES))
+def test_first_derivative_matches_autodiff(name):
+    loss = get_loss(name)
+    rng = np.random.default_rng(0)
+    z = jnp.asarray(rng.normal(scale=2.0, size=64).astype(np.float32))
+    y = jnp.asarray(_labels_for(name, 64, rng))
+    d1_auto = jax.vmap(jax.grad(lambda zz, yy: loss.value(zz, yy)))(z, y)
+    np.testing.assert_allclose(loss.d1(z, y), d1_auto, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", sorted(LOSSES))
+def test_second_derivative_matches_autodiff(name):
+    loss = get_loss(name)
+    rng = np.random.default_rng(1)
+    z = jnp.asarray(rng.normal(scale=2.0, size=64).astype(np.float32))
+    # Avoid the smoothed hinge's kink points where d2 is undefined.
+    if name == "smoothed_hinge":
+        z = z + 0.123
+    y = jnp.asarray(_labels_for(name, 64, rng))
+    d2_auto = jax.vmap(jax.grad(jax.grad(lambda zz, yy: loss.value(zz, yy))))(z, y)
+    np.testing.assert_allclose(loss.d2(z, y), d2_auto, rtol=1e-5, atol=1e-5)
+
+
+def test_logistic_extreme_margins_are_finite():
+    loss = get_loss("logistic")
+    z = jnp.asarray([-100.0, -30.0, 0.0, 30.0, 100.0])
+    y = jnp.asarray([1.0, 0.0, 1.0, 0.0, 1.0])
+    assert bool(jnp.all(jnp.isfinite(loss.value(z, y))))
+    assert bool(jnp.all(jnp.isfinite(loss.d1(z, y))))
+
+
+def test_logistic_known_values():
+    loss = get_loss("logistic")
+    # At margin 0: loss = log 2 regardless of label.
+    np.testing.assert_allclose(
+        loss.value(jnp.asarray(0.0), jnp.asarray(1.0)), np.log(2.0), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        loss.d2(jnp.asarray(0.0), jnp.asarray(0.0)), 0.25, rtol=1e-6
+    )
+
+
+def test_squared_known_values():
+    loss = get_loss("squared")
+    np.testing.assert_allclose(
+        loss.value(jnp.asarray(3.0), jnp.asarray(1.0)), 2.0, rtol=1e-6
+    )
+
+
+def test_task_type_aliases():
+    assert get_loss("logistic_regression").name == "logistic"
+    assert get_loss("linear_regression").name == "squared"
+    assert get_loss("poisson_regression").name == "poisson"

@@ -1,0 +1,169 @@
+"""GLM objective: dense vs sparse equivalence, gradient/Hv checks,
+normalization round trip (SURVEY.md §4 'aggregator math ... cross-check')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.core.normalization import NormalizationContext
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.core.stats import BasicStatisticalSummary
+from photon_tpu.data.batch import dense_batch, sparse_batch_from_rows
+
+DIM = 12
+N = 50
+
+
+def _make_data(seed=0, density=0.4):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N, DIM)).astype(np.float32)
+    mask = rng.random((N, DIM)) < density
+    mask[:, 0] = True  # keep feature 0 present so padding id 0 is exercised
+    x = x * mask
+    y = (rng.random(N) < 0.5).astype(np.float32)
+    offset = rng.normal(size=N).astype(np.float32) * 0.1
+    weight = rng.uniform(0.5, 2.0, N).astype(np.float32)
+    return x, y, offset, weight
+
+
+def _sparse_rows(x):
+    rows = []
+    for i in range(x.shape[0]):
+        ids = np.nonzero(x[i])[0].astype(np.int32)
+        rows.append((ids, x[i][ids].astype(np.float32)))
+    return rows
+
+
+def test_dense_sparse_equivalence():
+    x, y, offset, weight = _make_data()
+    dense = dense_batch(x, y, offset, weight)
+    sparse = sparse_batch_from_rows(_sparse_rows(x), y, offset, weight)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.5))
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    vd, gd = obj.value_and_grad(w, dense)
+    vs, gs = obj.value_and_grad(w, sparse)
+    np.testing.assert_allclose(vd, vs, rtol=1e-5)
+    np.testing.assert_allclose(gd, gs, rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_matches_numeric():
+    x, y, offset, weight = _make_data(2)
+    batch = dense_batch(x, y, offset, weight)
+    obj = GlmObjective.create("poisson", RegularizationContext("l2", 0.1))
+    w = jnp.zeros(DIM)
+    _, g = obj.value_and_grad(w, batch)
+    eps = 1e-3
+    for j in range(0, DIM, 3):
+        e = jnp.zeros(DIM).at[j].set(eps)
+        num = (obj.value(w + e, batch) - obj.value(w - e, batch)) / (2 * eps)
+        np.testing.assert_allclose(g[j], num, rtol=1e-2, atol=1e-2)
+
+
+def test_hessian_vector_matches_full_hessian():
+    x, y, offset, weight = _make_data(3)
+    batch = dense_batch(x, y, offset, weight)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.3))
+    rng = np.random.default_rng(4)
+    w = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    h = jax.hessian(obj.value)(w, batch)
+    np.testing.assert_allclose(
+        obj.hessian_vector(w, v, batch), h @ v, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_hessian_diagonal_matches_full_hessian():
+    x, y, offset, weight = _make_data(5)
+    dense = dense_batch(x, y, offset, weight)
+    sparse = sparse_batch_from_rows(_sparse_rows(x), y, offset, weight)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.2))
+    rng = np.random.default_rng(6)
+    w = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    h = jax.hessian(obj.value)(w, dense)
+    np.testing.assert_allclose(
+        obj.hessian_diagonal(w, dense), jnp.diag(h), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        obj.hessian_diagonal(w, sparse), jnp.diag(h), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_normalization_equals_materialized_scaling():
+    x, y, offset, weight = _make_data(7)
+    batch = dense_batch(x, y, offset, weight)
+    summary = BasicStatisticalSummary.from_batch(batch, DIM)
+    norm = NormalizationContext.build("scale_with_standard_deviation", summary)
+    obj_norm = GlmObjective.create("logistic", normalization=norm)
+    # Materialize the scaled features and compare objectives.
+    factors = np.asarray(norm.factors)
+    batch_scaled = dense_batch(x * factors, y, offset, weight)
+    obj_plain = GlmObjective.create("logistic")
+    rng = np.random.default_rng(8)
+    w = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    np.testing.assert_allclose(
+        obj_norm.value(w, batch), obj_plain.value(w, batch_scaled), rtol=1e-5
+    )
+
+
+def test_standardization_model_space_round_trip():
+    x, y, offset, weight = _make_data(9)
+    # Append an intercept column.
+    xi = np.concatenate([x, np.ones((N, 1), np.float32)], axis=1)
+    dim = DIM + 1
+    batch = dense_batch(xi, y, offset, weight)
+    summary = BasicStatisticalSummary.from_batch(batch, dim)
+    norm = NormalizationContext.build("standardization", summary, intercept_id=DIM)
+    obj_norm = GlmObjective.create("logistic", normalization=norm)
+    rng = np.random.default_rng(10)
+    w = jnp.asarray(rng.normal(size=dim).astype(np.float32))
+    # Margins under normalized objective == margins of denormalized model on raw data.
+    w_orig = norm.model_to_original_space(w)
+    obj_plain = GlmObjective.create("logistic")
+    np.testing.assert_allclose(
+        obj_norm._margins(w, batch),
+        obj_plain._margins(w_orig, batch),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_hessian_diagonal_under_standardization():
+    # Regression: the diagonal must account for shift terms, not just factors.
+    x, y, offset, weight = _make_data(12)
+    xi = np.concatenate([x, np.ones((N, 1), np.float32)], axis=1)
+    dim = DIM + 1
+    dense = dense_batch(xi, y, offset, weight)
+    sparse = sparse_batch_from_rows(_sparse_rows(xi), y, offset, weight)
+    summary = BasicStatisticalSummary.from_batch(dense, dim)
+    norm = NormalizationContext.build("standardization", summary, intercept_id=DIM)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.4),
+                              normalization=norm)
+    rng = np.random.default_rng(13)
+    w = jnp.asarray(rng.normal(size=dim).astype(np.float32))
+    h = jax.hessian(obj.value)(w, dense)
+    np.testing.assert_allclose(
+        obj.hessian_diagonal(w, dense), jnp.diag(h), rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        obj.hessian_diagonal(w, sparse), jnp.diag(h), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_sparse_batch_overflow_raises():
+    rows = [(np.array([1, 2, 3], np.int32), np.ones(3, np.float32))]
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="exceeds capacity"):
+        sparse_batch_from_rows(rows, np.ones(1, np.float32), capacity=2)
+
+
+def test_sparse_stats_match_dense():
+    x, y, offset, weight = _make_data(11)
+    dense = dense_batch(x, y, offset, weight)
+    sparse = sparse_batch_from_rows(_sparse_rows(x), y, offset, weight)
+    sd = BasicStatisticalSummary.from_batch(dense, DIM)
+    ss = BasicStatisticalSummary.from_batch(sparse, DIM)
+    np.testing.assert_allclose(sd.mean, ss.mean, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(sd.variance, ss.variance, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(sd.min, ss.min, rtol=1e-5)
+    np.testing.assert_allclose(sd.max, ss.max, rtol=1e-5)
+    np.testing.assert_allclose(sd.num_nonzeros, ss.num_nonzeros)

@@ -1,0 +1,234 @@
+"""Optimizer convergence tests vs closed form / scipy / sklearn-free checks
+(the reference tests optimizers on closed-form problems — SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.core.optimizers import (
+    OptimizationStatesTracker,
+    OptimizerConfig,
+    lbfgs,
+    owlqn,
+    tron,
+)
+from photon_tpu.data.batch import dense_batch
+
+CFG = OptimizerConfig(max_iterations=200, tolerance=1e-10, gradient_tolerance=1e-7)
+
+
+def _quadratic(A, b):
+    def fun(w):
+        v = 0.5 * w @ A @ w - b @ w
+        return v, A @ w - b
+    return fun
+
+
+def test_lbfgs_quadratic_exact():
+    rng = np.random.default_rng(0)
+    m = rng.normal(size=(8, 8))
+    A = jnp.asarray((m @ m.T + 8 * np.eye(8)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=8).astype(np.float32))
+    res = lbfgs(_quadratic(A, b), jnp.zeros(8), CFG)
+    w_star = np.linalg.solve(np.asarray(A), np.asarray(b))
+    np.testing.assert_allclose(res.w, w_star, rtol=1e-3, atol=1e-4)
+    assert bool(res.converged)
+
+
+def test_tron_quadratic_exact():
+    rng = np.random.default_rng(1)
+    m = rng.normal(size=(8, 8))
+    A = jnp.asarray((m @ m.T + 8 * np.eye(8)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=8).astype(np.float32))
+    res = tron(_quadratic(A, b), jnp.zeros(8), CFG, hvp=lambda w, v: A @ v)
+    w_star = np.linalg.solve(np.asarray(A), np.asarray(b))
+    np.testing.assert_allclose(res.w, w_star, rtol=1e-3, atol=1e-4)
+
+
+def _logistic_problem(seed=0, n=200, d=10, l2=1.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(x @ w_true)))
+    y = (rng.random(n) < p).astype(np.float32)
+    batch = dense_batch(x, y)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", l2))
+    return obj, batch, x, y
+
+
+def _scipy_reference(obj, batch, d):
+    def f(w):
+        return float(obj.value(jnp.asarray(w, jnp.float32), batch))
+
+    def g(w):
+        return np.asarray(
+            obj.grad(jnp.asarray(w, jnp.float32), batch), dtype=np.float64
+        )
+
+    out = scipy.optimize.minimize(f, np.zeros(d), jac=g, method="L-BFGS-B",
+                                  options={"maxiter": 500, "ftol": 1e-12})
+    return out
+
+
+@pytest.mark.parametrize("opt_name", ["lbfgs", "tron"])
+def test_logistic_matches_scipy(opt_name):
+    obj, batch, _, _ = _logistic_problem()
+    fun = jax.jit(lambda w: obj.value_and_grad(w, batch))
+    if opt_name == "lbfgs":
+        res = lbfgs(fun, jnp.zeros(10), CFG)
+    else:
+        res = tron(fun, jnp.zeros(10), CFG,
+                   hvp=lambda w, v: obj.hessian_vector(w, v, batch))
+    ref = _scipy_reference(obj, batch, 10)
+    assert float(res.value) <= ref.fun * (1 + 1e-5) + 1e-5
+    np.testing.assert_allclose(res.w, ref.x, rtol=2e-2, atol=2e-3)
+
+
+def test_poisson_tron_converges():
+    rng = np.random.default_rng(3)
+    n, d = 300, 8
+    x = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
+    w_true = (rng.normal(size=d) * 0.5).astype(np.float32)
+    lam = np.exp(x @ w_true)
+    y = rng.poisson(lam).astype(np.float32)
+    batch = dense_batch(x, y)
+    obj = GlmObjective.create("poisson", RegularizationContext("l2", 0.5))
+    fun = jax.jit(lambda w: obj.value_and_grad(w, batch))
+    res = tron(fun, jnp.zeros(d), CFG,
+               hvp=lambda w, v: obj.hessian_vector(w, v, batch))
+    assert float(res.grad_norm) < 1e-3 * max(1.0, float(res.value))
+    # Recovered weights correlate with the truth.
+    corr = np.corrcoef(np.asarray(res.w), w_true)[0, 1]
+    assert corr > 0.9
+
+
+def test_owlqn_lasso_sparsity_and_value():
+    # Lasso linear regression: compare objective value against scipy on the
+    # smooth-reformulated problem (split w = p - n, p,n >= 0).
+    rng = np.random.default_rng(4)
+    n, d = 120, 15
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.zeros(d, np.float32)
+    w_true[:3] = [2.0, -3.0, 1.5]
+    y = (x @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
+    batch = dense_batch(x, y)
+    l1 = 25.0
+    obj = GlmObjective.create("squared")
+    fun = jax.jit(lambda w: obj.value_and_grad(w, batch))
+    res = owlqn(fun, jnp.zeros(d), CFG, l1_weight=l1)
+
+    # scipy reference via positive/negative split (bounded L-BFGS-B).
+    def f_split(z):
+        w = z[:d] - z[d:]
+        wj = jnp.asarray(w, jnp.float32)
+        return float(obj.value(wj, batch)) + l1 * float(np.sum(z))
+
+    def g_split(z):
+        w = jnp.asarray(z[:d] - z[d:], jnp.float32)
+        g = np.asarray(obj.grad(w, batch), np.float64)
+        return np.concatenate([g + l1, -g + l1])
+
+    ref = scipy.optimize.minimize(
+        f_split, np.zeros(2 * d), jac=g_split, method="L-BFGS-B",
+        bounds=[(0, None)] * (2 * d), options={"maxiter": 1000, "ftol": 1e-14},
+    )
+    assert float(res.value) <= ref.fun * (1 + 1e-4) + 1e-4
+    # True zeros should be recovered as exact zeros (orthant projection).
+    w = np.asarray(res.w)
+    assert np.sum(np.abs(w[3:]) == 0.0) >= d - 3 - 2
+
+
+def test_owlqn_elastic_net_linear():
+    # Elastic net = L2 in objective + L1 in OWL-QN (bench config 2 shape).
+    rng = np.random.default_rng(5)
+    n, d = 100, 10
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.zeros(d, np.float32)
+    w_true[:2] = [1.0, -2.0]
+    y = (x @ w_true).astype(np.float32)
+    batch = dense_batch(x, y)
+    reg = RegularizationContext("elastic_net", 10.0, alpha=0.5)
+    obj = GlmObjective.create("squared", reg)
+    fun = jax.jit(lambda w: obj.value_and_grad(w, batch))
+    res = owlqn(fun, jnp.zeros(d), CFG, l1_weight=reg.l1_weight)
+    assert bool(res.converged)
+    w = np.asarray(res.w)
+    assert abs(w[0]) > 0.5 and w[1] < -1.0
+    assert np.all(np.abs(w[2:]) < 0.05)
+
+
+def test_owlqn_zero_l1_matches_lbfgs():
+    obj, batch, _, _ = _logistic_problem(6)
+    fun = jax.jit(lambda w: obj.value_and_grad(w, batch))
+    r1 = lbfgs(fun, jnp.zeros(10), CFG)
+    r2 = owlqn(fun, jnp.zeros(10), CFG, l1_weight=0.0)
+    np.testing.assert_allclose(r1.value, r2.value, rtol=1e-5)
+
+
+def test_states_tracker():
+    obj, batch, _, _ = _logistic_problem(7)
+    fun = jax.jit(lambda w: obj.value_and_grad(w, batch))
+    res = lbfgs(fun, jnp.zeros(10), OptimizerConfig(max_iterations=50))
+    tracker = OptimizationStatesTracker(res)
+    assert tracker.iterations >= 1
+    assert len(tracker.values) == tracker.iterations + 1
+    # Monotone decrease for a convex problem with Armijo line search.
+    assert np.all(np.diff(tracker.values) <= 1e-6)
+    assert tracker.convergence_reason in (
+        "FUNCTION_VALUES_TOLERANCE", "GRADIENT_TOLERANCE", "MAX_ITERATIONS",
+        "OBJECTIVE_NOT_IMPROVING",
+    )
+
+
+def test_vmapped_lbfgs_matches_sequential():
+    # The property GAME's random effects depend on: vmapping the optimizer
+    # over a batch of problems gives the same result as solving sequentially.
+    rng = np.random.default_rng(8)
+    B, n, d = 5, 40, 6
+    xs = rng.normal(size=(B, n, d)).astype(np.float32)
+    ys = (rng.random((B, n)) < 0.5).astype(np.float32)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+
+    def solve(x, y):
+        batch = dense_batch(x, y)
+        return lbfgs(lambda w: obj.value_and_grad(w, batch), jnp.zeros(d),
+                     OptimizerConfig(max_iterations=100)).w
+
+    seq = np.stack([np.asarray(solve(xs[i], ys[i])) for i in range(B)])
+
+    def solve_traced(x, y):
+        from photon_tpu.data.batch import DenseBatch
+        batch = DenseBatch(
+            x=x, label=y, offset=jnp.zeros(n), weight=jnp.ones(n)
+        )
+        return lbfgs(lambda w: obj.value_and_grad(w, batch), jnp.zeros(d),
+                     OptimizerConfig(max_iterations=100)).w
+
+    batched = jax.jit(jax.vmap(solve_traced))(jnp.asarray(xs), jnp.asarray(ys))
+    np.testing.assert_allclose(batched, seq, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("opt_name", ["tron"])
+def test_vmapped_tron_matches_sequential(opt_name):
+    rng = np.random.default_rng(9)
+    B, n, d = 4, 30, 5
+    xs = rng.normal(size=(B, n, d)).astype(np.float32)
+    ys = (rng.random((B, n)) < 0.5).astype(np.float32)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.5))
+
+    def solve(x, y):
+        from photon_tpu.data.batch import DenseBatch
+        batch = DenseBatch(x=x, label=y, offset=jnp.zeros(n), weight=jnp.ones(n))
+        return tron(
+            lambda w: obj.value_and_grad(w, batch), jnp.zeros(d),
+            OptimizerConfig(max_iterations=50),
+            hvp=lambda w, v: obj.hessian_vector(w, v, batch),
+        ).w
+
+    seq = np.stack([np.asarray(jax.jit(solve)(jnp.asarray(xs[i]), jnp.asarray(ys[i])))
+                    for i in range(B)])
+    batched = jax.jit(jax.vmap(solve))(jnp.asarray(xs), jnp.asarray(ys))
+    np.testing.assert_allclose(batched, seq, rtol=1e-3, atol=1e-4)
