@@ -1,0 +1,106 @@
+"""Optimization problems: optimizer + objective + variance, bound together.
+
+Rebuild of the reference's ``DistributedOptimizationProblem`` /
+``SingleNodeOptimizationProblem`` (photon-api .../optimization — SURVEY.md
+§2.2): a problem owns an objective (local or distributed), an optimizer
+choice, regularization, and optional per-coefficient variance computation
+(``VarianceComputationType`` NONE/SIMPLE — diagonal-Hessian inverse, the
+GLMix posterior approximation).
+
+One class serves both roles: the objective it is built with decides whether
+gradients psum over a mesh (DistributedGlmObjective) or stay local
+(GlmObjective) — the optimizer code cannot tell the difference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig, get_optimizer, lbfgs, owlqn, tron
+from photon_tpu.data.batch import Batch
+from photon_tpu.models.glm import Coefficients
+
+Array = jax.Array
+
+VARIANCE_TYPES = ("none", "simple")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProblemConfig:
+    """Per-coordinate training configuration (optimizer + regularization +
+    tolerances), the analog of the reference's optimization configs."""
+
+    optimizer: str = "lbfgs"
+    regularization: RegularizationContext = RegularizationContext()
+    optimizer_config: OptimizerConfig = OptimizerConfig()
+    variance_computation: str = "none"
+
+    def __post_init__(self):
+        get_optimizer(self.optimizer)  # validate early
+        if self.variance_computation not in VARIANCE_TYPES:
+            raise ValueError(
+                f"unknown variance computation {self.variance_computation!r}"
+            )
+        if self.regularization.l1_weight > 0 and self.optimizer.lower() not in (
+            "owlqn",
+            "owl-qn",
+        ):
+            raise ValueError(
+                "L1/elastic-net regularization requires the OWL-QN optimizer "
+                "(the reference enforces the same pairing)"
+            )
+
+    def replace(self, **kw) -> "ProblemConfig":
+        return dataclasses.replace(self, **kw)
+
+
+class GlmOptimizationProblem:
+    """Runs one GLM fit: ``run(batch, w0) -> (Coefficients, OptimizerResult)``.
+
+    ``objective`` may be a plain :class:`GlmObjective` (single-node path) or a
+    :class:`~photon_tpu.parallel.distributed.DistributedGlmObjective`
+    (mesh path); both expose the same evaluation methods.
+    """
+
+    def __init__(self, objective, config: ProblemConfig):
+        self.objective = objective
+        self.config = config
+
+    def _l1_weight(self) -> float:
+        return self.config.regularization.l1_weight
+
+    def run(
+        self, batch: Batch, w0: Optional[Array] = None, dim: Optional[int] = None
+    ):
+        if w0 is None:
+            if dim is None:
+                raise ValueError("need w0 or dim")
+            w0 = jnp.zeros(dim, jnp.float32)
+        fun = lambda w: self.objective.value_and_grad(w, batch)  # noqa: E731
+        name = self.config.optimizer.lower()
+        cfg = self.config.optimizer_config
+        if name in ("owlqn", "owl-qn"):
+            result = owlqn(fun, w0, cfg, l1_weight=self._l1_weight())
+        elif name == "tron":
+            result = tron(
+                fun, w0, cfg, hvp=lambda w, v: self.objective.hessian_vector(w, v, batch)
+            )
+        else:
+            result = lbfgs(fun, w0, cfg)
+        coefficients = Coefficients(
+            means=result.w, variances=self.compute_variances(result.w, batch)
+        )
+        return coefficients, result
+
+    def compute_variances(self, w: Array, batch: Batch) -> Optional[Array]:
+        """SIMPLE variance: 1 / diag(H) at the optimum (SURVEY.md §2.2
+        'L2 + variance')."""
+        if self.config.variance_computation == "none":
+            return None
+        diag = self.objective.hessian_diagonal(w, batch)
+        return 1.0 / jnp.maximum(diag, 1e-12)

@@ -1,0 +1,105 @@
+"""LIBSVM text format reader.
+
+Bench config (1) — "fixed-effect logistic GLM on a1a LIBSVM" — requires a
+LIBSVM reader (SURVEY.md §6).  The reference reads Avro; LIBSVM support is a
+rebuild addition driven by the benchmark configs.
+
+Format per line: ``<label> <id>:<val> <id>:<val> ...`` with 1-based feature
+ids (a1a convention).  Lines may carry a trailing ``# comment``.  Output is a
+:class:`SparseBatch` with 0-based ids and optionally an appended intercept
+feature at index ``dim`` (the reference adds the intercept as a feature via
+its index map, so models stay a single coefficient vector).
+
+A native C++ fast-path parser lives in :mod:`photon_tpu.native`; this module
+falls back to pure Python when the shared library isn't built.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_tpu.data.batch import SparseBatch, sparse_batch_from_rows
+
+
+@dataclasses.dataclass
+class LibsvmData:
+    """Parsed LIBSVM file: ragged rows + labels, before padding/batching."""
+
+    rows: list  # list[(np.ndarray ids, np.ndarray vals)]
+    labels: np.ndarray
+    dim: int  # number of features (0-based ids < dim), excluding intercept
+
+    @property
+    def num_examples(self) -> int:
+        return len(self.rows)
+
+
+def parse_libsvm(path: str, zero_based: bool = False) -> LibsvmData:
+    """Parse a LIBSVM file (uses the native parser when available)."""
+    try:
+        from photon_tpu.native import libsvm_native
+
+        parsed = libsvm_native.parse_file(path, zero_based)
+        if parsed is not None:
+            return LibsvmData(*parsed)
+    except ImportError:
+        pass
+    return _parse_libsvm_py(path, zero_based)
+
+
+def _parse_libsvm_py(path: str, zero_based: bool) -> LibsvmData:
+    rows = []
+    labels = []
+    max_id = -1
+    off = 0 if zero_based else 1
+    with open(path, "rb") as f:
+        for raw in f:
+            line = raw.split(b"#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            ids = np.empty(len(parts) - 1, np.int32)
+            vals = np.empty(len(parts) - 1, np.float32)
+            for j, tok in enumerate(parts[1:]):
+                k, v = tok.split(b":")
+                ids[j] = int(k) - off
+                vals[j] = float(v)
+            if len(ids):
+                max_id = max(max_id, int(ids.max()))
+            rows.append((ids, vals))
+    return LibsvmData(rows=rows, labels=np.asarray(labels, np.float32), dim=max_id + 1)
+
+
+def normalize_binary_labels(labels: np.ndarray) -> np.ndarray:
+    """Map {-1,+1} (LIBSVM convention) or {0,1} labels to {0,1}."""
+    out = labels.copy()
+    out[out < 0] = 0.0
+    return out
+
+
+def to_sparse_batch(
+    data: LibsvmData,
+    dim: int | None = None,
+    intercept: bool = True,
+    capacity: int | None = None,
+    binary_labels: bool = True,
+) -> tuple[SparseBatch, int]:
+    """Pad rows into a SparseBatch; returns (batch, total_dim).
+
+    With ``intercept=True`` a constant-1 feature is appended at index
+    ``dim`` (so ``total_dim = dim + 1``), matching the reference's
+    intercept-as-feature design.
+    """
+    d = dim if dim is not None else data.dim
+    rows = data.rows
+    if intercept:
+        rows = [
+            (np.append(ids, np.int32(d)), np.append(vals, np.float32(1.0)))
+            for ids, vals in rows
+        ]
+    labels = normalize_binary_labels(data.labels) if binary_labels else data.labels
+    batch = sparse_batch_from_rows(rows, labels, capacity=capacity)
+    return batch, d + (1 if intercept else 0)

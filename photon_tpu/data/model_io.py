@@ -1,0 +1,143 @@
+"""Model persistence: name/term-keyed coefficient export, Avro-compatible.
+
+Rebuild of the reference's ``ModelProcessingUtils.saveGameModelToHDFS`` /
+model loading (photon-client .../data/avro — SURVEY.md §5 'Checkpoint'):
+coefficients are keyed by their (name, term) feature strings, so models are
+portable across feature-index rebuilds; loading joins the stored keys against
+the current index map.
+
+Formats:
+- ``avro`` (default): Object Container File with a Bayesian-linear-model
+  record (modelClass, means[], variances[] as name/term/value records),
+  mirroring the reference's published schema shape.
+- ``json``: same content as plain JSON (debuggable, diff-able).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data import avro_codec
+from photon_tpu.data.index_map import DELIMITER, INTERCEPT_KEY, IndexMap
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel, model_for_task
+
+NAME_TERM_VALUE_SCHEMA = {
+    "type": "record",
+    "name": "NameTermValueAvro",
+    "namespace": "photon_tpu.generated",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+GLM_MODEL_SCHEMA = {
+    "type": "record",
+    "name": "BayesianLinearModelAvro",
+    "namespace": "photon_tpu.generated",
+    "fields": [
+        {"name": "modelClass", "type": "string"},
+        {"name": "means", "type": {"type": "array", "items": NAME_TERM_VALUE_SCHEMA}},
+        {
+            "name": "variances",
+            "type": ["null", {"type": "array", "items": "NameTermValueAvro"}],
+            "default": None,
+        },
+        {"name": "lossFunction", "type": ["null", "string"], "default": None},
+    ],
+}
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    if DELIMITER in key:
+        name, term = key.split(DELIMITER, 1)
+        return name, term
+    return key, ""
+
+
+def _ntv_list(values: np.ndarray, index_map: IndexMap, sparse_threshold: float = 0.0):
+    out = []
+    for i, v in enumerate(values):
+        if abs(float(v)) <= sparse_threshold and index_map.get_key(i) != INTERCEPT_KEY:
+            continue
+        name, term = _split_key(index_map.get_key(i))
+        out.append({"name": name, "term": term, "value": float(v)})
+    return out
+
+
+def save_glm_model(
+    path: str,
+    model: GeneralizedLinearModel,
+    index_map: IndexMap,
+    fmt: str = "avro",
+) -> None:
+    """Write a single GLM as one name/term-keyed record.
+
+    Zero coefficients are dropped (sparse storage, as the reference does for
+    OWL-QN models); the intercept is always kept.
+    """
+    means = np.asarray(model.coefficients.means)
+    record = {
+        "modelClass": model.task_type,
+        "means": _ntv_list(means, index_map),
+        "variances": (
+            None
+            if model.coefficients.variances is None
+            else _ntv_list(np.asarray(model.coefficients.variances), index_map)
+        ),
+        "lossFunction": model.loss.name,
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if fmt == "avro":
+        avro_codec.write_container(path, GLM_MODEL_SCHEMA, [record])
+    elif fmt == "json":
+        with open(path, "w") as f:
+            json.dump(record, f, indent=1)
+    else:
+        raise ValueError(f"unknown model format {fmt!r}")
+
+
+def load_glm_model(
+    path: str,
+    index_map: IndexMap,
+    fmt: Optional[str] = None,
+) -> GeneralizedLinearModel:
+    """Load a GLM, joining stored (name, term) keys onto ``index_map``.
+
+    Keys absent from the map are dropped (feature-index rebuild semantics,
+    as in the reference's model loader).
+    """
+    if fmt is None:
+        with open(path, "rb") as f:
+            fmt = "avro" if f.read(4) == avro_codec.MAGIC else "json"
+    if fmt == "avro":
+        _, records = avro_codec.read_container(path)
+        record = records[0]
+    else:
+        with open(path) as f:
+            record = json.load(f)
+
+    def to_vector(ntvs) -> np.ndarray:
+        vec = np.zeros(len(index_map), np.float32)
+        for ntv in ntvs:
+            key = (
+                f"{ntv['name']}{DELIMITER}{ntv['term']}" if ntv["term"] else ntv["name"]
+            )
+            idx = index_map.get_id(key)
+            if idx >= 0:
+                vec[idx] = ntv["value"]
+        return vec
+
+    means = jnp.asarray(to_vector(record["means"]))
+    variances = (
+        None
+        if record.get("variances") is None
+        else jnp.asarray(to_vector(record["variances"]))
+    )
+    return model_for_task(record["modelClass"], Coefficients(means, variances))

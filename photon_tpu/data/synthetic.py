@@ -1,0 +1,112 @@
+"""Deterministic synthetic data generators for tests and benchmarks.
+
+Rebuild of the reference's test-data generators (photon-test-utils
+CommonTestUtils/GameTestUtils — SURVEY.md §4): seeded generators for GLM
+training sets and GAME (fixed + per-entity random effect) datasets, so tests
+and benchmarks are reproducible without fixture files.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_tpu.data.batch import DenseBatch, dense_batch
+
+
+def make_glm_data(
+    n: int,
+    dim: int,
+    task: str = "logistic_regression",
+    seed: int = 0,
+    noise: float = 0.1,
+    intercept: bool = True,
+    density: float = 1.0,
+    weight_seed: int | None = None,
+) -> tuple[DenseBatch, np.ndarray]:
+    """Synthetic GLM data with known true weights; returns (batch, w_true).
+
+    With ``intercept=True`` the final feature column is constant 1.
+    ``weight_seed`` fixes the true weights independently of ``seed`` so
+    train/validation splits can share a model while drawing different rows.
+    """
+    rng = np.random.default_rng(seed)
+    d_raw = dim - 1 if intercept else dim
+    x = rng.normal(size=(n, d_raw)).astype(np.float32)
+    if density < 1.0:
+        x *= rng.random((n, d_raw)) < density
+    if intercept:
+        x = np.concatenate([x, np.ones((n, 1), np.float32)], axis=1)
+    w_rng = rng if weight_seed is None else np.random.default_rng(weight_seed)
+    w_true = (w_rng.normal(size=dim) * 0.5).astype(np.float32)
+    z = x @ w_true
+    if task == "logistic_regression":
+        p = 1.0 / (1.0 + np.exp(-z))
+        y = (rng.random(n) < p).astype(np.float32)
+    elif task == "linear_regression":
+        y = (z + noise * rng.normal(size=n)).astype(np.float32)
+    elif task == "poisson_regression":
+        y = rng.poisson(np.exp(np.clip(z, -8, 8))).astype(np.float32)
+    elif task == "smoothed_hinge_loss_linear_svm":
+        y = (z + noise * rng.normal(size=n) > 0).astype(np.float32)
+    else:
+        raise KeyError(f"unknown task {task!r}")
+    return dense_batch(x, y), w_true
+
+
+def make_game_data(
+    n_entities: int,
+    rows_per_entity_mean: int,
+    fixed_dim: int,
+    random_dim: int,
+    seed: int = 0,
+    n_random_coords: int = 1,
+):
+    """Synthetic GAME data: global fixed effect + per-entity random effects.
+
+    Returns a dict with dense feature blocks, labels, and per-coordinate
+    entity ids — the host-side precursor the GAME data pipeline buckets.
+    Row counts per entity are skewed (geometric-ish) to exercise the
+    ragged-bucketing path (SURVEY.md §7 'hard parts').
+    """
+    rng = np.random.default_rng(seed)
+    counts = np.maximum(1, rng.geometric(1.0 / rows_per_entity_mean, n_entities))
+    n = int(counts.sum())
+    x_fixed = rng.normal(size=(n, fixed_dim)).astype(np.float32)
+    x_fixed[:, -1] = 1.0  # intercept
+    w_fixed = (rng.normal(size=fixed_dim) * 0.5).astype(np.float32)
+    z = x_fixed @ w_fixed
+
+    entity_ids = {}
+    x_random = {}
+    for c in range(n_random_coords):
+        ids = np.repeat(np.arange(n_entities), counts)
+        perm = rng.permutation(n) if c > 0 else np.arange(n)
+        ids = ids[perm]
+        entity_ids[f"re{c}"] = ids.astype(np.int64)
+        xr = rng.normal(size=(n, random_dim)).astype(np.float32)
+        xr[:, -1] = 1.0
+        x_random[f"re{c}"] = xr
+        w_re = (rng.normal(size=(n_entities, random_dim)) * 0.5).astype(np.float32)
+        z = z + np.sum(xr * w_re[ids], axis=1)
+
+    p = 1.0 / (1.0 + np.exp(-z))
+    y = (rng.random(n) < p).astype(np.float32)
+    return {
+        "x_fixed": x_fixed,
+        "x_random": x_random,
+        "entity_ids": entity_ids,
+        "label": y,
+        "weight": np.ones(n, np.float32),
+        "n_entities": n_entities,
+    }
+
+
+def write_libsvm(path: str, batch_x: np.ndarray, labels: np.ndarray) -> None:
+    """Write a dense matrix as LIBSVM text (1-based ids, skipping zeros)."""
+    with open(path, "w") as f:
+        for i in range(batch_x.shape[0]):
+            row = batch_x[i]
+            toks = [f"{int(labels[i]) if labels[i] in (0, 1, -1) else labels[i]}"]
+            for j in np.nonzero(row)[0]:
+                toks.append(f"{j + 1}:{row[j]:.6g}")
+            f.write(" ".join(toks) + "\n")

@@ -1,0 +1,6 @@
+"""CLI drivers: train/score entry points.
+
+Equivalent of the reference's ``photon-client`` drivers (legacy ``Driver``,
+``GameTrainingDriver``, ``GameScoringDriver`` — SURVEY.md §2.3), with
+``--backend=tpu|cpu`` replacing spark-submit.
+"""

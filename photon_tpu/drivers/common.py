@@ -1,0 +1,134 @@
+"""Shared driver plumbing: backend selection, data loading, param parsing.
+
+The reference's drivers are Spark applications configured through Spark-ML
+``Param``s (SURVEY.md §5 'Config / flag system'); these drivers are plain
+argparse CLIs with the same vocabulary (task type, optimizer, tolerance,
+max-iter, regularization type + weight list, normalization, evaluators,
+IO paths) plus ``--backend=tpu|cpu``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def select_backend(backend: str) -> None:
+    """Pin the JAX platform before any device use.
+
+    ``cpu`` forces the host platform (needed in sandboxes where the TPU
+    plugin's device init requires real hardware); ``tpu`` (default) lets the
+    environment's TPU platform load.
+    """
+    import jax
+
+    if backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    # "tpu": leave the environment's platform selection alone.
+
+
+def add_common_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--backend", choices=("tpu", "cpu"), default="tpu",
+                        help="compute platform (tpu uses the environment's "
+                        "TPU runtime; cpu forces host execution)")
+    parser.add_argument("--output-dir", required=True)
+    parser.add_argument("--log-file", default=None)
+    parser.add_argument("--profile-dir", default=None,
+                        help="write a jax.profiler trace of the train phase")
+
+
+def add_data_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--input", required=True,
+                        help="training data: a LIBSVM file path, or "
+                        "synthetic:<task>:<n>:<dim>[:seed[:weight_seed]] for "
+                        "generated data (weight_seed pins the true model so "
+                        "train/validation can share it across seeds)")
+    parser.add_argument("--validation-input", default=None,
+                        help="validation data (same formats)")
+    parser.add_argument("--intercept", action=argparse.BooleanOptionalAction,
+                        default=True)
+
+
+BINARY_TASKS = ("logistic_regression", "smoothed_hinge_loss_linear_svm")
+
+
+def load_dataset(spec: str, intercept: bool, task: str = "logistic_regression"):
+    """Load (batch, dim, index_map) from an --input spec.
+
+    LIBSVM {-1,+1} labels are normalized to {0,1} only for binary tasks;
+    regression labels pass through untouched.
+    """
+    from photon_tpu.data.index_map import IndexMap, feature_key
+    from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
+
+    binary = task in BINARY_TASKS
+    if spec.startswith("synthetic:"):
+        from photon_tpu.data.synthetic import make_glm_data
+
+        parts = spec.split(":")
+        task, n, dim = parts[1], int(parts[2]), int(parts[3])
+        seed = int(parts[4]) if len(parts) > 4 else 0
+        weight_seed = int(parts[5]) if len(parts) > 5 else None
+        batch, _ = make_glm_data(
+            n, dim, task=task, seed=seed, intercept=intercept,
+            weight_seed=weight_seed,
+        )
+        keys = [feature_key(f"f{i}") for i in range(dim - (1 if intercept else 0))]
+        return batch, dim, IndexMap.build(keys, intercept=intercept)
+
+    if not os.path.exists(spec):
+        raise FileNotFoundError(f"--input {spec} does not exist")
+    data = parse_libsvm(spec)
+    batch, dim = to_sparse_batch(data, intercept=intercept, binary_labels=binary)
+    keys = [feature_key(f"f{i}") for i in range(data.dim)]
+    return batch, dim, IndexMap.build(keys, intercept=intercept)
+
+
+def load_validation(
+    spec: Optional[str], train_dim: int, intercept: bool,
+    task: str = "logistic_regression",
+):
+    """Load validation/scoring data padded to the training dimension
+    (files whose max feature id is below the training dim are valid)."""
+    if spec is None:
+        return None
+    if spec.startswith("synthetic:"):
+        batch, dim, _ = load_dataset(spec, intercept, task)
+        if dim != train_dim:
+            raise ValueError(f"validation dim {dim} != train dim {train_dim}")
+        return batch
+    from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
+
+    data = parse_libsvm(spec)
+    feature_dim = train_dim - (1 if intercept else 0)
+    if data.dim > feature_dim:
+        raise ValueError(
+            f"validation data has feature id {data.dim - 1} >= train dim {feature_dim}"
+        )
+    batch, _ = to_sparse_batch(
+        data, dim=feature_dim, intercept=intercept,
+        binary_labels=task in BINARY_TASKS,
+    )
+    return batch
+
+
+def maybe_mesh(min_devices: int = 2):
+    """A 1-D data mesh over all devices when more than one is present."""
+    import jax
+
+    if len(jax.devices()) >= min_devices:
+        from photon_tpu.parallel import create_mesh
+
+        return create_mesh()
+    return None
+
+
+def parse_weights_list(s: str) -> list[float]:
+    return [float(tok) for tok in s.split(",") if tok.strip()]
+
+
+def scores_on(batch, model) -> np.ndarray:
+    return np.asarray(model.compute_score(batch))

@@ -1,0 +1,88 @@
+"""GLM scoring driver: load a saved model, score a dataset, write scores.
+
+Scoring half of the reference's legacy driver / ``GameScoringDriver``'s GLM
+path (SURVEY.md §3.3): read model (name/term-keyed) → join onto the current
+index map → score → optional metrics → write scores + metrics JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from photon_tpu.drivers import common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("photon_tpu.drivers.score", description=__doc__)
+    common.add_common_args(p)
+    common.add_data_args(p)
+    p.add_argument("--model", required=True, help="saved model file (avro/json)")
+    p.add_argument("--index-map", default=None,
+                   help="feature index map JSON written at training time; "
+                   "defaults to feature_index.json next to the model")
+    p.add_argument("--evaluators", default=None)
+    p.add_argument("--predict-mean", action="store_true",
+                   help="write mean predictions (sigmoid/exp link) instead of "
+                   "raw scores")
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    common.select_backend(args.backend)
+    from photon_tpu.data.index_map import IndexMap
+    from photon_tpu.data.model_io import load_glm_model
+    from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.score", args.log_file)
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    imap_path = args.index_map or os.path.join(
+        os.path.dirname(args.model), "feature_index.json"
+    )
+    index_map = IndexMap.load(imap_path)
+    model = load_glm_model(args.model, index_map)
+    logger.info("model: %s dim=%d", model.task_type, model.coefficients.dim)
+
+    with logger.timed("load-data"):
+        # Pad to the model's dimension: scoring files whose max feature id is
+        # below the training dim are valid (load_validation handles this).
+        batch = common.load_validation(
+            args.input, model.coefficients.dim, args.intercept,
+            task=model.task_type,
+        )
+
+    with logger.timed("score"):
+        raw_scores = np.asarray(model.compute_score(batch))
+        scores = (
+            np.asarray(model.loss.mean(raw_scores)) if args.predict_mean
+            else raw_scores
+        )
+    np.savetxt(os.path.join(args.output_dir, "scores.txt"), scores, fmt="%.8g")
+
+    metrics = {}
+    if args.evaluators:
+        evaluators = MultiEvaluator(
+            [get_evaluator(n) for n in args.evaluators.split(",")]
+        )
+        metrics = evaluators.evaluate(
+            raw_scores,
+            np.asarray(batch.label),
+            np.asarray(batch.weight),
+        )
+        logger.info("metrics %s", metrics)
+        with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
+            json.dump(metrics, f, indent=1)
+    return {"num_scored": int(scores.shape[0]), "metrics": metrics}
+
+
+def main(argv=None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,231 @@
+"""Single-model GLM training driver (the reference's legacy ``Driver``).
+
+End-to-end: read data → optional normalization → regularization-weight sweep
+→ validate each model → select best → write models + metrics
+(SURVEY.md §3.2).  Runs the fixed-effect distributed path when more than one
+device is visible (mesh + psum), single-device otherwise — same optimizer
+code either way.
+
+Usage:
+    python -m photon_tpu.drivers.train \\
+        --input a1a.libsvm --task logistic_regression \\
+        --optimizer lbfgs --reg-type l2 --reg-weights 0.1,1,10 \\
+        --validation-input a1a.t --evaluators AUC,LOGISTIC_LOSS \\
+        --output-dir /tmp/model --backend tpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.drivers import common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon_tpu.drivers.train", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    common.add_common_args(p)
+    common.add_data_args(p)
+    p.add_argument("--task", default="logistic_regression",
+                   choices=("logistic_regression", "linear_regression",
+                            "poisson_regression", "smoothed_hinge_loss_linear_svm"))
+    p.add_argument("--optimizer", default="lbfgs", choices=("lbfgs", "owlqn", "tron"))
+    p.add_argument("--reg-type", default="l2",
+                   choices=("none", "l1", "l2", "elastic_net"))
+    p.add_argument("--reg-weights", default="1.0",
+                   help="comma-separated sweep of regularization weights")
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument("--max-iterations", type=int, default=100)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--normalization", default="none",
+                   choices=("none", "scale_with_standard_deviation",
+                            "scale_with_max_magnitude", "standardization"))
+    p.add_argument("--evaluators", default=None,
+                   help="comma-separated evaluator names; default per task")
+    p.add_argument("--variance-computation", default="none",
+                   choices=("none", "simple"))
+    p.add_argument("--model-format", default="avro", choices=("avro", "json"))
+    p.add_argument("--save-all-models", action="store_true",
+                   help="write every sweep model, not just the best")
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    common.select_backend(args.backend)
+    # Imports after backend pinning (device init happens on first jax use).
+    import jax
+
+    from photon_tpu.core.normalization import NormalizationContext
+    from photon_tpu.core.objective import GlmObjective, RegularizationContext
+    from photon_tpu.core.optimizers import OptimizationStatesTracker, OptimizerConfig
+    from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
+    from photon_tpu.core.stats import BasicStatisticalSummary
+    from photon_tpu.data.model_io import save_glm_model
+    from photon_tpu.evaluation.evaluators import (
+        MultiEvaluator,
+        default_evaluators_for_task,
+        get_evaluator,
+    )
+    from photon_tpu.models.glm import Coefficients, model_for_task
+    from photon_tpu.parallel import DistributedGlmObjective, shard_batch
+    from photon_tpu.utils import PhotonLogger
+    from photon_tpu.utils.logging import maybe_profile
+
+    logger = PhotonLogger("photon_tpu.train", args.log_file)
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    with logger.timed("load-data"):
+        batch, dim, index_map = common.load_dataset(
+            args.input, args.intercept, args.task
+        )
+        val_batch = common.load_validation(
+            args.validation_input, dim, args.intercept, args.task
+        )
+        logger.info("train: %d examples, %d features", batch.num_examples, dim)
+
+    norm = None
+    if args.normalization != "none":
+        with logger.timed("summarize"):
+            summary = BasicStatisticalSummary.from_batch(batch, dim)
+            norm = NormalizationContext.build(
+                args.normalization, summary, intercept_id=index_map.intercept_id
+            )
+
+    mesh = common.maybe_mesh()
+    if mesh is not None:
+        logger.info("mesh: %d devices on axis 'data'", mesh.devices.size)
+        batch = shard_batch(batch, mesh)
+
+    if args.evaluators:
+        evaluators = MultiEvaluator(
+            [get_evaluator(n) for n in args.evaluators.split(",")]
+        )
+        # LIBSVM/synthetic input has no entity column: sharded evaluators
+        # would only fail after training completes, so reject them up front
+        # (the GAME driver plumbs entity ids; this one cannot).
+        for ev in evaluators.evaluators:
+            if ev.entity_column is not None:
+                raise ValueError(
+                    f"evaluator {ev.name} needs per-entity ids, which "
+                    f"LIBSVM/synthetic input does not carry; use the GAME "
+                    f"training driver for sharded evaluators"
+                )
+    else:
+        evaluators = MultiEvaluator(default_evaluators_for_task(args.task))
+
+    lambdas = common.parse_weights_list(args.reg_weights)
+    opt_config = OptimizerConfig(
+        max_iterations=args.max_iterations, tolerance=args.tolerance
+    )
+    optimizer = args.optimizer
+    if args.reg_type in ("l1", "elastic_net") and optimizer != "owlqn":
+        logger.warning("reg-type %s requires owlqn; switching optimizer", args.reg_type)
+        optimizer = "owlqn"
+
+    sweep = []
+    w_start = jnp.zeros(dim, jnp.float32)
+    for lam in lambdas:
+        reg = RegularizationContext(args.reg_type, lam, args.elastic_net_alpha)
+        obj = GlmObjective.create(args.task, reg, normalization=norm)
+        objective = obj if mesh is None else DistributedGlmObjective(obj, mesh)
+        problem = GlmOptimizationProblem(
+            objective,
+            ProblemConfig(
+                optimizer=optimizer,
+                regularization=reg,
+                optimizer_config=opt_config,
+                variance_computation=args.variance_computation,
+            ),
+        )
+        with logger.timed(f"train-lambda-{lam}"), maybe_profile(args.profile_dir):
+            t0 = time.monotonic()
+            coefficients, result = problem.run(batch, w_start)
+            jax.block_until_ready(coefficients.means)
+            wall = time.monotonic() - t0
+        tracker = OptimizationStatesTracker(result, wall)
+        logger.info("lambda=%g %s", lam, tracker.summary().splitlines()[0])
+
+        # Store the model in the original feature space.
+        means = coefficients.means
+        if norm is not None:
+            means = norm.model_to_original_space(means)
+        model = model_for_task(args.task, Coefficients(means, coefficients.variances))
+
+        metrics = {}
+        if val_batch is not None:
+            scores = common.scores_on(val_batch, model)
+            metrics = evaluators.evaluate(
+                scores, np.asarray(val_batch.label), np.asarray(val_batch.weight)
+            )
+            logger.info("lambda=%g validation %s", lam, metrics)
+        sweep.append(
+            {
+                "lambda": lam,
+                "model": model,
+                "metrics": metrics,
+                "iterations": tracker.iterations,
+                "convergence_reason": tracker.convergence_reason,
+                "wall_time_s": wall,
+                "final_value": float(result.value),
+            }
+        )
+
+    # Best-model selection by the primary evaluator (falls back to final
+    # objective value when there is no validation set).
+    primary = evaluators.primary
+    if val_batch is not None:
+        best = sweep[0]
+        for entry in sweep[1:]:
+            if primary.better_than(
+                entry["metrics"][primary.name], best["metrics"][primary.name]
+            ):
+                best = entry
+    else:
+        best = min(sweep, key=lambda e: e["final_value"])
+
+    with logger.timed("save-models"):
+        index_map.save(os.path.join(args.output_dir, "feature_index.json"))
+        ext = "avro" if args.model_format == "avro" else "json"
+        save_glm_model(
+            os.path.join(args.output_dir, f"best_model.{ext}"),
+            best["model"], index_map, fmt=args.model_format,
+        )
+        if args.save_all_models:
+            for entry in sweep:
+                save_glm_model(
+                    os.path.join(
+                        args.output_dir, f"model_lambda_{entry['lambda']:g}.{ext}"
+                    ),
+                    entry["model"], index_map, fmt=args.model_format,
+                )
+        summary_payload = {
+            "task": args.task,
+            "optimizer": optimizer,
+            "best_lambda": best["lambda"],
+            "sweep": [
+                {k: v for k, v in entry.items() if k != "model"}
+                for entry in sweep
+            ],
+            "phase_times": logger.phase_times,
+        }
+        with open(os.path.join(args.output_dir, "training_summary.json"), "w") as f:
+            json.dump(summary_payload, f, indent=1)
+    logger.info("best lambda=%g -> %s/best_model.%s",
+                best["lambda"], args.output_dir, ext)
+    return summary_payload
+
+
+def main(argv=None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

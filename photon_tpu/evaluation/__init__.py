@@ -1,0 +1,22 @@
+"""Evaluators: validation metrics including grouped/per-entity variants.
+
+Equivalent of the reference's ``evaluation`` package (Evaluator,
+AreaUnderROCCurveEvaluator, RMSEEvaluator, PoissonLossEvaluator,
+LogisticLossEvaluator, SquaredLossEvaluator, PrecisionAtKEvaluator,
+sharded per-entity evaluators, MultiEvaluator — SURVEY.md §2.2).
+"""
+
+from photon_tpu.evaluation.metrics import (  # noqa: F401
+    area_under_roc_curve,
+    logistic_loss_metric,
+    poisson_loss_metric,
+    precision_at_k,
+    rmse,
+    sharded_metric,
+    squared_loss_metric,
+)
+from photon_tpu.evaluation.evaluators import (  # noqa: F401
+    Evaluator,
+    MultiEvaluator,
+    get_evaluator,
+)

@@ -1,0 +1,154 @@
+"""Evaluator objects: named metrics with comparison direction + registry.
+
+Mirrors the reference's ``Evaluator`` trait (``evaluate``, ``betterThan``) and
+``MultiEvaluator`` (SURVEY.md §2.2).  Names accepted by :func:`get_evaluator`
+follow the reference's CLI vocabulary: ``AUC``, ``RMSE``, ``LOGISTIC_LOSS``,
+``POISSON_LOSS``, ``SQUARED_LOSS``, ``SMOOTHED_HINGE_LOSS``,
+``PRECISION@k`` (e.g. ``PRECISION@10``), and sharded variants
+``SHARDED_AUC:<id_col>`` / ``SHARDED_PRECISION@k:<id_col>``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.evaluation import metrics as M
+
+
+@dataclasses.dataclass(frozen=True)
+class Evaluator:
+    """A named metric.  ``maximize`` gives the comparison direction
+    (AUC/precision up; losses/RMSE down), used for best-model selection."""
+
+    name: str
+    fn: Callable
+    maximize: bool
+    entity_column: Optional[str] = None  # set for sharded evaluators
+    requires_both_classes: bool = False
+
+    def evaluate(
+        self,
+        scores,
+        labels,
+        weights=None,
+        entity_ids=None,
+    ) -> float:
+        if self.entity_column is not None:
+            if entity_ids is None:
+                raise ValueError(
+                    f"evaluator {self.name} needs entity ids ({self.entity_column})"
+                )
+            return float(
+                M.sharded_metric(
+                    self.fn,
+                    scores,
+                    labels,
+                    entity_ids,
+                    weights,
+                    require_both_classes=self.requires_both_classes,
+                )
+            )
+        return float(self.fn(scores, labels, weights))
+
+    def better_than(self, a: float, b: float) -> bool:
+        """Is metric value ``a`` strictly better than ``b``? NaNs lose."""
+        if np.isnan(a):
+            return False
+        if np.isnan(b):
+            return True
+        return a > b if self.maximize else a < b
+
+
+class MultiEvaluator:
+    """Evaluate several metrics at once; the first is the selection metric."""
+
+    def __init__(self, evaluators: Sequence[Evaluator]):
+        if not evaluators:
+            raise ValueError("MultiEvaluator needs at least one evaluator")
+        self.evaluators = list(evaluators)
+
+    @property
+    def primary(self) -> Evaluator:
+        return self.evaluators[0]
+
+    def evaluate(self, scores, labels, weights=None, entity_ids=None) -> dict:
+        out = {}
+        for ev in self.evaluators:
+            ids = None
+            if ev.entity_column is not None and entity_ids is not None:
+                ids = (
+                    entity_ids.get(ev.entity_column)
+                    if isinstance(entity_ids, dict)
+                    else entity_ids
+                )
+            out[ev.name] = ev.evaluate(scores, labels, weights, ids)
+        return out
+
+
+_PRECISION_RE = re.compile(r"^precision@(\d+)$")
+_SHARDED_RE = re.compile(r"^sharded_(auc|precision@(\d+))(?::(\w+))?$", re.IGNORECASE)
+
+
+def get_evaluator(name: str) -> Evaluator:
+    key = name.strip().lower()
+    if key == "auc":
+        return Evaluator("AUC", M.area_under_roc_curve, maximize=True)
+    if key == "rmse":
+        return Evaluator("RMSE", M.rmse, maximize=False)
+    if key == "logistic_loss":
+        return Evaluator("LOGISTIC_LOSS", M.logistic_loss_metric, maximize=False)
+    if key == "poisson_loss":
+        return Evaluator("POISSON_LOSS", M.poisson_loss_metric, maximize=False)
+    if key == "squared_loss":
+        return Evaluator("SQUARED_LOSS", M.squared_loss_metric, maximize=False)
+    if key == "smoothed_hinge_loss":
+        return Evaluator(
+            "SMOOTHED_HINGE_LOSS", M.smoothed_hinge_loss_metric, maximize=False
+        )
+    m = _PRECISION_RE.match(key)
+    if m:
+        k = int(m.group(1))
+        return Evaluator(
+            f"PRECISION@{k}",
+            lambda s, l, w=None, k=k: M.precision_at_k(s, l, w, k),
+            maximize=True,
+        )
+    # Match sharded names against the original string: the entity column
+    # name is case-sensitive (only the metric part is case-folded).
+    m = _SHARDED_RE.match(name.strip())
+    if m:
+        base, k_str, col = m.group(1).lower(), m.group(2), m.group(3) or "entity"
+        if base == "auc":
+            return Evaluator(
+                f"SHARDED_AUC:{col}",
+                M.area_under_roc_curve,
+                maximize=True,
+                entity_column=col,
+                requires_both_classes=True,
+            )
+        k = int(k_str)
+        return Evaluator(
+            f"SHARDED_PRECISION@{k}:{col}",
+            lambda s, l, w=None, k=k: M.precision_at_k(s, l, w, k),
+            maximize=True,
+            entity_column=col,
+        )
+    raise KeyError(f"unknown evaluator {name!r}")
+
+
+def default_evaluators_for_task(task_type: str) -> list[Evaluator]:
+    """The reference's default evaluator per task type."""
+    task = task_type.lower()
+    if task == "logistic_regression":
+        return [get_evaluator("auc"), get_evaluator("logistic_loss")]
+    if task == "linear_regression":
+        return [get_evaluator("rmse"), get_evaluator("squared_loss")]
+    if task == "poisson_regression":
+        return [get_evaluator("poisson_loss")]
+    if task == "smoothed_hinge_loss_linear_svm":
+        return [get_evaluator("auc"), get_evaluator("smoothed_hinge_loss")]
+    raise KeyError(f"unknown task type {task_type!r}")

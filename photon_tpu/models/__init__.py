@@ -1,0 +1,15 @@
+"""Model classes: GLMs and (in :mod:`photon_tpu.game`) GAME containers.
+
+Equivalent of the reference's ``supervised/model`` package
+(GeneralizedLinearModel and subclasses, Coefficients — SURVEY.md §2.1).
+"""
+
+from photon_tpu.models.glm import (  # noqa: F401
+    Coefficients,
+    GeneralizedLinearModel,
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel,
+    model_for_task,
+)

@@ -1,0 +1,107 @@
+"""Generalized linear model classes.
+
+Rebuild of the reference's model hierarchy (photon-lib .../supervised/model:
+``GeneralizedLinearModel``, ``LogisticRegressionModel``,
+``LinearRegressionModel``, ``PoissonRegressionModel``,
+``SmoothedHingeLossLinearSVMModel``, ``Coefficients`` — SURVEY.md §2.1).
+
+A model is a thin, immutable wrapper over :class:`Coefficients` (means +
+optional per-coefficient variances) plus the task's loss/link; scoring is a
+batched margin computation on-device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.core.losses import PointwiseLoss, get_loss
+from photon_tpu.data.batch import Batch, margins
+
+Array = jax.Array
+
+
+class Coefficients(NamedTuple):
+    """Coefficient means + optional variances (GLMix posterior diagonal —
+    the reference's Coefficients(means, variancesOption))."""
+
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[0]
+
+    @classmethod
+    def zeros(cls, dim: int, dtype=jnp.float32) -> "Coefficients":
+        return cls(means=jnp.zeros(dim, dtype))
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """Base GLM: coefficients + task.
+
+    ``compute_score`` is the raw margin (w.x + offset); ``predict`` applies
+    the mean/inverse-link function, matching the reference's
+    computeMean/score split.
+    """
+
+    coefficients: Coefficients
+    loss: PointwiseLoss
+
+    task_type: str = "custom"
+
+    def compute_score(self, batch: Batch) -> Array:
+        return margins(self.coefficients.means, batch)
+
+    def predict(self, batch: Batch) -> Array:
+        return self.loss.mean(self.compute_score(batch))
+
+    def with_coefficients(self, coefficients: Coefficients) -> "GeneralizedLinearModel":
+        return dataclasses.replace(self, coefficients=coefficients)
+
+
+def LogisticRegressionModel(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(
+        coefficients, get_loss("logistic"), task_type="logistic_regression"
+    )
+
+
+def LinearRegressionModel(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(
+        coefficients, get_loss("squared"), task_type="linear_regression"
+    )
+
+
+def PoissonRegressionModel(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(
+        coefficients, get_loss("poisson"), task_type="poisson_regression"
+    )
+
+
+def SmoothedHingeLossLinearSVMModel(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(
+        coefficients,
+        get_loss("smoothed_hinge"),
+        task_type="smoothed_hinge_loss_linear_svm",
+    )
+
+
+_TASK_BUILDERS = {
+    "logistic_regression": LogisticRegressionModel,
+    "linear_regression": LinearRegressionModel,
+    "poisson_regression": PoissonRegressionModel,
+    "smoothed_hinge_loss_linear_svm": SmoothedHingeLossLinearSVMModel,
+}
+
+
+def model_for_task(task_type: str, coefficients: Coefficients) -> GeneralizedLinearModel:
+    task = task_type.lower()
+    if task not in _TASK_BUILDERS:
+        raise KeyError(
+            f"unknown task type {task_type!r}; available: {sorted(_TASK_BUILDERS)}"
+        )
+    return _TASK_BUILDERS[task](coefficients)

@@ -4,10 +4,12 @@ The TPU-native rebuild of the reference's ``DistributedGLMLossFunction``
 (photon-api .../function/glm — SURVEY.md §3.4): where the reference broadcasts
 coefficients, folds each RDD partition through a ``ValueAndGradientAggregator``
 and tree-reduces (gradient, value) pairs to the driver once per optimizer
-iteration, this evaluates the local shard's value/gradient under ``shard_map``
-and combines with ``lax.psum`` over the mesh's data axis — one fused XLA
-program per optimizer *run* (not per iteration), no host round-trips, with the
-coefficient vector resident and replicated in device memory.
+iteration, here the *loss value* is a ``shard_map`` program — local weighted
+loss per shard, ``lax.psum`` over the mesh's data axis — and derivatives come
+from differentiating straight through it (``jax.value_and_grad`` /
+``jax.jvp``), which transposes the psum correctly under JAX's varying-axes
+semantics.  One fused XLA program per optimizer *run*, no host round-trips,
+coefficients resident and replicated in device memory.
 
 The optimizer is oblivious: it receives a ``fun(w) -> (value, grad)`` whose
 collectives are internal, so the same L-BFGS/OWL-QN/TRON code drives
@@ -22,9 +24,8 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from photon_tpu.core.objective import GlmObjective
 from photon_tpu.data.batch import Batch
@@ -51,50 +52,34 @@ class DistributedGlmObjective:
             lambda leaf: P(self.axis_name, *([None] * (leaf.ndim - 1))), batch
         )
 
-    # -- distributed evaluations ---------------------------------------------
-    def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
+    # -- distributed value (the one shard_map program) ------------------------
+    def value(self, w: Array, batch: Batch) -> Array:
+        """Global objective: psum of per-shard weighted losses + L2 once."""
         ax = self.axis_name
 
         @partial(
             shard_map,
             mesh=self.mesh,
             in_specs=(P(), self._batch_specs(batch)),
-            out_specs=(P(), P()),
-            check_rep=False,
+            out_specs=P(),
         )
-        def _vg(w, local):
-            # L2 must be added once globally, not once per shard.
-            v, g = jax.value_and_grad(self.obj.data_value)(w, local)
-            v = lax.psum(v, ax)
-            g = lax.psum(g, ax)
-            if self.obj.l2_weight:
-                v = v + 0.5 * self.obj.l2_weight * jnp.dot(w, w)
-                g = g + self.obj.l2_weight * w
-            return v, g
+        def _v(w, local):
+            return lax.psum(self.obj.data_value(w, local), ax)
 
-        return _vg(w, batch)
+        v = _v(w, batch)
+        if self.obj.l2_weight:
+            v = v + 0.5 * self.obj.l2_weight * jnp.dot(w, w)
+        return v
 
-    def value(self, w: Array, batch: Batch) -> Array:
-        return self.value_and_grad(w, batch)[0]
+    # -- derivatives: differentiate through the psum --------------------------
+    def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
+        return jax.value_and_grad(self.value)(w, batch)
+
+    def grad(self, w: Array, batch: Batch) -> Array:
+        return jax.grad(self.value)(w, batch)
 
     def hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
-        ax = self.axis_name
-
-        @partial(
-            shard_map,
-            mesh=self.mesh,
-            in_specs=(P(), P(), self._batch_specs(batch)),
-            out_specs=P(),
-            check_rep=False,
-        )
-        def _hv(w, v, local):
-            hv = jax.jvp(
-                lambda u: jax.grad(self.obj.data_value)(u, local), (w,), (v,)
-            )[1]
-            hv = lax.psum(hv, ax)
-            return hv + self.obj.l2_weight * v
-
-        return _hv(w, v, batch)
+        return jax.jvp(lambda u: self.grad(u, batch), (w,), (v,))[1]
 
     def hessian_diagonal(self, w: Array, batch: Batch) -> Array:
         ax = self.axis_name
@@ -105,14 +90,12 @@ class DistributedGlmObjective:
             mesh=self.mesh,
             in_specs=(P(), self._batch_specs(batch)),
             out_specs=P(),
-            check_rep=False,
         )
         def _hd(w, local):
             # Strip the l2 added per shard by the local method; re-add once.
-            local_diag = self.obj.hessian_diagonal(w, local) - l2
-            return lax.psum(local_diag, ax) + l2
+            return lax.psum(self.obj.hessian_diagonal(w, local) - l2, ax)
 
-        return _hd(w, batch)
+        return _hd(w, batch) + l2
 
     # -- optimizer binding ----------------------------------------------------
     def bind(self, batch: Batch) -> Callable[[Array], tuple[Array, Array]]:

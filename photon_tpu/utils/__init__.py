@@ -1,0 +1,7 @@
+"""Utilities: logging, phase timing, profiling hooks.
+
+Equivalent of the reference's ``util`` package (PhotonLogger, Timed —
+SURVEY.md §2.1/§5).
+"""
+
+from photon_tpu.utils.logging import PhotonLogger, Timed  # noqa: F401

@@ -1,0 +1,90 @@
+"""Logging + phase timing.
+
+Rebuild of the reference's ``PhotonLogger`` (driver log file + console) and
+``Timed`` blocks that record wall-clock per driver phase (SURVEY.md §5
+'Tracing / profiling').  Adds an optional hook into ``jax.profiler`` traces
+for device-level profiling, the TPU-era upgrade of the reference's
+phase-timer logs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import time
+from typing import Iterator, Optional
+
+
+class PhotonLogger:
+    """Console + optional file logger with phase-timing helpers."""
+
+    def __init__(self, name: str = "photon_tpu", log_file: Optional[str] = None,
+                 level: int = logging.INFO):
+        self._logger = logging.getLogger(name)
+        self._logger.setLevel(level)
+        self._logger.propagate = False
+        if not self._logger.handlers:
+            console = logging.StreamHandler(sys.stderr)
+            console.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+            )
+            self._logger.addHandler(console)
+        if log_file:
+            # logging.getLogger returns a process-wide singleton: adding the
+            # same file on every construction would duplicate every line.
+            target = os.path.abspath(log_file)
+            already = any(
+                isinstance(h, logging.FileHandler) and h.baseFilename == target
+                for h in self._logger.handlers
+            )
+            if not already:
+                os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
+                fh = logging.FileHandler(log_file)
+                fh.setFormatter(
+                    logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+                )
+                self._logger.addHandler(fh)
+        self.phase_times: dict[str, float] = {}
+
+    def info(self, msg: str, *args) -> None:
+        self._logger.info(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self._logger.warning(msg, *args)
+
+    def error(self, msg: str, *args) -> None:
+        self._logger.error(msg, *args)
+
+    @contextlib.contextmanager
+    def timed(self, phase: str) -> Iterator[None]:
+        """Log + record wall-clock of a driver phase (the reference's
+        ``Timed { }``)."""
+        t0 = time.monotonic()
+        self.info("phase %s: start", phase)
+        try:
+            yield
+        finally:
+            dt = time.monotonic() - t0
+            self.phase_times[phase] = self.phase_times.get(phase, 0.0) + dt
+            self.info("phase %s: done in %.3fs", phase, dt)
+
+
+@contextlib.contextmanager
+def Timed(phase: str, logger: Optional[PhotonLogger] = None) -> Iterator[None]:
+    logger = logger or PhotonLogger()
+    with logger.timed(phase):
+        yield
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: Optional[str]) -> Iterator[None]:
+    """Wrap a phase in a jax.profiler trace when a directory is given."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

@@ -1,0 +1,135 @@
+"""LIBSVM reader, index maps, Avro codec, model save/load round trips
+(the reference's IO + index-map unit tests — SURVEY.md §4)."""
+
+import io
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data import avro_codec
+from photon_tpu.data.index_map import DELIMITER, IndexMap, feature_key
+from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
+from photon_tpu.data.model_io import load_glm_model, save_glm_model
+from photon_tpu.models.glm import Coefficients, LogisticRegressionModel
+
+LIBSVM_SAMPLE = b"""\
++1 3:1 11:0.5 14:-2
+-1 1:2.5 19:1 39:1  # trailing comment
++1 5:1
+-1 2:1 3:0.5
+"""
+
+
+def test_parse_libsvm(tmp_path):
+    p = tmp_path / "sample.libsvm"
+    p.write_bytes(LIBSVM_SAMPLE)
+    data = parse_libsvm(str(p))
+    assert data.num_examples == 4
+    assert data.dim == 39  # max 1-based id 39 -> 0-based 38 -> dim 39
+    np.testing.assert_allclose(data.labels, [1, -1, 1, -1])
+    ids0, vals0 = data.rows[0]
+    np.testing.assert_array_equal(ids0, [2, 10, 13])
+    np.testing.assert_allclose(vals0, [1.0, 0.5, -2.0])
+
+
+def test_to_sparse_batch_intercept(tmp_path):
+    p = tmp_path / "sample.libsvm"
+    p.write_bytes(LIBSVM_SAMPLE)
+    data = parse_libsvm(str(p))
+    batch, dim = to_sparse_batch(data, intercept=True)
+    assert dim == 40
+    # Labels normalized to {0,1}.
+    np.testing.assert_allclose(np.asarray(batch.label), [1, 0, 1, 0])
+    # Intercept id = 39 present in every row.
+    assert all(39 in set(np.asarray(batch.ids[i])) for i in range(4))
+    # Margin with w = e_intercept is 1 for every row.
+    from photon_tpu.data.batch import margins
+
+    w = jnp.zeros(40).at[39].set(1.0)
+    np.testing.assert_allclose(np.asarray(margins(w, batch)), np.ones(4))
+
+
+def test_index_map_roundtrip(tmp_path):
+    keys = [feature_key("age"), feature_key("cat", "dog"), feature_key("z", "1")]
+    imap = IndexMap.build(keys + keys, intercept=True)  # dedup preserved order
+    assert len(imap) == 4
+    assert imap.intercept_id == 3
+    assert imap.get_id(feature_key("cat", "dog")) == 1
+    assert imap.get_id("missing") == -1
+    path = str(tmp_path / "imap.json")
+    imap.save(path)
+    loaded = IndexMap.load(path)
+    assert list(loaded.keys()) == list(imap.keys())
+    assert loaded.intercept_id == 3
+
+
+def test_avro_codec_primitives_roundtrip():
+    schema = {
+        "type": "record",
+        "name": "T",
+        "fields": [
+            {"name": "s", "type": "string"},
+            {"name": "i", "type": "long"},
+            {"name": "d", "type": "double"},
+            {"name": "u", "type": ["null", "string"]},
+            {"name": "arr", "type": {"type": "array", "items": "double"}},
+        ],
+    }
+    rec = {"s": "héllo", "i": -12345678901, "d": 3.25, "u": None, "arr": [1.0, -2.5]}
+    buf = io.BytesIO()
+    avro_codec.write_datum(buf, rec, schema)
+    buf.seek(0)
+    assert avro_codec.read_datum(buf, schema) == rec
+
+
+def test_avro_container_roundtrip(tmp_path):
+    schema = {
+        "type": "record",
+        "name": "Row",
+        "fields": [{"name": "x", "type": "long"}],
+    }
+    path = str(tmp_path / "rows.avro")
+    records = [{"x": i} for i in range(100)]
+    avro_codec.write_container(path, schema, records)
+    schema2, records2 = avro_codec.read_container(path)
+    assert records2 == records
+    assert schema2["name"] == "Row"
+
+
+@pytest.mark.parametrize("fmt", ["avro", "json"])
+def test_model_save_load_roundtrip(tmp_path, fmt):
+    keys = [feature_key(f"f{i}") for i in range(5)]
+    imap = IndexMap.build(keys, intercept=True)
+    means = jnp.asarray([0.5, 0.0, -1.5, 2.0, 0.0, 0.25])  # two exact zeros
+    variances = jnp.asarray([0.1, 0.2, 0.3, 0.4, 0.5, 0.6])
+    model = LogisticRegressionModel(Coefficients(means, variances))
+    path = str(tmp_path / f"model.{fmt}")
+    save_glm_model(path, model, imap, fmt=fmt)
+    loaded = load_glm_model(path, imap)
+    assert loaded.task_type == "logistic_regression"
+    np.testing.assert_allclose(np.asarray(loaded.coefficients.means), means, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(loaded.coefficients.variances), variances, rtol=1e-6
+    )
+
+
+def test_model_load_with_rebuilt_index_map(tmp_path):
+    # Feature-key join: a permuted/extended index map must still place
+    # coefficients at the right features (the reference's portability
+    # property for name/term-keyed models).
+    keys = [feature_key(n) for n in ("a", "b", "c")]
+    imap = IndexMap.build(keys, intercept=True)
+    means = jnp.asarray([1.0, 2.0, 3.0, 0.5])
+    model = LogisticRegressionModel(Coefficients(means))
+    path = str(tmp_path / "m.avro")
+    save_glm_model(path, model, imap)
+    imap2 = IndexMap.build([feature_key(n) for n in ("c", "x", "a", "b")], intercept=True)
+    loaded = load_glm_model(path, imap2)
+    got = np.asarray(loaded.coefficients.means)
+    assert got[imap2.get_id(feature_key("a"))] == 1.0
+    assert got[imap2.get_id(feature_key("b"))] == 2.0
+    assert got[imap2.get_id(feature_key("c"))] == 3.0
+    assert got[imap2.get_id(feature_key("x"))] == 0.0
+    assert got[imap2.intercept_id] == 0.5
