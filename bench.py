@@ -1,0 +1,119 @@
+"""Headline benchmark: GLM grad-steps/sec (BASELINE.json primary metric).
+
+Times the innermost distributed operation of the framework — one full
+value-and-gradient evaluation of a logistic-GLM objective over a sparse
+batch (the rebuild of the reference's ``DistributedGLMLossFunction.calculate``
+treeAggregate hot path, SURVEY.md §3.4) — as a jit-compiled XLA program on
+whatever backend JAX exposes (one real TPU chip under the driver; CPU
+elsewhere).
+
+Prints ONE JSON line:
+    {"metric": "glm_grad_steps_per_sec", "value": N, "unit": "steps/s",
+     "vs_baseline": N}
+
+``vs_baseline`` is vs. the reference's published numbers — of which there are
+none (``BASELINE.json.published == {}``), so it reports the ratio against a
+recorded prior run in ``BENCH_BASELINE.json`` when present and 1.0 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _build_batch(n: int, k: int, d: int, seed: int = 0):
+    """Synthetic sparse logistic data in the framework's padded-COO layout."""
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import SparseBatch
+
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, d, size=(n, k), dtype=np.int32)  # id 0 = pad/intercept
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    w_true = rng.standard_normal(d).astype(np.float32) * 0.1
+    margin = (w_true[ids] * vals).sum(axis=1)
+    label = (rng.random(n) < 1.0 / (1.0 + np.exp(-margin))).astype(np.float32)
+    return SparseBatch(
+        ids=jnp.asarray(ids),
+        vals=jnp.asarray(vals),
+        label=jnp.asarray(label),
+        offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+    )
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.core.objective import GlmObjective, RegularizationContext
+
+    platform = jax.devices()[0].platform
+    # Problem size: ~32M nonzeros on an accelerator keeps the gather/scatter
+    # hot loop HBM-bound like production GLM batches; small on CPU so the
+    # driver's sanity runs stay fast.
+    if platform == "cpu":
+        n, k, d = 1 << 16, 16, 1 << 14
+    else:
+        n, k, d = 1 << 20, 32, 1 << 18
+
+    batch = _build_batch(n, k, d)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    w = jnp.zeros(d, jnp.float32)
+
+    # Each "grad step" is one full value+gradient over all n rows followed by
+    # a small coefficient update — chaining steps through w gives a real
+    # optimizer-trajectory dependency so no execution can be elided.
+    @jax.jit
+    def step(w, batch):
+        v, g = obj.value_and_grad(w, batch)
+        return w - 1e-3 * g, v
+
+    # Warm up: compile + one execution.
+    w, v = step(w, batch)
+    jax.block_until_ready(w)
+
+    reps = 20 if platform != "cpu" else 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        w, v = step(w, batch)
+    jax.block_until_ready(w)
+    wall = time.perf_counter() - t0
+    steps_per_sec = reps / wall
+
+    vs_baseline = 1.0
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        try:
+            with open(base_path) as f:
+                prior = json.load(f)
+            if prior.get("value"):
+                vs_baseline = steps_per_sec / float(prior["value"])
+        except (ValueError, KeyError):
+            pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "glm_grad_steps_per_sec",
+                "value": round(steps_per_sec, 3),
+                "unit": "steps/s",
+                "vs_baseline": round(vs_baseline, 3),
+                "detail": {
+                    "rows": n,
+                    "nnz_per_row": k,
+                    "dim": d,
+                    "platform": platform,
+                    "rows_per_sec": round(steps_per_sec * n, 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

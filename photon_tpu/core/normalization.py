@@ -70,6 +70,24 @@ class NormalizationContext:
             w_eff = w_eff.at[self.intercept_id].add(-correction)
         return w_eff
 
+    def variances_to_original_space(self, variances: Optional[Array]) -> Optional[Array]:
+        """Transform per-coefficient variances alongside
+        :meth:`model_to_original_space` under the diagonal-posterior
+        approximation: w_orig_j = factor_j * w_j gives
+        var_orig_j = factor_j^2 * var_j, and the intercept's
+        w_int -= (shift*factor) . w adds sum((shift_j*factor_j)^2 * var_j)
+        to its variance (independent coordinates)."""
+        if variances is None:
+            return None
+        f = self.factors_or_ones(variances.shape[0])
+        var = variances * f * f
+        if self.shifts is not None:
+            if self.intercept_id is None:
+                raise ValueError("shift-based normalization requires an intercept")
+            sf = self.shifts * f  # intercept entry is 0 (shift forced to 0)
+            var = var.at[self.intercept_id].add(jnp.dot(sf * sf, variances))
+        return var
+
     @classmethod
     def build(
         cls,

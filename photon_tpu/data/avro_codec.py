@@ -100,8 +100,35 @@ def _resolve(schema: Any, named: _Named) -> Any:
     return schema
 
 
+def _register_named(schema: Any, named: _Named) -> None:
+    """Walk a schema and register every named type up front, so by-name
+    references resolve even when the defining occurrence writes/reads no
+    data first (e.g. an empty array of records followed by a by-name
+    reference in a later field)."""
+    if isinstance(schema, list):
+        for branch in schema:
+            _register_named(branch, named)
+        return
+    if not isinstance(schema, dict):
+        return
+    t = schema.get("type")
+    if t in ("record", "enum") and "name" in schema:
+        if schema["name"] in named.types:
+            return  # already walked (guards recursive schemas)
+        named.types[schema["name"]] = schema
+    if t == "record":
+        for field in schema["fields"]:
+            _register_named(field["type"], named)
+    elif t == "array":
+        _register_named(schema["items"], named)
+    elif t == "map":
+        _register_named(schema["values"], named)
+
+
 def write_datum(buf: BinaryIO, datum: Any, schema: Any, named: _Named | None = None) -> None:
-    named = named or _Named()
+    if named is None:
+        named = _Named()
+        _register_named(schema, named)
     schema = _resolve(schema, named)
     if isinstance(schema, str):
         t = schema
@@ -131,7 +158,6 @@ def write_datum(buf: BinaryIO, datum: Any, schema: Any, named: _Named | None = N
         raise ValueError(f"datum {datum!r} matches no union branch {schema}")
     t = schema["type"]
     if t == "record":
-        named.types[schema["name"]] = schema
         for field in schema["fields"]:
             write_datum(buf, datum[field["name"]], field["type"], named)
     elif t == "array":
@@ -150,7 +176,6 @@ def write_datum(buf: BinaryIO, datum: Any, schema: Any, named: _Named | None = N
                 write_datum(buf, v, schema["values"], named)
         write_long(buf, 0)
     elif t == "enum":
-        named.types[schema["name"]] = schema
         write_long(buf, schema["symbols"].index(datum))
     else:
         # {"type": "string"}-style wrapping of primitives
@@ -179,7 +204,9 @@ def _matches(datum: Any, branch: Any, named: _Named) -> bool:
 
 
 def read_datum(buf: BinaryIO, schema: Any, named: _Named | None = None) -> Any:
-    named = named or _Named()
+    if named is None:
+        named = _Named()
+        _register_named(schema, named)
     schema = _resolve(schema, named)
     if isinstance(schema, str):
         t = schema
@@ -203,7 +230,6 @@ def read_datum(buf: BinaryIO, schema: Any, named: _Named | None = None) -> Any:
         return read_datum(buf, schema[idx], named)
     t = schema["type"]
     if t == "record":
-        named.types[schema["name"]] = schema
         return {
             f["name"]: read_datum(buf, f["type"], named) for f in schema["fields"]
         }
@@ -231,7 +257,6 @@ def read_datum(buf: BinaryIO, schema: Any, named: _Named | None = None) -> Any:
                 k = read_string(buf)
                 out[k] = read_datum(buf, schema["values"], named)
     if t == "enum":
-        named.types[schema["name"]] = schema
         return schema["symbols"][read_long(buf)]
     return read_datum(buf, t, named)
 
@@ -256,9 +281,11 @@ def write_container(path: str, schema: dict, records: list, sync: bytes | None =
         f.write(meta_buf.getvalue())
         f.write(sync)
         if records:
+            named = _Named()
+            _register_named(schema, named)
             block = io.BytesIO()
             for rec in records:
-                write_datum(block, rec, schema)
+                write_datum(block, rec, schema, named)
             payload = block.getvalue()
             hdr = io.BytesIO()
             write_long(hdr, len(records))
@@ -284,6 +311,8 @@ def read_container(path: str) -> tuple[dict, list]:
                 k = read_string(f)
                 meta[k] = read_bytes(f)
         schema = json.loads(meta["avro.schema"].decode())
+        named = _Named()
+        _register_named(schema, named)
         sync = f.read(16)
         records = []
         while True:
@@ -293,7 +322,7 @@ def read_container(path: str) -> tuple[dict, list]:
                 break
             read_long(f)  # byte size (unused, codec is null)
             for _ in range(count):
-                records.append(read_datum(f, schema))
+                records.append(read_datum(f, schema, named))
             if f.read(16) != sync:
                 raise ValueError(f"{path}: sync marker mismatch")
         return schema, records

@@ -132,3 +132,21 @@ def parse_weights_list(s: str) -> list[float]:
 
 def scores_on(batch, model) -> np.ndarray:
     return np.asarray(model.compute_score(batch))
+
+
+def build_flat_evaluators(spec: str, driver_kind: str):
+    """Build a MultiEvaluator from a comma-separated ``--evaluators`` spec,
+    rejecting sharded (per-entity) evaluators up front — LIBSVM/synthetic
+    input carries no entity ids, and failing after an expensive train/score
+    pass would waste the run (GAME drivers plumb entity ids instead)."""
+    from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
+
+    evaluators = MultiEvaluator([get_evaluator(n) for n in spec.split(",")])
+    for ev in evaluators.evaluators:
+        if ev.entity_column is not None:
+            raise ValueError(
+                f"evaluator {ev.name} needs per-entity ids, which "
+                f"LIBSVM/synthetic input does not carry; use the GAME "
+                f"{driver_kind} driver for sharded evaluators"
+            )
+    return evaluators

@@ -35,7 +35,6 @@ def run(args: argparse.Namespace) -> dict:
     common.select_backend(args.backend)
     from photon_tpu.data.index_map import IndexMap
     from photon_tpu.data.model_io import load_glm_model
-    from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
     from photon_tpu.utils import PhotonLogger
 
     logger = PhotonLogger("photon_tpu.score", args.log_file)
@@ -48,11 +47,25 @@ def run(args: argparse.Namespace) -> dict:
     model = load_glm_model(args.model, index_map)
     logger.info("model: %s dim=%d", model.task_type, model.coefficients.dim)
 
+    # Whether the model has an intercept is recorded in the index map, not
+    # the CLI flag — trusting the flag would shift every feature id when the
+    # model was trained with --no-intercept.
+    intercept = index_map.intercept_id is not None
+    if intercept != args.intercept:
+        logger.warning(
+            "index map says intercept=%s; overriding --intercept flag", intercept
+        )
+
+    evaluators = (
+        common.build_flat_evaluators(args.evaluators, "scoring")
+        if args.evaluators else None
+    )
+
     with logger.timed("load-data"):
         # Pad to the model's dimension: scoring files whose max feature id is
         # below the training dim are valid (load_validation handles this).
         batch = common.load_validation(
-            args.input, model.coefficients.dim, args.intercept,
+            args.input, model.coefficients.dim, intercept,
             task=model.task_type,
         )
 
@@ -65,10 +78,7 @@ def run(args: argparse.Namespace) -> dict:
     np.savetxt(os.path.join(args.output_dir, "scores.txt"), scores, fmt="%.8g")
 
     metrics = {}
-    if args.evaluators:
-        evaluators = MultiEvaluator(
-            [get_evaluator(n) for n in args.evaluators.split(",")]
-        )
+    if evaluators is not None:
         metrics = evaluators.evaluate(
             raw_scores,
             np.asarray(batch.label),

@@ -72,7 +72,6 @@ def run(args: argparse.Namespace) -> dict:
     from photon_tpu.evaluation.evaluators import (
         MultiEvaluator,
         default_evaluators_for_task,
-        get_evaluator,
     )
     from photon_tpu.models.glm import Coefficients, model_for_task
     from photon_tpu.parallel import DistributedGlmObjective, shard_batch
@@ -105,19 +104,7 @@ def run(args: argparse.Namespace) -> dict:
         batch = shard_batch(batch, mesh)
 
     if args.evaluators:
-        evaluators = MultiEvaluator(
-            [get_evaluator(n) for n in args.evaluators.split(",")]
-        )
-        # LIBSVM/synthetic input has no entity column: sharded evaluators
-        # would only fail after training completes, so reject them up front
-        # (the GAME driver plumbs entity ids; this one cannot).
-        for ev in evaluators.evaluators:
-            if ev.entity_column is not None:
-                raise ValueError(
-                    f"evaluator {ev.name} needs per-entity ids, which "
-                    f"LIBSVM/synthetic input does not carry; use the GAME "
-                    f"training driver for sharded evaluators"
-                )
+        evaluators = common.build_flat_evaluators(args.evaluators, "training")
     else:
         evaluators = MultiEvaluator(default_evaluators_for_task(args.task))
 
@@ -153,11 +140,15 @@ def run(args: argparse.Namespace) -> dict:
         tracker = OptimizationStatesTracker(result, wall)
         logger.info("lambda=%g %s", lam, tracker.summary().splitlines()[0])
 
-        # Store the model in the original feature space.
+        # Store the model in the original feature space (variances too —
+        # mixing original-space means with normalized-space variances would
+        # mis-scale the GLMix posterior by factor^2 per coordinate).
         means = coefficients.means
+        variances = coefficients.variances
         if norm is not None:
             means = norm.model_to_original_space(means)
-        model = model_for_task(args.task, Coefficients(means, coefficients.variances))
+            variances = norm.variances_to_original_space(variances)
+        model = model_for_task(args.task, Coefficients(means, variances))
 
         metrics = {}
         if val_batch is not None:

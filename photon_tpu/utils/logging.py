@@ -31,21 +31,25 @@ class PhotonLogger:
                 logging.Formatter("%(asctime)s %(levelname)s %(message)s")
             )
             self._logger.addHandler(console)
-        if log_file:
-            # logging.getLogger returns a process-wide singleton: adding the
-            # same file on every construction would duplicate every line.
-            target = os.path.abspath(log_file)
-            already = any(
-                isinstance(h, logging.FileHandler) and h.baseFilename == target
-                for h in self._logger.handlers
+        # logging.getLogger returns a process-wide singleton: each construction
+        # is a new run, so file handlers are reset to exactly the requested
+        # log file (keeping stale ones would append later runs to earlier
+        # runs' logs; re-adding the same file would duplicate every line).
+        target = os.path.abspath(log_file) if log_file else None
+        for h in list(self._logger.handlers):
+            if isinstance(h, logging.FileHandler) and h.baseFilename != target:
+                self._logger.removeHandler(h)
+                h.close()
+        if target and not any(
+            isinstance(h, logging.FileHandler) and h.baseFilename == target
+            for h in self._logger.handlers
+        ):
+            os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
+            fh = logging.FileHandler(log_file)
+            fh.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(message)s")
             )
-            if not already:
-                os.makedirs(os.path.dirname(log_file) or ".", exist_ok=True)
-                fh = logging.FileHandler(log_file)
-                fh.setFormatter(
-                    logging.Formatter("%(asctime)s %(levelname)s %(message)s")
-                )
-                self._logger.addHandler(fh)
+            self._logger.addHandler(fh)
         self.phase_times: dict[str, float] = {}
 
     def info(self, msg: str, *args) -> None:

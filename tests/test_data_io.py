@@ -133,3 +133,25 @@ def test_model_load_with_rebuilt_index_map(tmp_path):
     assert got[imap2.get_id(feature_key("c"))] == 3.0
     assert got[imap2.get_id(feature_key("x"))] == 0.0
     assert got[imap2.intercept_id] == 0.5
+
+
+def test_avro_by_name_reference_with_empty_defining_array():
+    # A named record referenced by name in a later field must decode even
+    # when the defining array is empty (named types are registered by a
+    # schema walk, not lazily at first write).
+    schema = {
+        "type": "record",
+        "name": "M",
+        "fields": [
+            {"name": "means", "type": {"type": "array", "items": {
+                "type": "record", "name": "NTV",
+                "fields": [{"name": "v", "type": "double"}],
+            }}},
+            {"name": "variances", "type": ["null", {"type": "array", "items": "NTV"}]},
+        ],
+    }
+    rec = {"means": [], "variances": [{"v": 1.5}]}
+    buf = io.BytesIO()
+    avro_codec.write_datum(buf, rec, schema)
+    buf.seek(0)
+    assert avro_codec.read_datum(buf, schema) == rec

@@ -97,3 +97,48 @@ def test_train_driver_tron_poisson(tmp_path):
     ]))
     # Poisson loss on validation should beat the intercept-only baseline.
     assert summary["sweep"][0]["metrics"]["POISSON_LOSS"] < 2.0
+
+
+def test_score_no_intercept_model(tmp_path):
+    # The score driver must take intercept presence from the index map, not
+    # the CLI flag: a model trained with --no-intercept scored with default
+    # flags would otherwise shift feature ids (review finding).
+    batch, _ = make_glm_data(300, 12, task="logistic_regression", seed=3,
+                             intercept=False)
+    x, y = np.asarray(batch.x), np.asarray(batch.label)
+    train_p = str(tmp_path / "train.libsvm")
+    write_libsvm(train_p, x, y)
+    out = str(tmp_path / "out")
+    train_driver.run(train_driver.build_parser().parse_args([
+        "--input", train_p, "--task", "logistic_regression",
+        "--reg-weights", "1.0", "--output-dir", out, "--backend", "cpu",
+        "--no-intercept",
+    ]))
+    score_out = str(tmp_path / "scores")
+    result = score_driver.run(score_driver.build_parser().parse_args([
+        "--input", train_p, "--model", os.path.join(out, "best_model.avro"),
+        "--output-dir", score_out, "--backend", "cpu", "--evaluators", "AUC",
+    ]))
+    # With the flag mistakenly trusted, ids shift and AUC collapses.
+    assert result["metrics"]["AUC"] > 0.7
+
+
+def test_score_rejects_sharded_evaluators_before_scoring(tmp_path):
+    batch, _ = make_glm_data(100, 8, task="logistic_regression", seed=4)
+    x, y = np.asarray(batch.x)[:, :-1], np.asarray(batch.label)
+    train_p = str(tmp_path / "train.libsvm")
+    write_libsvm(train_p, x, y)
+    out = str(tmp_path / "out")
+    train_driver.run(train_driver.build_parser().parse_args([
+        "--input", train_p, "--task", "logistic_regression",
+        "--reg-weights", "1.0", "--output-dir", out, "--backend", "cpu",
+    ]))
+    score_out = str(tmp_path / "scores")
+    with pytest.raises(ValueError, match="entity ids"):
+        score_driver.run(score_driver.build_parser().parse_args([
+            "--input", train_p, "--model", os.path.join(out, "best_model.avro"),
+            "--output-dir", score_out, "--backend", "cpu",
+            "--evaluators", "SHARDED_AUC:user",
+        ]))
+    # The guard must fire before any scoring output is written.
+    assert not os.path.exists(os.path.join(score_out, "scores.txt"))

@@ -167,3 +167,23 @@ def test_sparse_stats_match_dense():
     np.testing.assert_allclose(sd.min, ss.min, rtol=1e-5)
     np.testing.assert_allclose(sd.max, ss.max, rtol=1e-5)
     np.testing.assert_allclose(sd.num_nonzeros, ss.num_nonzeros)
+
+
+def test_variances_follow_model_to_original_space():
+    # Monte-carlo check of the diagonal-posterior variance transform: sample
+    # w ~ N(mean, diag(var)) in normalized space, map each sample through
+    # model_to_original_space, and compare empirical variances.
+    rng = np.random.default_rng(0)
+    d = 5
+    factors = jnp.asarray([2.0, 0.5, 1.5, 3.0, 1.0])
+    shifts = jnp.asarray([0.3, -1.0, 0.0, 2.0, 0.0])
+    norm = NormalizationContext(factors=factors, shifts=shifts, intercept_id=4)
+    var = jnp.asarray([0.4, 0.1, 0.2, 0.3, 0.5])
+    samples = rng.standard_normal((200_000, d)) * np.sqrt(np.asarray(var))
+    # Vectorized replica of model_to_original_space for the sample cloud:
+    w_eff = samples * np.asarray(factors)
+    corr = w_eff @ np.asarray(shifts)
+    w_eff[:, 4] -= corr
+    empirical = w_eff.var(axis=0)
+    predicted = np.asarray(norm.variances_to_original_space(var))
+    np.testing.assert_allclose(empirical, predicted, rtol=0.05)
