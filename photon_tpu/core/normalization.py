@@ -70,6 +70,19 @@ class NormalizationContext:
             w_eff = w_eff.at[self.intercept_id].add(-correction)
         return w_eff
 
+    def model_to_normalized_space(self, w_orig: Array) -> Array:
+        """Inverse of :meth:`model_to_original_space` (warm starts: a stored
+        original-space model re-enters an optimizer that works in normalized
+        space).  Exact because the intercept has factor 1 / shift 0."""
+        f = self.factors_or_ones(w_orig.shape[0])
+        w = w_orig / f
+        if self.shifts is not None:
+            if self.intercept_id is None:
+                raise ValueError("shift-based normalization requires an intercept")
+            # shift[intercept] == 0, so the dot sees only real features.
+            w = w.at[self.intercept_id].add(jnp.dot(self.shifts, w_orig))
+        return w
+
     def variances_to_original_space(self, variances: Optional[Array]) -> Optional[Array]:
         """Transform per-coefficient variances alongside
         :meth:`model_to_original_space` under the diagonal-posterior

@@ -1,0 +1,53 @@
+"""GAME (Generalized Additive Mixed Effect) training engine.
+
+TPU-native rebuild of the reference's photon-api layer: the GAME data
+pipeline (``data.GameDatum``/``FixedEffectDataset``/``RandomEffectDataset``),
+coordinates (``FixedEffectCoordinate``/``RandomEffectCoordinate``),
+``CoordinateDescent``, GAME models, and ``GameEstimator`` — SURVEY.md §2.2.
+"""
+
+from photon_tpu.game.data import (
+    DenseShard,
+    EntityBucket,
+    GameDataset,
+    RandomEffectDataset,
+    SparseShard,
+    build_random_effect_dataset,
+)
+from photon_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.game.coordinate import (
+    CoordinateConfig,
+    FixedEffectCoordinate,
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinate,
+    RandomEffectCoordinateConfig,
+    build_coordinate,
+)
+from photon_tpu.game.descent import CoordinateDescent, DescentResult
+from photon_tpu.game.estimator import GameEstimator, GameOptimizationConfiguration
+
+__all__ = [
+    "DenseShard",
+    "SparseShard",
+    "GameDataset",
+    "EntityBucket",
+    "RandomEffectDataset",
+    "build_random_effect_dataset",
+    "FixedEffectModel",
+    "RandomEffectModel",
+    "GameModel",
+    "CoordinateConfig",
+    "FixedEffectCoordinateConfig",
+    "RandomEffectCoordinateConfig",
+    "FixedEffectCoordinate",
+    "RandomEffectCoordinate",
+    "build_coordinate",
+    "CoordinateDescent",
+    "DescentResult",
+    "GameEstimator",
+    "GameOptimizationConfiguration",
+]

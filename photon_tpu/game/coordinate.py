@@ -1,0 +1,417 @@
+"""GAME coordinates: fixed-effect and random-effect training units.
+
+Rebuild of the reference's ``algorithm.Coordinate`` hierarchy
+(``FixedEffectCoordinate`` / ``RandomEffectCoordinate`` — SURVEY.md §2.2,
+§3.1): a coordinate owns one slice of the model, can ``train`` it against the
+residuals (offsets) of the other coordinates, and can ``score`` data with it.
+
+TPU-native shapes (SURVEY.md §2.5 parallelism table):
+
+- **FixedEffectCoordinate** — whole-dataset GLM fit: the batch is sharded
+  over the mesh's data axis and gradients ``psum`` over ICI
+  (DistributedGlmObjective); the reference's broadcast + treeAggregate loop
+  collapses into one XLA program per optimizer run.
+- **RandomEffectCoordinate** — per-entity independent solves: each row-count
+  bucket is a ``[E, R, ...]`` block, and the whole per-entity solver
+  (L-BFGS/OWL-QN/TRON with masked line search) runs under ``jax.vmap`` over
+  the entity axis — thousands of entity solves advance in lockstep, with
+  converged lanes frozen (SURVEY.md §7 'hard parts').  Under a mesh the
+  entity axis is sharded across chips, the analog of the reference's
+  ``RandomEffectDatasetPartitioner`` hash partitioning.
+
+Device-resident data is cached in dataset objects (``FixedEffectDeviceData``
+/ ``RandomEffectDeviceData``) that coordinates share across sweep
+configurations and descent iterations — only the per-iteration offsets move
+host→device (the reference, by contrast, re-broadcasts coefficients every
+iteration).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Protocol, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from photon_tpu.core.normalization import NormalizationContext
+from photon_tpu.core.objective import GlmObjective
+from photon_tpu.core.optimizers import OptimizationStatesTracker
+from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
+from photon_tpu.data.batch import DenseBatch, SparseBatch, with_offset
+from photon_tpu.game.data import (
+    DenseShard,
+    GameDataset,
+    RandomEffectDataset,
+    _gather_shard_rows,
+    build_random_effect_dataset,
+    entity_index_for,
+    pad_bucket_entities,
+)
+from photon_tpu.game.model import (
+    FixedEffectModel,
+    RandomEffectModel,
+    shard_to_batch,
+)
+from photon_tpu.models.glm import Coefficients, model_for_task
+from photon_tpu.parallel.mesh import DATA_AXIS, shard_batch
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinateConfig:
+    """Reference: FixedEffectDataConfiguration + per-coordinate optimization
+    config inside GameOptimizationConfiguration."""
+
+    shard_name: str
+    problem: ProblemConfig = ProblemConfig()
+    downsampling_rate: float = 1.0  # <1: train on a uniform subsample
+    seed: int = 0  # subsample seed
+
+    @property
+    def data_key(self):
+        return ("fixed", self.shard_name, self.downsampling_rate, self.seed)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectCoordinateConfig:
+    """Reference: RandomEffectDataConfiguration (entity id column a.k.a.
+    randomEffectType, feature shard, active-data upper bound)."""
+
+    shard_name: str
+    entity_column: str
+    problem: ProblemConfig = ProblemConfig()
+    active_row_cap: Optional[int] = None
+    seed: int = 0
+
+    @property
+    def data_key(self):
+        return (
+            "random",
+            self.shard_name,
+            self.entity_column,
+            self.active_row_cap,
+            self.seed,
+        )
+
+
+CoordinateConfig = Union[FixedEffectCoordinateConfig, RandomEffectCoordinateConfig]
+
+
+class Coordinate(Protocol):
+    def train(self, offsets: np.ndarray, initial_model=None): ...
+
+    def score(self, model) -> np.ndarray: ...
+
+
+# ---------------------------------------------------------------------------
+# Device-resident datasets (shared across sweep configurations)
+# ---------------------------------------------------------------------------
+
+
+class FixedEffectDeviceData:
+    """The fixed-effect training batch, resident on device (sharded over the
+    mesh's data axis when a mesh is given).  Built once per (shard,
+    downsampling) data config; reused across the regularization sweep."""
+
+    def __init__(
+        self,
+        data: GameDataset,
+        config: FixedEffectCoordinateConfig,
+        mesh=None,
+    ):
+        self.mesh = mesh
+        shard = data.shard(config.shard_name)
+        self.dim = shard.dim
+        self.train_rows: Optional[np.ndarray] = None
+        label, offset, weight = data.label, data.offset, data.weight
+        if config.downsampling_rate < 1.0:
+            # Uniform subsample with 1/rate weight correction (the
+            # reference's DefaultDownSampler on the fixed-effect dataset).
+            rng = np.random.default_rng(config.seed)
+            keep = np.nonzero(rng.random(data.num_examples) < config.downsampling_rate)[0]
+            self.train_rows = keep
+            shard = _gather_shard_rows(shard, keep)
+            label = label[keep]
+            offset = offset[keep]
+            weight = weight[keep] / config.downsampling_rate
+        self.batch = shard_to_batch(shard, label, offset, weight)
+        self.unpadded_n = self.batch.num_examples
+        if mesh is not None:
+            self.batch = shard_batch(self.batch, mesh)
+
+    def offsets_to_device(self, offsets: np.ndarray) -> Array:
+        if self.train_rows is not None:
+            offsets = offsets[self.train_rows]
+        dev = jnp.asarray(offsets, jnp.float32)
+        if self.mesh is None:
+            return dev
+        padded = jnp.pad(dev, (0, self.batch.num_examples - self.unpadded_n))
+        return jax.device_put(padded, NamedSharding(self.mesh, P(DATA_AXIS)))
+
+
+class RandomEffectDeviceData:
+    """Bucketed per-entity data resident on device, entity axis sharded over
+    the mesh.  Holds everything except offsets, which change per descent
+    iteration."""
+
+    def __init__(
+        self,
+        data: GameDataset,
+        config: RandomEffectCoordinateConfig,
+        mesh=None,
+    ):
+        self.mesh = mesh
+        self.dataset: RandomEffectDataset = build_random_effect_dataset(
+            data,
+            entity_column=config.entity_column,
+            shard_name=config.shard_name,
+            active_row_cap=config.active_row_cap,
+            seed=config.seed,
+        )
+        self.dim = self.dataset.dim
+        n_shards = 1 if mesh is None else int(np.prod(list(mesh.shape.values())))
+        self.buckets = [
+            pad_bucket_entities(b, n_shards, self.dataset.num_entities)
+            for b in self.dataset.buckets
+        ]
+        # Device-resident static parts: features / label / weight / entity idx.
+        self.device_buckets = []
+        for bucket in self.buckets:
+            feats = bucket.features
+            if isinstance(feats, DenseShard):
+                dev_feats = (self._place(jnp.asarray(feats.x)),)
+            else:
+                dev_feats = (
+                    self._place(jnp.asarray(feats.ids)),
+                    self._place(jnp.asarray(feats.vals)),
+                )
+            self.device_buckets.append(
+                {
+                    "feats": dev_feats,
+                    "dense": isinstance(feats, DenseShard),
+                    "label": self._place(jnp.asarray(bucket.label)),
+                    "weight": self._place(jnp.asarray(bucket.row_weight)),
+                    "entity_index": jnp.asarray(bucket.entity_index),
+                    "w0": self._place(
+                        jnp.zeros((bucket.num_entities, self.dim), jnp.float32)
+                    ),
+                }
+            )
+
+    def _sharding(self, ndim: int):
+        axis = next(iter(self.mesh.shape))  # single-axis mesh
+        return NamedSharding(self.mesh, P(axis, *([None] * (ndim - 1))))
+
+    def _place(self, leaf: Array) -> Array:
+        if self.mesh is None:
+            return leaf
+        return jax.device_put(leaf, self._sharding(leaf.ndim))
+
+    def batch_for(self, i: int, offsets_b: Array):
+        dev = self.device_buckets[i]
+        offsets_b = self._place(offsets_b)
+        if dev["dense"]:
+            return DenseBatch(dev["feats"][0], dev["label"], offsets_b, dev["weight"])
+        return SparseBatch(
+            dev["feats"][0], dev["feats"][1], dev["label"], offsets_b, dev["weight"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Coordinates
+# ---------------------------------------------------------------------------
+
+
+class FixedEffectCoordinate:
+    """Data-parallel global GLM fit (reference: FixedEffectCoordinate)."""
+
+    def __init__(
+        self,
+        data: GameDataset,
+        config: FixedEffectCoordinateConfig,
+        task_type: str,
+        mesh=None,
+        normalization: Optional[NormalizationContext] = None,
+        device_data: Optional[FixedEffectDeviceData] = None,
+    ):
+        self.data = data
+        self.config = config
+        self.task_type = task_type
+        self.mesh = mesh
+        self.device_data = device_data or FixedEffectDeviceData(data, config, mesh)
+        self.dim = self.device_data.dim
+        if normalization is not None and len(
+            np.asarray(normalization.factors_or_ones(self.dim))
+        ) != self.dim:
+            raise ValueError(
+                f"normalization context dim mismatch for shard "
+                f"{config.shard_name!r} (expected {self.dim})"
+            )
+        # get_loss accepts task-type names directly (core/losses.TASK_TO_LOSS).
+        obj = GlmObjective.create(
+            task_type, config.problem.regularization, normalization
+        )
+        if mesh is None:
+            self.objective = obj
+        else:
+            from photon_tpu.parallel.distributed import DistributedGlmObjective
+
+            self.objective = DistributedGlmObjective(obj, mesh)
+        self.problem = GlmOptimizationProblem(self.objective, config.problem)
+        self.normalization = normalization
+
+    def train(
+        self, offsets: np.ndarray, initial_model: Optional[FixedEffectModel] = None
+    ) -> tuple[FixedEffectModel, OptimizationStatesTracker]:
+        """One GLM fit against the other coordinates' scores as offsets
+        (SURVEY.md §3.1: offsets = sum of scores of other coordinates)."""
+        import time
+
+        batch = with_offset(
+            self.device_data.batch, self.device_data.offsets_to_device(offsets)
+        )
+        w0 = None
+        if initial_model is not None:
+            w0 = jnp.asarray(initial_model.coefficients.means)
+            if self.normalization is not None:
+                w0 = self.normalization.model_to_normalized_space(w0)
+        t0 = time.monotonic()
+        coefficients, result = self.problem.run(batch, w0, dim=self.dim)
+        jax.block_until_ready(coefficients.means)
+        tracker = OptimizationStatesTracker(result, time.monotonic() - t0)
+        means, variances = coefficients.means, coefficients.variances
+        if self.normalization is not None:
+            means = self.normalization.model_to_original_space(means)
+            variances = self.normalization.variances_to_original_space(variances)
+        model = FixedEffectModel(
+            model=model_for_task(self.task_type, Coefficients(means, variances)),
+            shard_name=self.config.shard_name,
+        )
+        return model, tracker
+
+    def score(self, model: FixedEffectModel) -> np.ndarray:
+        return model.score(self.data)
+
+
+class RandomEffectCoordinate:
+    """Per-entity batched GLM fits (reference: RandomEffectCoordinate).
+
+    The reference maps ``SingleNodeOptimizationProblem.run`` over an
+    ``RDD[(entityId, LocalDataset)]``; here each bucket's entities are solved
+    by ONE vmapped optimizer call — per-lane line search and convergence are
+    masked, so early-converging entities freeze while heavy ones iterate
+    (SURVEY.md §7).
+    """
+
+    def __init__(
+        self,
+        data: GameDataset,
+        config: RandomEffectCoordinateConfig,
+        task_type: str,
+        mesh=None,
+        device_data: Optional[RandomEffectDeviceData] = None,
+    ):
+        self.data = data
+        self.config = config
+        self.task_type = task_type
+        self.mesh = mesh
+        self.device_data = device_data or RandomEffectDeviceData(data, config, mesh)
+        self.dataset = self.device_data.dataset
+        self.dim = self.dataset.dim
+        obj = GlmObjective.create(task_type, config.problem.regularization)
+        self.problem = GlmOptimizationProblem(obj, config.problem)
+        # One jitted vmapped solver per bucket shape (bounded by the number
+        # of power-of-two buckets).
+        self._solver = jax.jit(jax.vmap(lambda b, w0: self.problem.run(b, w0)))
+
+    def _initial_table(self, initial_model: RandomEffectModel) -> Array:
+        """Align a warm-start model's per-entity rows onto THIS dataset's
+        vocabulary by key (the model may come from different training data —
+        SURVEY.md §5 warm start); unseen entities start at zero.  The dummy
+        slot at the end absorbs padded entities."""
+        aligned = np.zeros((self.dataset.num_entities + 1, self.dim), np.float32)
+        src_idx = entity_index_for(self.dataset.keys, np.asarray(initial_model.keys))
+        found = src_idx >= 0
+        if initial_model.dim != self.dim:
+            raise ValueError(
+                f"warm-start model dim {initial_model.dim} != coordinate dim {self.dim}"
+            )
+        aligned[:-1][found] = np.asarray(initial_model.table)[src_idx[found]]
+        return jnp.asarray(aligned)
+
+    def train(
+        self, offsets: np.ndarray, initial_model: Optional[RandomEffectModel] = None
+    ) -> tuple[RandomEffectModel, dict]:
+        """Solve every entity; returns the model + convergence summary."""
+        num_entities = self.dataset.num_entities
+        # Extra dummy slot absorbs padded entities' scatter writes.
+        table = jnp.zeros((num_entities + 1, self.dim), jnp.float32)
+        var_table = (
+            jnp.zeros((num_entities + 1, self.dim), jnp.float32)
+            if self.config.problem.variance_computation != "none"
+            else None
+        )
+        init_table = (
+            None if initial_model is None else self._initial_table(initial_model)
+        )
+        stats = {"entities": 0, "converged": 0, "iterations_max": 0}
+        for i, bucket in enumerate(self.device_data.buckets):
+            offsets_b = jnp.asarray(
+                offsets[bucket.row_index] * (bucket.row_weight > 0), jnp.float32
+            )
+            batch = self.device_data.batch_for(i, offsets_b)
+            entity_idx = self.device_data.device_buckets[i]["entity_index"]
+            if init_table is not None:
+                w0 = self.device_data._place(init_table[entity_idx])
+            else:
+                w0 = self.device_data.device_buckets[i]["w0"]
+            coefficients, result = self._solver(batch, w0)
+            table = table.at[entity_idx].set(coefficients.means)
+            if var_table is not None:
+                var_table = var_table.at[entity_idx].set(coefficients.variances)
+            real = bucket.entity_index < num_entities
+            stats["entities"] += int(real.sum())
+            stats["converged"] += int(np.asarray(result.converged)[real].sum())
+            if real.any():
+                stats["iterations_max"] = max(
+                    stats["iterations_max"],
+                    int(np.asarray(result.iterations)[real].max()),
+                )
+        model = RandomEffectModel(
+            table=table[:num_entities],
+            keys=self.dataset.keys,
+            entity_column=self.config.entity_column,
+            shard_name=self.config.shard_name,
+            task_type=self.task_type,
+            variances=None if var_table is None else var_table[:num_entities],
+        )
+        return model, stats
+
+    def score(self, model: RandomEffectModel) -> np.ndarray:
+        return model.score(self.data)
+
+
+def build_coordinate(
+    data: GameDataset,
+    config: CoordinateConfig,
+    task_type: str,
+    mesh=None,
+    normalization: Optional[NormalizationContext] = None,
+    device_data=None,
+):
+    if isinstance(config, FixedEffectCoordinateConfig):
+        return FixedEffectCoordinate(
+            data, config, task_type, mesh, normalization, device_data
+        )
+    if isinstance(config, RandomEffectCoordinateConfig):
+        if normalization is not None:
+            raise ValueError(
+                "normalization is not supported for random-effect coordinates "
+                f"(coordinate on shard {config.shard_name!r})"
+            )
+        return RandomEffectCoordinate(data, config, task_type, mesh, device_data)
+    raise TypeError(f"unknown coordinate config type {type(config)!r}")

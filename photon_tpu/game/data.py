@@ -1,0 +1,320 @@
+"""GAME data pipeline: feature shards, entity grouping, bucketed datasets.
+
+Rebuild of the reference's GAME data layer (photon-api .../data:
+``GameDatum``, ``FixedEffectDataset``, ``RandomEffectDataset``,
+``LocalDataset``, ``RandomEffectDatasetPartitioner`` — SURVEY.md §2.2).  The
+reference builds an ``RDD[(UniqueSampleId, GameDatum)]`` then, per random
+effect, SHUFFLES rows into per-entity groups spread over executors; each
+entity's rows become a ``LocalDataset`` solved independently.
+
+On TPU the same structure becomes static arrays (SURVEY.md §2.6: "the
+entity-grouping shuffle becomes a one-time host-side bucketing"):
+
+- A :class:`GameDataset` is columnar host-side storage — one row per example
+  (the unique-sample-id order IS the row index), per-coordinate **feature
+  shards** (dense ``[n, d]`` or padded-sparse ``[n, k]`` blocks), and raw
+  entity-id columns.
+- A :class:`RandomEffectDataset` groups rows by entity **once** and packs
+  entities into power-of-two row-count **buckets**: each bucket is a dense
+  ``[E, R, ...]`` block where every entity has exactly ``R`` (padded) rows.
+  Buckets keep XLA shapes static while bounding padding waste to 2x on the
+  skewed per-entity row-count distribution (SURVEY.md §7 'hard parts':
+  ragged per-entity data under vmap).
+- The reference's active/passive split (``numActiveDataPointsUpperBound``)
+  becomes an ``active_row_cap``: entities over the cap train on a seeded
+  subsample with weights scaled by ``count/cap`` (unbiased objective), while
+  scoring still covers every row via :meth:`RandomEffectDataset.entity_index_for`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, NamedTuple, Optional, Union
+
+import numpy as np
+
+Float = np.float32
+
+
+class DenseShard(NamedTuple):
+    """A feature shard stored dense: ``x[i]`` is row i's feature vector."""
+
+    x: np.ndarray  # [n, d] float32
+
+    @property
+    def dim(self) -> int:
+        return self.x.shape[1]
+
+
+class SparseShard(NamedTuple):
+    """A feature shard in padded-COO layout (see data.batch.SparseBatch)."""
+
+    ids: np.ndarray  # [n, k] int32
+    vals: np.ndarray  # [n, k] float32
+    dim_: int
+
+    @property
+    def dim(self) -> int:
+        return self.dim_
+
+
+Shard = Union[DenseShard, SparseShard]
+
+
+def _gather_shard_rows(shard: Shard, row_index: np.ndarray) -> Shard:
+    """Index a shard's per-row arrays with an arbitrary-shape row index."""
+    if isinstance(shard, DenseShard):
+        return DenseShard(shard.x[row_index])
+    return SparseShard(shard.ids[row_index], shard.vals[row_index], shard.dim_)
+
+
+@dataclasses.dataclass(frozen=True)
+class GameDataset:
+    """Columnar GAME training/scoring data (host side).
+
+    The row index plays the reference's ``UniqueSampleId`` role: scores,
+    offsets, and labels all align on it.
+    """
+
+    label: np.ndarray  # [n] float32
+    offset: np.ndarray  # [n] float32
+    weight: np.ndarray  # [n] float32
+    shards: Dict[str, Shard]
+    id_columns: Dict[str, np.ndarray]  # raw per-row entity keys
+
+    def __post_init__(self):
+        n = self.num_examples
+        for name, col in self.id_columns.items():
+            if len(col) != n:
+                raise ValueError(f"id column {name!r} has {len(col)} rows, want {n}")
+        for name, shard in self.shards.items():
+            rows = shard.x.shape[0] if isinstance(shard, DenseShard) else shard.ids.shape[0]
+            if rows != n:
+                raise ValueError(f"feature shard {name!r} has {rows} rows, want {n}")
+
+    @property
+    def num_examples(self) -> int:
+        return len(self.label)
+
+    def shard(self, name: str) -> Shard:
+        if name not in self.shards:
+            raise KeyError(
+                f"unknown feature shard {name!r}; available: {sorted(self.shards)}"
+            )
+        return self.shards[name]
+
+    @classmethod
+    def create(
+        cls,
+        label: np.ndarray,
+        shards: Dict[str, Shard],
+        id_columns: Optional[Dict[str, np.ndarray]] = None,
+        offset: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+    ) -> "GameDataset":
+        n = len(label)
+        return cls(
+            label=np.asarray(label, Float),
+            offset=np.zeros(n, Float) if offset is None else np.asarray(offset, Float),
+            weight=np.ones(n, Float) if weight is None else np.asarray(weight, Float),
+            shards=dict(shards),
+            id_columns={} if id_columns is None else dict(id_columns),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class EntityBucket:
+    """One row-capacity cohort of a random-effect dataset.
+
+    Every entity in the bucket owns exactly ``row_capacity`` (padded) rows.
+    Padded rows carry ``weight == 0`` (invisible to objectives); their
+    ``row_index`` points at row 0, which is safe because weight masks them.
+    """
+
+    row_capacity: int
+    entity_index: np.ndarray  # [E] int32 — global entity index
+    row_index: np.ndarray  # [E, R] int64 — original dataset row
+    row_weight: np.ndarray  # [E, R] float32 — 0 on padding; includes cap correction
+    label: np.ndarray  # [E, R] float32
+    features: Shard  # x: [E, R, d]  or  ids/vals: [E, R, k]
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.entity_index)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataset:
+    """Per-entity training data for one random-effect coordinate.
+
+    ``keys`` is the sorted entity vocabulary; a global entity index is its
+    position in ``keys``.  ``entity_idx_per_row`` maps every dataset row to
+    its entity index (the scoring-side join the reference does with a
+    shuffle).
+    """
+
+    entity_column: str
+    shard_name: str
+    dim: int
+    keys: np.ndarray  # [num_entities] sorted unique entity keys
+    buckets: tuple[EntityBucket, ...]
+    entity_idx_per_row: np.ndarray  # [n] int32
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.keys)
+
+    def entity_index_for(self, raw_keys: np.ndarray) -> np.ndarray:
+        """Map raw entity keys to this dataset's entity indices (-1 = unseen).
+
+        The scoring-time equivalent of the reference's data-model JOIN by
+        entity id (SURVEY.md §3.3): unseen entities score zero from this
+        coordinate.
+        """
+        return entity_index_for(raw_keys, self.keys)
+
+
+def entity_index_for(raw_keys: np.ndarray, vocab_keys: np.ndarray) -> np.ndarray:
+    """Vectorized key→index lookup against a sorted vocabulary; -1 = missing."""
+    raw = np.asarray(raw_keys)
+    pos = np.searchsorted(vocab_keys, raw)
+    pos = np.clip(pos, 0, len(vocab_keys) - 1)
+    found = vocab_keys[pos] == raw if len(vocab_keys) else np.zeros(len(raw), bool)
+    return np.where(found, pos, -1).astype(np.int32)
+
+
+def _bucket_capacity(count: int, cap: Optional[int]) -> int:
+    """Power-of-two row capacity for an entity with ``count`` active rows."""
+    if cap is not None:
+        count = min(count, cap)
+    r = 1
+    while r < count:
+        r *= 2
+    return r
+
+
+def build_random_effect_dataset(
+    data: GameDataset,
+    entity_column: str,
+    shard_name: str,
+    active_row_cap: Optional[int] = None,
+    seed: int = 0,
+    vocab: Optional[np.ndarray] = None,
+) -> RandomEffectDataset:
+    """Group rows by entity and pack them into row-capacity buckets.
+
+    This is the one-time host-side replacement for the reference's
+    ``RandomEffectDataset`` build (groupByKey + partitionBy shuffle —
+    SURVEY.md §2.6).  ``vocab`` pins the entity vocabulary (e.g. when
+    bucketing validation data against a training vocabulary); by default the
+    vocabulary is the sorted unique keys present in ``data``.
+    """
+    if entity_column not in data.id_columns:
+        raise KeyError(
+            f"unknown id column {entity_column!r}; available: "
+            f"{sorted(data.id_columns)}"
+        )
+    shard = data.shard(shard_name)
+    raw = data.id_columns[entity_column]
+
+    if vocab is None:
+        keys = np.unique(raw)
+    else:
+        # entity_index_for requires a sorted unique vocabulary; normalize the
+        # caller's array (index = position in the SORTED keys, everywhere).
+        keys = np.unique(np.asarray(vocab))
+    entity_idx_per_row = entity_index_for(raw, keys)
+
+    # Group row indices by entity (stable order = original row order).
+    present = entity_idx_per_row >= 0
+    order = np.argsort(entity_idx_per_row[present], kind="stable")
+    rows_in_order = np.nonzero(present)[0][order]
+    counts = np.bincount(entity_idx_per_row[present], minlength=len(keys))
+    starts = np.concatenate([[0], np.cumsum(counts)])
+
+    rng = np.random.default_rng(seed)
+    # Cohort entities by padded row capacity.
+    by_capacity: Dict[int, list[tuple[int, np.ndarray, float]]] = {}
+    for e in range(len(keys)):
+        count = int(counts[e])
+        if count == 0:
+            continue  # vocab entity with no data: stays at zero coefficients
+        entity_rows = rows_in_order[starts[e] : starts[e + 1]]
+        correction = 1.0
+        if active_row_cap is not None and count > active_row_cap:
+            # Active-set subsample with unbiased weight correction (the
+            # reference's numActiveDataPointsUpperBound down-sampling).
+            entity_rows = rng.choice(entity_rows, size=active_row_cap, replace=False)
+            entity_rows.sort()
+            correction = count / active_row_cap
+        capacity = _bucket_capacity(len(entity_rows), active_row_cap)
+        by_capacity.setdefault(capacity, []).append((e, entity_rows, correction))
+
+    buckets = []
+    for capacity in sorted(by_capacity):
+        members = by_capacity[capacity]
+        n_e = len(members)
+        entity_index = np.empty(n_e, np.int32)
+        row_index = np.zeros((n_e, capacity), np.int64)
+        mask = np.zeros((n_e, capacity), Float)
+        corrections = np.empty(n_e, Float)
+        for i, (e, entity_rows, correction) in enumerate(members):
+            entity_index[i] = e
+            row_index[i, : len(entity_rows)] = entity_rows
+            mask[i, : len(entity_rows)] = 1.0
+            corrections[i] = correction
+        row_weight = data.weight[row_index] * mask * corrections[:, None]
+        buckets.append(
+            EntityBucket(
+                row_capacity=capacity,
+                entity_index=entity_index,
+                row_index=row_index,
+                row_weight=row_weight.astype(Float),
+                label=(data.label[row_index] * mask).astype(Float),
+                features=_gather_shard_rows(shard, row_index),
+            )
+        )
+
+    return RandomEffectDataset(
+        entity_column=entity_column,
+        shard_name=shard_name,
+        dim=shard.dim,
+        keys=keys,
+        buckets=tuple(buckets),
+        entity_idx_per_row=entity_idx_per_row,
+    )
+
+
+def pad_bucket_entities(bucket: EntityBucket, multiple: int, num_entities: int) -> EntityBucket:
+    """Pad a bucket's entity axis to a multiple (for even mesh sharding).
+
+    Padded entities carry zero row weights and ``entity_index ==
+    num_entities`` — a scatter into the coefficient table's dummy slot (the
+    table is allocated with ``num_entities + 1`` rows; see
+    RandomEffectCoordinate).
+    """
+    n_e = bucket.num_entities
+    target = ((n_e + multiple - 1) // multiple) * multiple
+    if target == n_e:
+        return bucket
+    pad = target - n_e
+
+    def pad0(a: np.ndarray) -> np.ndarray:
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    features = bucket.features
+    if isinstance(features, DenseShard):
+        features = DenseShard(pad0(features.x))
+    else:
+        features = SparseShard(pad0(features.ids), pad0(features.vals), features.dim_)
+    return EntityBucket(
+        row_capacity=bucket.row_capacity,
+        entity_index=np.concatenate(
+            [bucket.entity_index, np.full(pad, num_entities, np.int32)]
+        ),
+        row_index=pad0(bucket.row_index),
+        row_weight=pad0(bucket.row_weight),
+        label=pad0(bucket.label),
+        features=features,
+    )

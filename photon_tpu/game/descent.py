@@ -1,0 +1,154 @@
+"""CoordinateDescent: the GAME outer loop.
+
+Rebuild of the reference's ``algorithm.CoordinateDescent``
+(``descend``/``optimize`` — SURVEY.md §2.2, §3.1): cycle the coordinates in
+update order for a fixed number of outer iterations; each coordinate trains
+against the **residuals** of the others — its training offsets are the
+dataset offset plus the sum of every other coordinate's current scores — then
+re-scores the data.  After each full pass the composite model is evaluated on
+validation data and the best model (by the primary evaluator) is tracked.
+
+Locked coordinates (the reference's partial-retraining lock list) keep their
+initial model: they are scored but never retrained.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.evaluation.evaluators import MultiEvaluator
+from photon_tpu.game.data import GameDataset
+from photon_tpu.game.model import GameModel
+from photon_tpu.utils.logging import PhotonLogger
+
+
+@dataclasses.dataclass
+class DescentResult:
+    """Outcome of one CoordinateDescent run."""
+
+    best_model: GameModel
+    last_model: GameModel
+    best_metrics: Dict[str, float]
+    history: list  # per outer iteration: {"iteration", "metrics", "coordinates"}
+
+    @property
+    def models_match(self) -> bool:
+        return self.best_model is self.last_model
+
+
+class CoordinateDescent:
+    """Cycles coordinate training with residual (offset) passing.
+
+    ``coordinates`` maps name -> built Coordinate object; iteration order is
+    the update order (the reference's coordinateUpdateSequence).
+    """
+
+    def __init__(
+        self,
+        coordinates: Dict[str, object],
+        task_type: str,
+        training_data: GameDataset,
+        validation_data: Optional[GameDataset] = None,
+        evaluators: Optional[MultiEvaluator] = None,
+        logger: Optional[PhotonLogger] = None,
+    ):
+        if not coordinates:
+            raise ValueError("CoordinateDescent needs at least one coordinate")
+        self.coordinates = dict(coordinates)
+        self.task_type = task_type
+        self.training_data = training_data
+        self.validation_data = validation_data
+        self.evaluators = evaluators
+        self.logger = logger or PhotonLogger("photon_tpu.game")
+
+    def _evaluate(self, model: GameModel) -> Dict[str, float]:
+        if self.validation_data is None or self.evaluators is None:
+            return {}
+        data = self.validation_data
+        scores = model.score(data)
+        entity_ids = dict(data.id_columns)
+        return self.evaluators.evaluate(scores, data.label, data.weight, entity_ids)
+
+    def run(
+        self,
+        num_iterations: int,
+        initial_model: Optional[GameModel] = None,
+        locked_coordinates: Sequence[str] = (),
+    ) -> DescentResult:
+        locked = set(locked_coordinates)
+        unknown = locked - set(self.coordinates)
+        if unknown:
+            raise KeyError(f"locked coordinates not in update sequence: {sorted(unknown)}")
+        if locked and initial_model is None:
+            raise ValueError("locked coordinates require an initial model")
+        for name in locked:
+            if initial_model is not None and name not in initial_model.coordinates:
+                raise KeyError(f"locked coordinate {name!r} missing from initial model")
+
+        n = self.training_data.num_examples
+        models: Dict[str, object] = {}
+        scores: Dict[str, np.ndarray] = {}
+        if initial_model is not None:
+            for name, coord_model in initial_model.coordinates.items():
+                if name not in self.coordinates:
+                    continue
+                models[name] = coord_model
+                scores[name] = np.asarray(
+                    self.coordinates[name].score(coord_model), np.float64
+                )
+
+        base_offset = self.training_data.offset.astype(np.float64)
+        best_model: Optional[GameModel] = None
+        best_metrics: Dict[str, float] = {}
+        history = []
+
+        for it in range(num_iterations):
+            coord_logs = {}
+            for name, coord in self.coordinates.items():
+                if name in locked:
+                    continue
+                offsets = base_offset.copy()
+                for other, s in scores.items():
+                    if other != name:
+                        offsets += s
+                with self.logger.timed(f"iter{it}-{name}"):
+                    model, info = coord.train(
+                        offsets.astype(np.float32), initial_model=models.get(name)
+                    )
+                models[name] = model
+                scores[name] = np.asarray(coord.score(model), np.float64)
+                summary = (
+                    info.summary().splitlines()[0]
+                    if hasattr(info, "summary")
+                    else str(info)
+                )
+                coord_logs[name] = summary
+                self.logger.info("iter %d coordinate %s: %s", it, name, summary)
+
+            game_model = GameModel(dict(models), self.task_type)
+            metrics = self._evaluate(game_model)
+            if metrics:
+                self.logger.info("iter %d validation %s", it, metrics)
+            history.append(
+                {"iteration": it, "metrics": metrics, "coordinates": coord_logs}
+            )
+
+            if not metrics:
+                best_model, best_metrics = game_model, metrics
+            else:
+                primary = self.evaluators.primary
+                if best_model is None or primary.better_than(
+                    metrics[primary.name], best_metrics[primary.name]
+                ):
+                    best_model, best_metrics = game_model, metrics
+
+        assert best_model is not None
+        return DescentResult(
+            best_model=best_model,
+            last_model=game_model,
+            best_metrics=best_metrics,
+            history=history,
+        )

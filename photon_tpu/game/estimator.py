@@ -1,0 +1,172 @@
+"""GameEstimator: sweep over GAME optimization configurations.
+
+Rebuild of the reference's ``estimators.GameEstimator`` (SURVEY.md §2.2):
+``fit()`` runs CoordinateDescent once per :class:`GameOptimizationConfiguration`
+in the sweep (the reference's per-coordinate regularization-weight grid),
+evaluates each resulting model on validation data, and selects the best
+(model, configuration) pair by the primary evaluator — the reference's
+model-selection component.
+
+Warm start / partial retraining (SURVEY.md §5 'Checkpoint'): an
+``initial_model`` seeds every coordinate's first fit, and
+``locked_coordinates`` keep their initial model entirely (scored, never
+retrained).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from photon_tpu.core.normalization import NormalizationContext
+from photon_tpu.evaluation.evaluators import MultiEvaluator, default_evaluators_for_task
+from photon_tpu.game.coordinate import CoordinateConfig, build_coordinate
+from photon_tpu.game.data import GameDataset
+from photon_tpu.game.descent import CoordinateDescent, DescentResult
+from photon_tpu.game.model import GameModel
+from photon_tpu.utils.logging import PhotonLogger
+
+
+@dataclasses.dataclass(frozen=True)
+class GameOptimizationConfiguration:
+    """One point of the sweep: per-coordinate configs in update order +
+    number of outer coordinate-descent iterations (the reference's
+    GameOptimizationConfiguration + coordinateDescentIterations)."""
+
+    coordinates: Dict[str, CoordinateConfig]
+    descent_iterations: int = 1
+    name: str = ""
+
+    def __post_init__(self):
+        if not self.coordinates:
+            raise ValueError("configuration needs at least one coordinate")
+        if self.descent_iterations < 1:
+            raise ValueError("descent_iterations must be >= 1")
+
+
+@dataclasses.dataclass
+class GameResult:
+    """One fitted sweep entry: (model, evaluation, configuration) — the
+    reference's GameEstimator.fit return triple."""
+
+    model: GameModel
+    metrics: Dict[str, float]
+    configuration: GameOptimizationConfiguration
+    descent: DescentResult
+
+
+class GameEstimator:
+    """Builds coordinates per configuration and runs the descent sweep."""
+
+    def __init__(
+        self,
+        task_type: str,
+        training_data: GameDataset,
+        validation_data: Optional[GameDataset] = None,
+        evaluators: Optional[MultiEvaluator] = None,
+        mesh=None,
+        normalization: Optional[Dict[str, NormalizationContext]] = None,
+        logger: Optional[PhotonLogger] = None,
+    ):
+        """``normalization`` is keyed by feature-shard name and applies to
+        fixed-effect coordinates on that shard (the reference normalizes the
+        fixed-effect objective only)."""
+        self.task_type = task_type
+        self.training_data = training_data
+        self.validation_data = validation_data
+        if evaluators is None and validation_data is not None:
+            evaluators = MultiEvaluator(default_evaluators_for_task(task_type))
+        self.evaluators = evaluators
+        self.mesh = mesh
+        if isinstance(normalization, NormalizationContext):
+            raise TypeError(
+                "pass normalization as {shard_name: NormalizationContext}"
+            )
+        self.normalization = normalization or {}
+        self.logger = logger or PhotonLogger("photon_tpu.game")
+        # Device-resident data shared across sweep configurations: building
+        # the bucketed random-effect datasets (the reference's shuffle) and
+        # uploading feature blocks happens once per distinct data config.
+        self._device_data_cache: Dict[tuple, object] = {}
+
+    def _device_data(self, coord_config):
+        from photon_tpu.game.coordinate import (
+            FixedEffectCoordinateConfig,
+            FixedEffectDeviceData,
+            RandomEffectDeviceData,
+        )
+
+        key = coord_config.data_key
+        if key not in self._device_data_cache:
+            cls = (
+                FixedEffectDeviceData
+                if isinstance(coord_config, FixedEffectCoordinateConfig)
+                else RandomEffectDeviceData
+            )
+            self._device_data_cache[key] = cls(
+                self.training_data, coord_config, self.mesh
+            )
+        return self._device_data_cache[key]
+
+    def _build_coordinates(self, config: GameOptimizationConfiguration):
+        return {
+            name: build_coordinate(
+                self.training_data,
+                coord_config,
+                self.task_type,
+                mesh=self.mesh,
+                normalization=self.normalization.get(coord_config.shard_name),
+                device_data=self._device_data(coord_config),
+            )
+            for name, coord_config in config.coordinates.items()
+        }
+
+    def fit(
+        self,
+        configurations: Sequence[GameOptimizationConfiguration],
+        initial_model: Optional[GameModel] = None,
+        locked_coordinates: Sequence[str] = (),
+    ) -> List[GameResult]:
+        if not configurations:
+            raise ValueError("fit() needs at least one configuration")
+        results = []
+        for i, config in enumerate(configurations):
+            label = config.name or f"config-{i}"
+            with self.logger.timed(f"fit-{label}"):
+                descent = CoordinateDescent(
+                    self._build_coordinates(config),
+                    self.task_type,
+                    self.training_data,
+                    self.validation_data,
+                    self.evaluators,
+                    logger=self.logger,
+                ).run(
+                    config.descent_iterations,
+                    initial_model=initial_model,
+                    locked_coordinates=locked_coordinates,
+                )
+            results.append(
+                GameResult(
+                    model=descent.best_model,
+                    metrics=descent.best_metrics,
+                    configuration=config,
+                    descent=descent,
+                )
+            )
+        return results
+
+    def select_best(self, results: Sequence[GameResult]) -> GameResult:
+        """Best sweep entry by the primary evaluator; without validation the
+        first entry wins (reference behavior: selection needs a validation
+        set)."""
+        if self.evaluators is None or not any(r.metrics for r in results):
+            return results[0]
+        primary = self.evaluators.primary
+        best = results[0]
+        for r in results[1:]:
+            if r.metrics and primary.better_than(
+                r.metrics.get(primary.name, float("nan")),
+                best.metrics.get(primary.name, float("nan")),
+            ):
+                best = r
+        return best

@@ -1,0 +1,173 @@
+"""GAME models: fixed-effect, random-effect, and the composite GameModel.
+
+Rebuild of the reference's photon-api model layer (SURVEY.md §2.2 'GAME
+models'): ``FixedEffectModel`` (broadcast coefficients + feature-shard id),
+``RandomEffectModel`` (an ``RDD[(entityId, GeneralizedLinearModel)]``), and
+``GameModel`` (ordered per-coordinate container), plus the scoring join
+(``ModelDataScores`` accumulation — SURVEY.md §3.3).
+
+TPU-native shape: a random-effect model is a dense coefficient **table**
+``[num_entities, dim]`` resident in device memory — the per-entity model RDD
+collapses into one array, and the scoring-time shuffle-join becomes a gather
+by entity index.  Per-coordinate scores are raw margins (no offset, no link);
+the dataset offset is added once when combining, exactly like the
+reference's ``CoordinateDataScores -> ModelDataScores`` accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.core.losses import get_loss
+from photon_tpu.data.batch import DenseBatch, SparseBatch
+from photon_tpu.game.data import DenseShard, GameDataset, Shard, SparseShard
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel, model_for_task
+
+Array = jax.Array
+
+
+def shard_to_batch(
+    shard: Shard,
+    label: np.ndarray,
+    offset: Optional[np.ndarray] = None,
+    weight: Optional[np.ndarray] = None,
+):
+    """Device batch for one feature shard of a GameDataset."""
+    n = len(label)
+    label = jnp.asarray(label, jnp.float32)
+    offset = (
+        jnp.zeros(n, jnp.float32) if offset is None else jnp.asarray(offset, jnp.float32)
+    )
+    weight = (
+        jnp.ones(n, jnp.float32) if weight is None else jnp.asarray(weight, jnp.float32)
+    )
+    if isinstance(shard, DenseShard):
+        return DenseBatch(jnp.asarray(shard.x), label, offset, weight)
+    return SparseBatch(
+        jnp.asarray(shard.ids), jnp.asarray(shard.vals), label, offset, weight
+    )
+
+
+@partial(jax.jit, static_argnames=("dense",))
+def _fixed_margins(w: Array, feats, dense: bool) -> Array:
+    if dense:
+        return feats @ w
+    ids, vals = feats
+    return jnp.sum(jnp.take(w, ids, axis=0) * vals, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("dense",))
+def _random_margins(table: Array, entity_idx: Array, feats, dense: bool) -> Array:
+    """Margins via gather of per-row entity coefficients; unseen entities -> 0."""
+    safe = jnp.maximum(entity_idx, 0)
+    if dense:
+        m = jnp.einsum("nd,nd->n", feats, table[safe])
+    else:
+        ids, vals = feats
+        # table[entity, feature] gathered per nonzero: [n, k].
+        m = jnp.sum(table[safe[:, None], ids] * vals, axis=-1)
+    return jnp.where(entity_idx >= 0, m, 0.0)
+
+
+def _shard_feats(shard: Shard):
+    if isinstance(shard, DenseShard):
+        return jnp.asarray(shard.x), True
+    return (jnp.asarray(shard.ids), jnp.asarray(shard.vals)), False
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Global GLM on one feature shard (reference: FixedEffectModel)."""
+
+    model: GeneralizedLinearModel
+    shard_name: str
+
+    @property
+    def coefficients(self) -> Coefficients:
+        return self.model.coefficients
+
+    def score(self, data: GameDataset) -> np.ndarray:
+        """Raw margins ``w . x_i`` over the dataset's shard (no offset)."""
+        feats, dense = _shard_feats(data.shard(self.shard_name))
+        return np.asarray(_fixed_margins(self.coefficients.means, feats, dense))
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity coefficient table for one random-effect coordinate.
+
+    ``table[i]`` is entity ``keys[i]``'s coefficient vector; entities never
+    seen in training keep (implicit) zero coefficients and contribute zero
+    score — matching the reference's left-outer scoring join.
+    """
+
+    table: Array  # [num_entities, dim]
+    keys: np.ndarray  # sorted entity vocabulary
+    entity_column: str
+    shard_name: str
+    task_type: str
+    variances: Optional[Array] = None  # [num_entities, dim]
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.keys)
+
+    @property
+    def dim(self) -> int:
+        return self.table.shape[1]
+
+    def model_for_entity(self, key) -> Optional[GeneralizedLinearModel]:
+        """Single-entity view (the reference's per-entity GLM objects)."""
+        idx = np.searchsorted(self.keys, key)
+        if idx >= len(self.keys) or self.keys[idx] != key:
+            return None
+        variances = None if self.variances is None else self.variances[idx]
+        return model_for_task(self.task_type, Coefficients(self.table[idx], variances))
+
+    def score(self, data: GameDataset) -> np.ndarray:
+        from photon_tpu.game.data import entity_index_for
+
+        entity_idx = entity_index_for(data.id_columns[self.entity_column], self.keys)
+        feats, dense = _shard_feats(data.shard(self.shard_name))
+        return np.asarray(
+            _random_margins(self.table, jnp.asarray(entity_idx), feats, dense)
+        )
+
+
+CoordinateModel = "FixedEffectModel | RandomEffectModel"
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Ordered per-coordinate model container (reference: GameModel).
+
+    ``task_type`` fixes the link for prediction; coordinate order is the
+    score-accumulation order (it does not affect the sum).
+    """
+
+    coordinates: Dict[str, object]  # name -> FixedEffectModel | RandomEffectModel
+    task_type: str
+
+    def coordinate(self, name: str):
+        return self.coordinates[name]
+
+    def score(self, data: GameDataset) -> np.ndarray:
+        """Total raw score: dataset offset + sum of coordinate margins
+        (reference: ModelDataScores accumulation, SURVEY.md §3.3)."""
+        total = data.offset.astype(np.float64).copy()
+        for model in self.coordinates.values():
+            total += np.asarray(model.score(data), np.float64)
+        return total.astype(np.float32)
+
+    def predict(self, data: GameDataset) -> np.ndarray:
+        """Apply the task's mean/inverse-link to the total score (e.g.
+        sigmoid for logistic — SURVEY.md §3.3 'sigmoid for logistic')."""
+        # get_loss resolves task-type names directly (core/losses.TASK_TO_LOSS).
+        loss = get_loss(self.task_type)
+        return np.asarray(loss.mean(jnp.asarray(self.score(data))))

@@ -1,0 +1,341 @@
+"""GAME engine tests: bucketing, batched solves, coordinate descent.
+
+Mirrors the reference's integration-test strategy (SURVEY.md §4): the
+batched/vmapped random-effect solver is cross-checked against independent
+sequential per-entity solves (the distributed-vs-local trick), and full
+GameEstimator fits on tiny synthetic GAME data must converge with improving
+validation metrics.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
+from photon_tpu.data.batch import dense_batch
+from photon_tpu.data.synthetic import make_game_data
+from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
+from photon_tpu.game import (
+    CoordinateDescent,
+    DenseShard,
+    FixedEffectCoordinate,
+    FixedEffectCoordinateConfig,
+    GameDataset,
+    GameEstimator,
+    GameOptimizationConfiguration,
+    RandomEffectCoordinate,
+    RandomEffectCoordinateConfig,
+    build_random_effect_dataset,
+)
+from photon_tpu.parallel import create_mesh
+
+
+def _game_dataset(seed=0, n_entities=40, rows_mean=6, fixed_dim=5, random_dim=3):
+    raw = make_game_data(
+        n_entities=n_entities,
+        rows_per_entity_mean=rows_mean,
+        fixed_dim=fixed_dim,
+        random_dim=random_dim,
+        seed=seed,
+    )
+    return GameDataset.create(
+        label=raw["label"],
+        shards={
+            "global": DenseShard(raw["x_fixed"]),
+            "per_entity": DenseShard(raw["x_random"]["re0"]),
+        },
+        id_columns={"userId": raw["entity_ids"]["re0"]},
+        weight=raw["weight"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Random-effect dataset bucketing
+# ---------------------------------------------------------------------------
+
+
+def test_bucketing_partitions_all_rows_once():
+    data = _game_dataset()
+    ds = build_random_effect_dataset(data, "userId", "per_entity")
+    seen = []
+    for bucket in ds.buckets:
+        mask = bucket.row_weight > 0
+        assert bucket.row_capacity >= mask.sum(axis=1).max()
+        # power-of-two capacities
+        assert bucket.row_capacity & (bucket.row_capacity - 1) == 0
+        seen.append(bucket.row_index[mask])
+    seen = np.concatenate(seen)
+    assert sorted(seen.tolist()) == list(range(data.num_examples))
+    # every entity present exactly once across buckets
+    all_entities = np.concatenate([b.entity_index for b in ds.buckets])
+    assert sorted(all_entities.tolist()) == list(range(ds.num_entities))
+
+
+def test_bucketing_respects_active_row_cap_with_weight_correction():
+    data = _game_dataset(rows_mean=10)
+    cap = 4
+    ds = build_random_effect_dataset(data, "userId", "per_entity", active_row_cap=cap)
+    raw_counts = np.bincount(
+        ds.entity_idx_per_row[ds.entity_idx_per_row >= 0], minlength=ds.num_entities
+    )
+    for bucket in ds.buckets:
+        assert bucket.row_capacity <= cap
+        mask = bucket.row_weight > 0
+        for i, e in enumerate(bucket.entity_index):
+            rows_kept = int(mask[i].sum())
+            assert rows_kept == min(raw_counts[e], cap)
+            # weight mass is preserved in expectation: kept rows upweighted
+            expected_mass = data.weight[
+                ds.entity_idx_per_row == e
+            ].sum()
+            np.testing.assert_allclose(
+                bucket.row_weight[i].sum(), expected_mass, rtol=1e-5
+            )
+
+
+def test_entity_index_for_unseen_keys():
+    data = _game_dataset()
+    ds = build_random_effect_dataset(data, "userId", "per_entity")
+    idx = ds.entity_index_for(np.array([0, 10**9, 1]))
+    assert idx[0] >= 0 and idx[2] >= 0
+    assert idx[1] == -1
+
+
+# ---------------------------------------------------------------------------
+# Batched (vmapped) random-effect solves vs sequential per-entity solves
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("optimizer", ["lbfgs", "tron"])
+def test_vmapped_solves_match_sequential(optimizer):
+    data = _game_dataset(seed=3, n_entities=12, rows_mean=5)
+    config = RandomEffectCoordinateConfig(
+        shard_name="per_entity",
+        entity_column="userId",
+        problem=ProblemConfig(
+            optimizer=optimizer,
+            regularization=RegularizationContext("l2", 0.5),
+            optimizer_config=OptimizerConfig(max_iterations=50),
+        ),
+    )
+    coord = RandomEffectCoordinate(data, config, "logistic_regression")
+    offsets = np.zeros(data.num_examples, np.float32)
+    model, stats = coord.train(offsets)
+    assert stats["entities"] == coord.dataset.num_entities
+
+    # Sequential reference: solve each entity's rows independently.
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.5))
+    problem = GlmOptimizationProblem(obj, config.problem)
+    shard = data.shards["per_entity"]
+    for e in range(coord.dataset.num_entities):
+        rows = np.nonzero(coord.dataset.entity_idx_per_row == e)[0]
+        batch = dense_batch(
+            shard.x[rows], data.label[rows], weight=data.weight[rows]
+        )
+        coefficients, _ = problem.run(batch, jnp.zeros(shard.dim, jnp.float32))
+        np.testing.assert_allclose(
+            model.table[e], coefficients.means, rtol=5e-3, atol=5e-3
+        )
+
+
+def test_random_effect_scores_zero_for_unseen_entities():
+    train = _game_dataset(seed=1, n_entities=10)
+    config = RandomEffectCoordinateConfig(
+        shard_name="per_entity", entity_column="userId",
+        problem=ProblemConfig(
+            regularization=RegularizationContext("l2", 1.0),
+            optimizer_config=OptimizerConfig(max_iterations=20),
+        ),
+    )
+    coord = RandomEffectCoordinate(train, config, "logistic_regression")
+    model, _ = coord.train(np.zeros(train.num_examples, np.float32))
+    # Score a dataset containing unseen entity keys.
+    other = GameDataset.create(
+        label=train.label[:4],
+        shards={"per_entity": DenseShard(train.shards["per_entity"].x[:4])},
+        id_columns={"userId": np.array([10**6, 10**6 + 1, 0, 1], np.int64)},
+    )
+    scores = model.score(other)
+    assert scores[0] == 0.0 and scores[1] == 0.0
+    assert scores[2] != 0.0 or scores[3] != 0.0
+
+
+# ---------------------------------------------------------------------------
+# Coordinate descent / estimator
+# ---------------------------------------------------------------------------
+
+
+def _configs(descent_iterations=2, lam_fixed=0.01, lam_re=1.0):
+    return GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                shard_name="global",
+                problem=ProblemConfig(
+                    regularization=RegularizationContext("l2", lam_fixed),
+                    optimizer_config=OptimizerConfig(max_iterations=60),
+                ),
+            ),
+            "per-user": RandomEffectCoordinateConfig(
+                shard_name="per_entity",
+                entity_column="userId",
+                problem=ProblemConfig(
+                    regularization=RegularizationContext("l2", lam_re),
+                    optimizer_config=OptimizerConfig(max_iterations=30),
+                ),
+            ),
+        },
+        descent_iterations=descent_iterations,
+    )
+
+
+def _split_rows(data: GameDataset, frac=0.25, seed=0):
+    """Row-wise train/validation split of one GameDataset (same ground-truth
+    model on both sides — the valid way to test generalization here)."""
+    rng = np.random.default_rng(seed)
+    val_mask = rng.random(data.num_examples) < frac
+
+    def subset(mask):
+        rows = np.nonzero(mask)[0]
+        from photon_tpu.game.data import _gather_shard_rows
+
+        return GameDataset(
+            label=data.label[rows],
+            offset=data.offset[rows],
+            weight=data.weight[rows],
+            shards={k: _gather_shard_rows(s, rows) for k, s in data.shards.items()},
+            id_columns={k: v[rows] for k, v in data.id_columns.items()},
+        )
+
+    return subset(~val_mask), subset(val_mask)
+
+
+def test_game_estimator_beats_fixed_effect_alone():
+    full = _game_dataset(seed=7, n_entities=60, rows_mean=20)
+    train, val = _split_rows(full)
+    evaluators = MultiEvaluator([get_evaluator("auc"), get_evaluator("logistic_loss")])
+
+    estimator = GameEstimator(
+        "logistic_regression", train, val, evaluators=evaluators
+    )
+    game_results = estimator.fit([_configs()])
+    best = estimator.select_best(game_results)
+
+    # Fixed-effect-only baseline on the same data.
+    fixed_only = GameEstimator(
+        "logistic_regression", train, val, evaluators=evaluators
+    ).fit(
+        [
+            GameOptimizationConfiguration(
+                coordinates={
+                    "fixed": _configs().coordinates["fixed"],
+                },
+                descent_iterations=1,
+            )
+        ]
+    )[0]
+    assert best.metrics["AUC"] > fixed_only.metrics["AUC"]
+    assert best.metrics["LOGISTIC_LOSS"] < fixed_only.metrics["LOGISTIC_LOSS"]
+
+
+def test_game_model_score_is_offset_plus_coordinate_sum():
+    train = _game_dataset(seed=2, n_entities=20)
+    result = GameEstimator("logistic_regression", train).fit(
+        [_configs(descent_iterations=1)]
+    )[0]
+    model = result.model
+    total = model.score(train)
+    parts = sum(np.asarray(m.score(train)) for m in model.coordinates.values())
+    np.testing.assert_allclose(total, train.offset + parts, rtol=1e-5, atol=1e-5)
+
+
+def test_sweep_selects_best_configuration():
+    train, val = _split_rows(_game_dataset(seed=4, n_entities=40, rows_mean=16))
+    estimator = GameEstimator("logistic_regression", train, val)
+    results = estimator.fit(
+        [_configs(lam_re=1000.0), _configs(lam_re=1.0)]
+    )
+    best = estimator.select_best(results)
+    assert best is results[int(np.argmax([r.metrics["AUC"] for r in results]))]
+
+
+def test_warm_start_and_locked_coordinates():
+    train, val = _split_rows(_game_dataset(seed=9, n_entities=25, rows_mean=12))
+    estimator = GameEstimator("logistic_regression", train, val)
+    first = estimator.fit([_configs(descent_iterations=1)])[0]
+
+    # Retrain with the fixed effect locked: its coefficients must not move.
+    second = estimator.fit(
+        [_configs(descent_iterations=1)],
+        initial_model=first.model,
+        locked_coordinates=["fixed"],
+    )[0]
+    np.testing.assert_array_equal(
+        np.asarray(second.model.coordinate("fixed").coefficients.means),
+        np.asarray(first.model.coordinate("fixed").coefficients.means),
+    )
+    # The unlocked coordinate was retrained from the warm start.
+    assert "per-user" in second.model.coordinates
+
+
+def test_warm_start_aligns_entity_vocabularies_by_key():
+    """A warm-start model trained on a different entity set must be joined
+    by key, not by index (review finding: silent index misalignment)."""
+    train = _game_dataset(seed=13, n_entities=12)
+    config = RandomEffectCoordinateConfig(
+        shard_name="per_entity", entity_column="userId",
+        problem=ProblemConfig(
+            regularization=RegularizationContext("l2", 1.0),
+            optimizer_config=OptimizerConfig(max_iterations=5),
+        ),
+    )
+    coord = RandomEffectCoordinate(train, config, "logistic_regression")
+    model, _ = coord.train(np.zeros(train.num_examples, np.float32))
+    # Shift the model's keys so only some overlap with the dataset's vocab.
+    from photon_tpu.game.model import RandomEffectModel
+
+    shifted = RandomEffectModel(
+        table=model.table,
+        keys=model.keys + 6,  # keys 6..17 vs dataset keys 0..11
+        entity_column=model.entity_column,
+        shard_name=model.shard_name,
+        task_type=model.task_type,
+    )
+    init_table = np.asarray(coord._initial_table(shifted))
+    for e, key in enumerate(coord.dataset.keys):
+        src = np.searchsorted(shifted.keys, key)
+        if src < len(shifted.keys) and shifted.keys[src] == key:
+            np.testing.assert_array_equal(init_table[e], np.asarray(model.table)[src])
+        else:
+            np.testing.assert_array_equal(init_table[e], 0.0)
+
+
+def test_locked_coordinate_without_initial_model_raises():
+    train = _game_dataset(seed=11, n_entities=10)
+    estimator = GameEstimator("logistic_regression", train)
+    with pytest.raises(ValueError):
+        estimator.fit([_configs(descent_iterations=1)], locked_coordinates=["fixed"])
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded GAME training (8 virtual devices)
+# ---------------------------------------------------------------------------
+
+
+def test_game_training_on_mesh_matches_single_device():
+    train = _game_dataset(seed=12, n_entities=30, rows_mean=5)
+    config = _configs(descent_iterations=1)
+    single = GameEstimator("logistic_regression", train).fit([config])[0]
+    mesh = create_mesh()
+    sharded = GameEstimator("logistic_regression", train, mesh=mesh).fit([config])[0]
+    np.testing.assert_allclose(
+        np.asarray(single.model.coordinate("fixed").coefficients.means),
+        np.asarray(sharded.model.coordinate("fixed").coefficients.means),
+        rtol=1e-3, atol=1e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.model.coordinate("per-user").table),
+        np.asarray(sharded.model.coordinate("per-user").table),
+        rtol=1e-3, atol=1e-3,
+    )
