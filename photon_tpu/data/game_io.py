@@ -1,0 +1,243 @@
+"""GAME training-data IO: Avro records -> GameDataset (+ index maps).
+
+Rebuild of the reference's ``AvroDataReader`` / ``GameConverters`` path
+(photon-client .../data/avro, SURVEY.md §2.3 'Avro IO' and §3.1): training
+records carry a ``response``, optional ``offset``/``weight``/``uid``, one or
+more **feature bags** (arrays of name/term/value records), and entity-id
+columns (e.g. ``userId``) for random effects.  Reading indexes each bag's
+(name, term) keys through a per-shard :class:`IndexMap` and packs rows into
+the framework's padded-COO feature shards.
+
+TPU-native shape: the reference materializes an
+``RDD[(UniqueSampleId, GameDatum)]``; here the row order of the file(s) IS
+the unique-sample-id, and the output is one columnar :class:`GameDataset`
+ready for host-side entity bucketing (photon_tpu.game.data).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.data import avro_codec
+from photon_tpu.data.batch import pad_row_capacity
+from photon_tpu.data.index_map import INTERCEPT_KEY, IndexMap, feature_key
+from photon_tpu.game.data import GameDataset, SparseShard
+
+FEATURE_SCHEMA = {
+    "type": "record",
+    "name": "FeatureAvro",
+    "namespace": "photon_tpu.generated",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+
+def _id_field(col: str, bag_fields: Sequence[str]) -> str:
+    """Record field holding entity-id column ``col``; suffixed when the name
+    collides with a feature-bag field (synthetic data uses one name for
+    both the shard and its entity column)."""
+    return f"{col}__id" if col in bag_fields else col
+
+
+def training_example_schema(
+    feature_bags: Sequence[str], id_columns: Sequence[str]
+) -> dict:
+    """Schema for one training record; mirrors the reference's
+    TrainingExampleAvro shape (response/offset/weight/uid + feature bags),
+    with one array-of-FeatureAvro field per bag and one string field per
+    entity-id column."""
+    fields = [
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "uid", "type": ["null", "string"], "default": None},
+    ]
+    for i, bag in enumerate(feature_bags):
+        items = FEATURE_SCHEMA if i == 0 else "FeatureAvro"
+        fields.append({"name": bag, "type": {"type": "array", "items": items}})
+    for col in id_columns:
+        fields.append({"name": _id_field(col, feature_bags), "type": "string"})
+    return {
+        "type": "record",
+        "name": "TrainingExampleAvro",
+        "namespace": "photon_tpu.generated",
+        "fields": fields,
+    }
+
+
+def _input_files(path: str) -> list[str]:
+    """A file, a directory of part files, or a glob -> sorted file list."""
+    if os.path.isdir(path):
+        files = sorted(
+            p
+            for p in _glob.glob(os.path.join(path, "*"))
+            if os.path.isfile(p) and not os.path.basename(p).startswith((".", "_"))
+        )
+    elif os.path.isfile(path):
+        files = [path]
+    else:
+        files = sorted(_glob.glob(path))
+    if not files:
+        raise FileNotFoundError(f"no input files match {path!r}")
+    return files
+
+
+def write_game_avro(
+    path: str,
+    dataset: GameDataset,
+    index_maps: Dict[str, IndexMap],
+    feature_bags: Optional[Dict[str, str]] = None,
+) -> None:
+    """Write a GameDataset as TrainingExampleAvro records (test fixtures and
+    interop round-trips; the reference ships such files under
+    photon-client/src/integTest/resources — SURVEY.md §4).
+
+    ``feature_bags`` maps shard name -> record field name (default: the
+    shard name itself).
+    """
+    feature_bags = feature_bags or {name: name for name in dataset.shards}
+    id_cols = sorted(dataset.id_columns)
+    bag_fields = [feature_bags[s] for s in sorted(feature_bags)]
+    schema = training_example_schema(bag_fields, id_cols)
+
+    def row_nonzeros(shard, i: int):
+        """Per-row (feature id, value) pairs, zeros skipped."""
+        if isinstance(shard, SparseShard):
+            pairs = zip(shard.ids[i], shard.vals[i])
+        else:
+            row = shard.x[i]
+            pairs = zip(np.nonzero(row)[0], row[np.nonzero(row)[0]])
+        return [(int(f), float(v)) for f, v in pairs if float(v) != 0.0]
+
+    shard_rows = {
+        field: (dataset.shard(shard_name), index_maps[shard_name])
+        for shard_name, field in feature_bags.items()
+    }
+
+    records = []
+    for i in range(dataset.num_examples):
+        rec = {
+            "response": float(dataset.label[i]),
+            "offset": float(dataset.offset[i]),
+            "weight": float(dataset.weight[i]),
+            "uid": str(i),
+        }
+        for field, (shard, imap) in shard_rows.items():
+            bag = []
+            for fid, val in row_nonzeros(shard, i):
+                key = imap.get_key(fid)
+                if key == INTERCEPT_KEY:
+                    continue  # readers re-add the intercept per row
+                name, _, term = key.partition("\x01")
+                bag.append({"name": name, "term": term, "value": val})
+            rec[field] = bag
+        for col in id_cols:
+            rec[_id_field(col, bag_fields)] = str(dataset.id_columns[col][i])
+        records.append(rec)
+    avro_codec.write_container(path, schema, records)
+
+
+def read_game_avro(
+    path: str,
+    feature_bags: Dict[str, str],
+    id_columns: Sequence[str],
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+    intercept: bool = True,
+) -> tuple[GameDataset, Dict[str, IndexMap]]:
+    """Read TrainingExampleAvro file(s) into a GameDataset.
+
+    ``feature_bags`` maps shard name -> record field holding that shard's
+    feature array.  When ``index_maps`` is None, maps are built from the data
+    in first-seen order (the FeatureIndexingJob path collapsed into the read,
+    valid single-host); passing training-time maps reproduces the reference's
+    fixed-index scoring path — features absent from a map are DROPPED, and
+    when an intercept is present every example keeps it.
+    """
+    files = _input_files(path)
+    records: list[dict] = []
+    for f in files:
+        _, recs = avro_codec.read_container(f)
+        records.extend(recs)
+    if not records:
+        raise ValueError(f"no records in {path!r}")
+
+    n = len(records)
+    label = np.empty(n, np.float32)
+    offset = np.zeros(n, np.float32)
+    weight = np.ones(n, np.float32)
+    ids_cols: Dict[str, list] = {c: [] for c in id_columns}
+    build_maps = index_maps is None
+    if build_maps:
+        index_maps = {}
+        key_order: Dict[str, dict] = {s: {} for s in feature_bags}
+
+    # Pass 1: labels/ids + (optionally) discover feature vocabularies.
+    for i, rec in enumerate(records):
+        label[i] = rec["response"]
+        if rec.get("offset") is not None:
+            offset[i] = rec["offset"]
+        if rec.get("weight") is not None:
+            weight[i] = rec["weight"]
+        for col in id_columns:
+            field = f"{col}__id" if f"{col}__id" in rec else col
+            if field not in rec:
+                raise KeyError(f"record {i} missing id column {col!r}")
+            ids_cols[col].append(rec[field])
+        if build_maps:
+            for shard_name, field in feature_bags.items():
+                seen = key_order[shard_name]
+                for ntv in rec.get(field, ()):
+                    key = feature_key(ntv["name"], ntv["term"])
+                    if key != INTERCEPT_KEY:  # the intercept is implicit
+                        seen.setdefault(key, None)
+    if build_maps:
+        for shard_name in feature_bags:
+            index_maps[shard_name] = IndexMap.build(
+                list(key_order[shard_name]), intercept=intercept
+            )
+
+    # Pass 2: index features into padded-COO shards.
+    shards: Dict[str, SparseShard] = {}
+    for shard_name, field in feature_bags.items():
+        imap = index_maps[shard_name]
+        rows_ids, rows_vals, nnz = [], [], np.zeros(n, np.int64)
+        for i, rec in enumerate(records):
+            r_ids, r_vals = [], []
+            for ntv in rec.get(field, ()):
+                key = feature_key(ntv["name"], ntv["term"])
+                if key == INTERCEPT_KEY:
+                    continue  # implicit: appended once below
+                fid = imap.get_id(key)
+                if fid >= 0:
+                    r_ids.append(fid)
+                    r_vals.append(ntv["value"])
+            if imap.intercept_id is not None:
+                r_ids.append(imap.intercept_id)
+                r_vals.append(1.0)
+            rows_ids.append(r_ids)
+            rows_vals.append(r_vals)
+            nnz[i] = len(r_ids)
+        k = pad_row_capacity(nnz)
+        ids = np.zeros((n, k), np.int32)
+        vals = np.zeros((n, k), np.float32)
+        for i in range(n):
+            m = int(nnz[i])
+            ids[i, :m] = rows_ids[i]
+            vals[i, :m] = rows_vals[i]
+        shards[shard_name] = SparseShard(ids, vals, len(imap))
+
+    dataset = GameDataset(
+        label=label,
+        offset=offset,
+        weight=weight,
+        shards=shards,
+        id_columns={c: np.asarray(v) for c, v in ids_cols.items()},
+    )
+    return dataset, index_maps

@@ -101,6 +101,44 @@ def make_game_data(
     }
 
 
+def make_game_dataset(
+    n_entities: int,
+    rows_per_entity_mean: int,
+    fixed_dim: int,
+    random_dim: int,
+    seed: int = 0,
+    n_random_coords: int = 1,
+):
+    """GAME data as a ready-to-train ``GameDataset`` + per-shard index maps.
+
+    Shards: ``"global"`` (fixed effect) and ``"re0"``/``"re1"``… (one per
+    random coordinate, with a same-named entity-id column).  The last column
+    of every shard is the intercept, matching each shard's index map.
+    """
+    from photon_tpu.data.index_map import IndexMap, feature_key
+    from photon_tpu.game.data import DenseShard, GameDataset
+
+    raw = make_game_data(
+        n_entities, rows_per_entity_mean, fixed_dim, random_dim,
+        seed=seed, n_random_coords=n_random_coords,
+    )
+
+    def imap_for(dim: int) -> IndexMap:
+        return IndexMap.build(
+            [feature_key(f"f{i}") for i in range(dim - 1)], intercept=True
+        )
+
+    shards = {"global": DenseShard(raw["x_fixed"])}
+    index_maps = {"global": imap_for(fixed_dim)}
+    id_columns = {}
+    for name, ids in raw["entity_ids"].items():
+        shards[name] = DenseShard(raw["x_random"][name])
+        index_maps[name] = imap_for(random_dim)
+        id_columns[name] = ids
+    data = GameDataset.create(raw["label"], shards, id_columns=id_columns)
+    return data, index_maps
+
+
 def write_libsvm(path: str, batch_x: np.ndarray, labels: np.ndarray) -> None:
     """Write a dense matrix as LIBSVM text (1-based ids, skipping zeros)."""
     with open(path, "w") as f:

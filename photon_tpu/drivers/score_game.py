@@ -1,0 +1,102 @@
+"""GAME scoring driver (the reference's ``GameScoringDriver``).
+
+SURVEY.md §3.3: load a saved GAME model directory → read + index scoring
+data with the model's per-shard feature maps → per-coordinate score
+accumulation (fixed: broadcast coefficients; random: gather by entity index,
+the TPU shape of the reference's shuffle-join) → write scores (+ optional
+metrics).
+
+    python -m photon_tpu.drivers.score_game \\
+        --input test.avro --model out/best_model \\
+        --feature-bags global=features,per_user=userFeatures \\
+        --id-columns userId \\
+        --evaluators AUC,SHARDED_AUC:userId --output-dir scored
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from photon_tpu.drivers import common
+from photon_tpu.drivers.train_game import _load_game_data
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon_tpu.drivers.score_game", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    common.add_common_args(p)
+    p.add_argument("--input", required=True,
+                   help="scoring data: Avro file/dir/glob or synthetic-game "
+                   "spec (see train_game)")
+    p.add_argument("--model", required=True, help="GAME model directory")
+    p.add_argument("--feature-bags", default=None)
+    p.add_argument("--id-columns", default=None)
+    p.add_argument("--evaluators", default=None)
+    p.add_argument("--predict-mean", action="store_true",
+                   help="write mean predictions (inverse link) instead of "
+                   "raw scores")
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    common.select_backend(args.backend)
+    from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
+    from photon_tpu.game.model_io import load_game_model
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.score_game", args.log_file)
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    with logger.timed("load-model"):
+        model, index_maps = load_game_model(args.model)
+        logger.info(
+            "model: %s, coordinates %s", model.task_type,
+            list(model.coordinates),
+        )
+
+    with logger.timed("load-data"):
+        # Index scoring features through the model's training-time maps —
+        # unseen features drop, matching the reference's fixed-index scoring.
+        data, _ = _load_game_data(args.input, args, index_maps=index_maps)
+        logger.info("scoring %d examples", data.num_examples)
+
+    with logger.timed("score"):
+        raw_scores = model.score(data)
+        if args.predict_mean:
+            import jax.numpy as jnp
+
+            from photon_tpu.core.losses import get_loss
+
+            out_scores = np.asarray(
+                get_loss(model.task_type).mean(jnp.asarray(raw_scores))
+            )
+        else:
+            out_scores = raw_scores
+    np.savetxt(os.path.join(args.output_dir, "scores.txt"), out_scores, fmt="%.8g")
+
+    metrics = {}
+    if args.evaluators:
+        evaluators = MultiEvaluator(
+            [get_evaluator(n) for n in args.evaluators.split(",")]
+        )
+        metrics = evaluators.evaluate(
+            raw_scores, data.label, data.weight, dict(data.id_columns)
+        )
+        logger.info("metrics %s", metrics)
+        with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
+            json.dump(metrics, f, indent=1)
+    return {"num_scored": int(data.num_examples), "metrics": metrics}
+
+
+def main(argv=None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,329 @@
+"""GAME training driver (the reference's ``GameTrainingDriver``).
+
+End-to-end (SURVEY.md §3.1): read Avro training data (feature bags +
+entity-id columns) → build per-coordinate GAME datasets → GameEstimator.fit
+over the per-coordinate regularization sweep → evaluate → save the best GAME
+model directory (per-coordinate name/term-keyed Avro coefficients).
+
+Coordinate configs are ``name:key=value,...`` specs (or ``@file.json``):
+
+    python -m photon_tpu.drivers.train_game \\
+        --input train.avro --task logistic_regression \\
+        --feature-bags global=features,per_user=userFeatures \\
+        --id-columns userId \\
+        --coordinate global:type=fixed,shard=global,optimizer=lbfgs,reg_weights=0.1+1 \\
+        --coordinate per_user:type=random,shard=per_user,entity=userId,reg_weights=1 \\
+        --descent-iterations 2 --validation-split 0.2 --output-dir out
+
+Spec keys: ``type`` (fixed|random), ``shard``, ``entity`` (random only),
+``optimizer`` (lbfgs|owlqn|tron), ``reg_type``, ``reg_weights`` (``+``-joined
+sweep list), ``alpha`` (elastic net), ``max_iters``, ``tolerance``,
+``variance`` (none|simple), ``active_row_cap`` (random), ``downsample``
+(fixed), ``seed``.  The sweep is the cross product of every coordinate's
+``reg_weights`` list (the reference's GameOptimizationConfiguration grid).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+
+from photon_tpu.drivers import common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon_tpu.drivers.train_game", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    common.add_common_args(p)
+    p.add_argument("--input", required=True,
+                   help="training data: Avro file/dir/glob, or "
+                   "synthetic-game:<entities>:<rows_mean>:<fixed_dim>:"
+                   "<random_dim>[:n_random[:seed]]")
+    p.add_argument("--validation-input", default=None,
+                   help="validation data (same format as --input)")
+    p.add_argument("--validation-split", type=float, default=None,
+                   help="fraction of --input rows held out for validation "
+                   "(alternative to --validation-input)")
+    p.add_argument("--feature-bags", default=None,
+                   help="shard=recordField pairs, comma separated "
+                   "(Avro input only)")
+    p.add_argument("--id-columns", default=None,
+                   help="entity id columns to read, comma separated "
+                   "(Avro input only)")
+    p.add_argument("--task", default="logistic_regression",
+                   choices=("logistic_regression", "linear_regression",
+                            "poisson_regression", "smoothed_hinge_loss_linear_svm"))
+    p.add_argument("--coordinate", action="append", required=True,
+                   dest="coordinates", metavar="NAME:K=V,...",
+                   help="one per coordinate, in update order; or a single "
+                   "@configs.json")
+    p.add_argument("--descent-iterations", type=int, default=1)
+    p.add_argument("--evaluators", default=None,
+                   help="comma-separated; sharded variants take the id "
+                   "column, e.g. SHARDED_AUC:userId")
+    p.add_argument("--initial-model", default=None,
+                   help="GAME model directory for warm start")
+    p.add_argument("--locked-coordinates", default=None,
+                   help="comma-separated coordinates to freeze at the "
+                   "initial model (partial retraining)")
+    p.add_argument("--model-format", default="avro", choices=("avro", "json"))
+    p.add_argument("--save-all-models", action="store_true")
+    p.add_argument("--checkpoint", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="write each sweep entry's model as it finishes "
+                   "(resume via --initial-model)")
+    return p
+
+
+_KNOWN_COORDINATE_KEYS = {
+    "type", "shard", "entity", "optimizer", "reg_type", "reg_weights",
+    "alpha", "max_iters", "tolerance", "variance", "active_row_cap",
+    "downsample", "seed",
+}
+
+
+def _validate_coordinate(name: str, kv: dict, origin: str) -> tuple[str, dict]:
+    unknown = set(kv) - _KNOWN_COORDINATE_KEYS
+    if unknown:
+        raise ValueError(f"unknown coordinate key(s) {sorted(unknown)} in {origin}")
+    if kv.get("type", "fixed") not in ("fixed", "random"):
+        raise ValueError(f"coordinate type must be fixed|random in {origin}")
+    if "shard" not in kv:
+        raise ValueError(f"coordinate {name!r} needs shard=<feature shard>")
+    if kv.get("type") == "random" and "entity" not in kv:
+        raise ValueError(f"random coordinate {name!r} needs entity=<id column>")
+    return name, kv
+
+
+def parse_coordinate_spec(spec: str):
+    """``name:key=value,...`` -> (name, dict).  Raises on unknown keys."""
+    name, _, body = spec.partition(":")
+    if not name or not body:
+        raise ValueError(f"bad coordinate spec {spec!r} (want name:key=value,...)")
+    kv = {}
+    for tok in body.split(","):
+        k, _, v = tok.partition("=")
+        kv[k.strip()] = v.strip()
+    return _validate_coordinate(name, kv, repr(spec))
+
+
+def _coordinate_specs(args) -> list[tuple[str, dict]]:
+    if len(args.coordinates) == 1 and args.coordinates[0].startswith("@"):
+        path = args.coordinates[0][1:]
+        with open(path) as f:
+            payload = json.load(f)
+        return [
+            _validate_coordinate(c.pop("name"), c, f"{path} entry {i}")
+            for i, c in enumerate(payload)
+        ]
+    return [parse_coordinate_spec(s) for s in args.coordinates]
+
+
+def _build_sweep(specs):
+    """Cross product of per-coordinate reg weights -> configuration list."""
+    from photon_tpu.core.objective import RegularizationContext
+    from photon_tpu.core.optimizers import OptimizerConfig
+    from photon_tpu.core.problem import ProblemConfig
+    from photon_tpu.game.coordinate import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.game.estimator import GameOptimizationConfiguration
+
+    weight_lists = []
+    for _, kv in specs:
+        weights = [float(w) for w in str(kv.get("reg_weights", "1.0")).split("+")]
+        weight_lists.append(weights)
+
+    def coord_config(kv: dict, lam: float):
+        reg_type = kv.get("reg_type", "l2")
+        optimizer = kv.get("optimizer", "lbfgs")
+        if reg_type in ("l1", "elastic_net"):
+            optimizer = "owlqn"
+        problem = ProblemConfig(
+            optimizer=optimizer,
+            regularization=RegularizationContext(
+                reg_type, lam, float(kv.get("alpha", 0.5))
+            ),
+            optimizer_config=OptimizerConfig(
+                max_iterations=int(kv.get("max_iters", 50)),
+                tolerance=float(kv.get("tolerance", 1e-7)),
+            ),
+            variance_computation=kv.get("variance", "none"),
+        )
+        if kv.get("type", "fixed") == "fixed":
+            return FixedEffectCoordinateConfig(
+                shard_name=kv["shard"],
+                problem=problem,
+                downsampling_rate=float(kv.get("downsample", 1.0)),
+                seed=int(kv.get("seed", 0)),
+            )
+        cap = kv.get("active_row_cap")
+        return RandomEffectCoordinateConfig(
+            shard_name=kv["shard"],
+            entity_column=kv["entity"],
+            problem=problem,
+            active_row_cap=None if cap in (None, "") else int(cap),
+            seed=int(kv.get("seed", 0)),
+        )
+
+    configurations = []
+    for combo in itertools.product(*weight_lists):
+        coords = {
+            name: coord_config(kv, lam)
+            for (name, kv), lam in zip(specs, combo)
+        }
+        label = ",".join(
+            f"{name}={lam:g}" for (name, _), lam in zip(specs, combo)
+        )
+        configurations.append((label, coords, combo))
+    return configurations
+
+
+def _load_game_data(spec: str, args, index_maps=None):
+    """(dataset, index_maps) from an input spec (Avro or synthetic-game)."""
+    if spec.startswith("synthetic-game:"):
+        from photon_tpu.data.synthetic import make_game_dataset
+
+        parts = spec.split(":")
+        n_e, rows, fdim, rdim = (int(x) for x in parts[1:5])
+        n_random = int(parts[5]) if len(parts) > 5 else 1
+        seed = int(parts[6]) if len(parts) > 6 else 0
+        data, maps = make_game_dataset(
+            n_e, rows, fdim, rdim, seed=seed, n_random_coords=n_random
+        )
+        return data, (index_maps or maps)
+    from photon_tpu.data.game_io import read_game_avro
+
+    if not args.feature_bags or not args.id_columns:
+        raise ValueError(
+            "Avro input needs --feature-bags and --id-columns "
+            "(shard=field pairs and entity id fields)"
+        )
+    bags = dict(tok.split("=", 1) for tok in args.feature_bags.split(","))
+    id_cols = [c.strip() for c in args.id_columns.split(",") if c.strip()]
+    return read_game_avro(spec, bags, id_cols, index_maps=index_maps)
+
+
+def run(args: argparse.Namespace) -> dict:
+    common.select_backend(args.backend)
+    from photon_tpu.evaluation.evaluators import (
+        MultiEvaluator,
+        default_evaluators_for_task,
+        get_evaluator,
+    )
+    from photon_tpu.game.data import split_game_dataset
+    from photon_tpu.game.estimator import GameEstimator, GameOptimizationConfiguration
+    from photon_tpu.game.model_io import load_game_model, save_game_model
+    from photon_tpu.utils import PhotonLogger
+    from photon_tpu.utils.logging import maybe_profile
+
+    logger = PhotonLogger("photon_tpu.train_game", args.log_file)
+    os.makedirs(args.output_dir, exist_ok=True)
+    specs = _coordinate_specs(args)
+
+    with logger.timed("load-data"):
+        data, index_maps = _load_game_data(args.input, args)
+        val_data = None
+        if args.validation_input:
+            val_data, _ = _load_game_data(
+                args.validation_input, args, index_maps=index_maps
+            )
+        elif args.validation_split:
+            data, val_data = split_game_dataset(data, args.validation_split)
+        logger.info(
+            "train: %d examples, shards %s", data.num_examples,
+            {n: s.dim for n, s in data.shards.items()},
+        )
+
+    if args.evaluators:
+        evaluators = MultiEvaluator(
+            [get_evaluator(n) for n in args.evaluators.split(",")]
+        )
+    else:
+        evaluators = MultiEvaluator(default_evaluators_for_task(args.task))
+
+    initial_model = None
+    if args.initial_model:
+        initial_model, _ = load_game_model(args.initial_model)
+    locked = (
+        [c.strip() for c in args.locked_coordinates.split(",") if c.strip()]
+        if args.locked_coordinates else []
+    )
+
+    mesh = common.maybe_mesh()
+    estimator = GameEstimator(
+        args.task,
+        data,
+        validation_data=val_data,
+        evaluators=evaluators if val_data is not None else None,
+        mesh=mesh,
+        logger=logger,
+    )
+
+    sweep = _build_sweep(specs)
+    configurations = [
+        GameOptimizationConfiguration(
+            coordinates=coords,
+            descent_iterations=args.descent_iterations,
+            name=label,
+        )
+        for label, coords, _ in sweep
+    ]
+
+    with maybe_profile(args.profile_dir):
+        results = []
+        for config in configurations:
+            result = estimator.fit(
+                [config], initial_model=initial_model,
+                locked_coordinates=locked,
+            )[0]
+            results.append(result)
+            if args.checkpoint or args.save_all_models:
+                save_game_model(
+                    os.path.join(args.output_dir, f"model_{config.name}"),
+                    result.model, index_maps, fmt=args.model_format,
+                )
+    best = estimator.select_best(results)
+
+    with logger.timed("save-model"):
+        save_game_model(
+            os.path.join(args.output_dir, "best_model"),
+            best.model, index_maps, fmt=args.model_format,
+        )
+    summary = {
+        "task": args.task,
+        "best_configuration": best.configuration.name,
+        "best_metrics": best.metrics,
+        "sweep": [
+            {
+                "configuration": r.configuration.name,
+                "metrics": r.metrics,
+                "history": [
+                    {"iteration": h["iteration"], "metrics": h["metrics"]}
+                    for h in r.descent.history
+                ],
+            }
+            for r in results
+        ],
+        "phase_times": logger.phase_times,
+    }
+    with open(os.path.join(args.output_dir, "training_summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    logger.info(
+        "best configuration %s -> %s/best_model",
+        best.configuration.name, args.output_dir,
+    )
+    return summary
+
+
+def main(argv=None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,35 @@ class GameDataset:
         )
 
 
+def take_rows(data: GameDataset, rows: np.ndarray) -> GameDataset:
+    """Row-subset view of a GameDataset (train/validation splits)."""
+    return GameDataset(
+        label=data.label[rows],
+        offset=data.offset[rows],
+        weight=data.weight[rows],
+        shards={n: _gather_shard_rows(s, rows) for n, s in data.shards.items()},
+        id_columns={n: c[rows] for n, c in data.id_columns.items()},
+    )
+
+
+def split_game_dataset(
+    data: GameDataset, validation_fraction: float, seed: int = 0
+) -> tuple[GameDataset, GameDataset]:
+    """Random train/validation row split (the reference takes a separate
+    validation path; a fraction split covers single-file workflows)."""
+    if not 0.0 < validation_fraction < 1.0:
+        raise ValueError("validation_fraction must be in (0, 1)")
+    n = data.num_examples
+    if n < 2:
+        raise ValueError("cannot split a dataset with fewer than 2 rows")
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_val = min(n - 1, max(1, int(round(n * validation_fraction))))
+    val_rows = np.sort(perm[:n_val])
+    train_rows = np.sort(perm[n_val:])
+    return take_rows(data, train_rows), take_rows(data, val_rows)
+
+
 @dataclasses.dataclass(frozen=True)
 class EntityBucket:
     """One row-capacity cohort of a random-effect dataset.
@@ -175,8 +204,28 @@ class RandomEffectDataset:
 
 
 def entity_index_for(raw_keys: np.ndarray, vocab_keys: np.ndarray) -> np.ndarray:
-    """Vectorized key→index lookup against a sorted vocabulary; -1 = missing."""
+    """Vectorized key→index lookup against a sorted vocabulary; -1 = missing.
+
+    Raw keys are coerced to the vocabulary's dtype kind first: Avro id
+    columns arrive as strings while a saved model's entity keys may have been
+    restored as integers (game.model_io), and comparing across kinds would
+    silently match nothing.
+    """
     raw = np.asarray(raw_keys)
+    if len(vocab_keys) and len(raw) and raw.dtype.kind != vocab_keys.dtype.kind:
+        if vocab_keys.dtype.kind in "iu" and raw.dtype.kind in "US":
+            try:
+                raw = raw.astype(np.int64)
+            except ValueError as e:
+                raise ValueError(
+                    "entity id column holds non-numeric strings but the "
+                    "vocabulary is integer-typed"
+                ) from e
+        else:
+            # astype(str) keeps each value's natural width; casting to the
+            # vocabulary's fixed-width dtype would truncate longer keys into
+            # false matches.
+            raw = raw.astype(str)
     pos = np.searchsorted(vocab_keys, raw)
     pos = np.clip(pos, 0, len(vocab_keys) - 1)
     found = vocab_keys[pos] == raw if len(vocab_keys) else np.zeros(len(raw), bool)

@@ -1,0 +1,222 @@
+"""GAME model persistence: per-coordinate name/term-keyed Avro export.
+
+Rebuild of the reference's ``ModelProcessingUtils.saveGameModelToHDFS`` /
+``loadGameModelFromHDFS`` (photon-client .../data/avro — SURVEY.md §5
+'Checkpoint / resume'): a GAME model is a directory with one subdirectory per
+coordinate — ``fixed-effect/<name>/`` holding a single coefficient record,
+``random-effect/<name>/`` holding one record **per entity** (the reference's
+``RDD[(entityId, model)]`` written as BayesianLinearModelAvro keyed by
+modelId).  Coefficients are keyed by (name, term) feature strings so models
+survive feature-index rebuilds; each coordinate directory carries its own
+feature index map.
+
+Layout:
+    <dir>/metadata.json                        task type, coordinate order
+    <dir>/fixed-effect/<coord>/coefficients.avro
+    <dir>/fixed-effect/<coord>/feature_index.json
+    <dir>/random-effect/<coord>/coefficients.avro   (one record per entity)
+    <dir>/random-effect/<coord>/feature_index.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data import avro_codec
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.data.model_io import (
+    GLM_MODEL_SCHEMA,
+    NAME_TERM_VALUE_SCHEMA,
+    _ntv_list,
+    load_glm_model,
+    save_glm_model,
+)
+from photon_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_tpu.models.glm import model_for_task
+
+RANDOM_EFFECT_SCHEMA = {
+    "type": "record",
+    "name": "RandomEffectModelAvro",
+    "namespace": "photon_tpu.generated",
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "means", "type": {"type": "array", "items": NAME_TERM_VALUE_SCHEMA}},
+        {
+            "name": "variances",
+            "type": ["null", {"type": "array", "items": "NameTermValueAvro"}],
+            "default": None,
+        },
+    ],
+}
+
+
+def save_game_model(
+    dir_path: str,
+    model: GameModel,
+    index_maps: Dict[str, IndexMap],
+    fmt: str = "avro",
+) -> None:
+    """``index_maps`` is keyed by feature-shard name (each coordinate stores
+    the map for its shard)."""
+    os.makedirs(dir_path, exist_ok=True)
+    meta = {"version": 1, "task_type": model.task_type, "coordinates": []}
+    ext = "avro" if fmt == "avro" else "json"
+    for name, coord in model.coordinates.items():
+        if isinstance(coord, FixedEffectModel):
+            coord_dir = os.path.join(dir_path, "fixed-effect", name)
+            os.makedirs(coord_dir, exist_ok=True)
+            imap = index_maps[coord.shard_name]
+            save_glm_model(
+                os.path.join(coord_dir, f"coefficients.{ext}"),
+                coord.model,
+                imap,
+                fmt=fmt,
+            )
+            imap.save(os.path.join(coord_dir, "feature_index.json"))
+            meta["coordinates"].append(
+                {"name": name, "type": "fixed", "shard_name": coord.shard_name}
+            )
+        elif isinstance(coord, RandomEffectModel):
+            coord_dir = os.path.join(dir_path, "random-effect", name)
+            os.makedirs(coord_dir, exist_ok=True)
+            imap = index_maps[coord.shard_name]
+            _save_random_effect(coord_dir, coord, imap, ext)
+            imap.save(os.path.join(coord_dir, "feature_index.json"))
+            meta["coordinates"].append(
+                {
+                    "name": name,
+                    "type": "random",
+                    "shard_name": coord.shard_name,
+                    "entity_column": coord.entity_column,
+                }
+            )
+        else:
+            raise TypeError(f"unknown coordinate model type {type(coord)!r}")
+    with open(os.path.join(dir_path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def _save_random_effect(
+    coord_dir: str, coord: RandomEffectModel, imap: IndexMap, ext: str
+) -> None:
+    table = np.asarray(coord.table)
+    variances = None if coord.variances is None else np.asarray(coord.variances)
+    records = []
+    for i, key in enumerate(coord.keys):
+        records.append(
+            {
+                "modelId": str(key),
+                "means": _ntv_list(table[i], imap),
+                "variances": None if variances is None else _ntv_list(variances[i], imap),
+            }
+        )
+    path = os.path.join(coord_dir, f"coefficients.{ext}")
+    if ext == "avro":
+        avro_codec.write_container(path, RANDOM_EFFECT_SCHEMA, records)
+    else:
+        with open(path, "w") as f:
+            json.dump(records, f, indent=1)
+
+
+def _coeff_file(coord_dir: str) -> tuple[str, str]:
+    for ext in ("avro", "json"):
+        p = os.path.join(coord_dir, f"coefficients.{ext}")
+        if os.path.exists(p):
+            return p, ext
+    raise FileNotFoundError(f"no coefficients file under {coord_dir}")
+
+
+def _load_random_effect(
+    coord_dir: str,
+    meta: dict,
+    imap: IndexMap,
+    task_type: str,
+    keys_dtype=None,
+) -> RandomEffectModel:
+    path, ext = _coeff_file(coord_dir)
+    if ext == "avro":
+        _, records = avro_codec.read_container(path)
+    else:
+        with open(path) as f:
+            records = json.load(f)
+
+    def to_vec(ntvs) -> np.ndarray:
+        vec = np.zeros(len(imap), np.float32)
+        for ntv in ntvs:
+            from photon_tpu.data.index_map import feature_key
+
+            idx = imap.get_id(feature_key(ntv["name"], ntv["term"]))
+            if idx >= 0:
+                vec[idx] = ntv["value"]
+        return vec
+
+    raw_keys = [r["modelId"] for r in records]
+    # Entity keys were stringified on save; restore a numeric dtype when every
+    # key parses (so vocab joins against int id columns keep working) AND the
+    # parse is injective — '01' and '1' must stay distinct strings.
+    try:
+        ints = [int(k) for k in raw_keys]
+        parsed = (
+            np.asarray(ints)
+            if len(set(ints)) == len(ints)
+            else np.asarray(raw_keys)
+        )
+    except ValueError:
+        parsed = np.asarray(raw_keys)
+    order = np.argsort(parsed, kind="stable")
+    keys = parsed[order]
+    has_var = any(r.get("variances") is not None for r in records)
+    table = np.zeros((len(records), len(imap)), np.float32)
+    variances = np.zeros_like(table) if has_var else None
+    for out_i, rec_i in enumerate(order):
+        rec = records[rec_i]
+        table[out_i] = to_vec(rec["means"])
+        if has_var and rec.get("variances") is not None:
+            variances[out_i] = to_vec(rec["variances"])
+    return RandomEffectModel(
+        table=jnp.asarray(table),
+        keys=keys,
+        entity_column=meta["entity_column"],
+        shard_name=meta["shard_name"],
+        task_type=task_type,
+        variances=None if variances is None else jnp.asarray(variances),
+    )
+
+
+def load_game_model(
+    dir_path: str, index_maps: Optional[Dict[str, IndexMap]] = None
+) -> tuple[GameModel, Dict[str, IndexMap]]:
+    """Load a GAME model directory.  By default each coordinate's saved
+    feature index is used (self-contained model); passing ``index_maps``
+    re-keys coefficients onto the caller's maps (feature-index rebuild
+    semantics, as the reference's loader does)."""
+    with open(os.path.join(dir_path, "metadata.json")) as f:
+        meta = json.load(f)
+    task_type = meta["task_type"]
+    coordinates = {}
+    maps_out: Dict[str, IndexMap] = {}
+    for cmeta in meta["coordinates"]:
+        name, ctype = cmeta["name"], cmeta["type"]
+        sub = "fixed-effect" if ctype == "fixed" else "random-effect"
+        coord_dir = os.path.join(dir_path, sub, name)
+        shard = cmeta["shard_name"]
+        if index_maps is not None and shard in index_maps:
+            imap = index_maps[shard]
+        else:
+            imap = IndexMap.load(os.path.join(coord_dir, "feature_index.json"))
+        maps_out[shard] = imap
+        if ctype == "fixed":
+            path, fmt = _coeff_file(coord_dir)
+            glm = load_glm_model(path, imap, fmt=fmt)
+            # The task's link governs GAME prediction; per-coordinate loss is
+            # irrelevant post-training, so rebuild on the model's task.
+            glm = model_for_task(task_type, glm.coefficients)
+            coordinates[name] = FixedEffectModel(model=glm, shard_name=shard)
+        else:
+            coordinates[name] = _load_random_effect(coord_dir, cmeta, imap, task_type)
+    return GameModel(coordinates=coordinates, task_type=task_type), maps_out

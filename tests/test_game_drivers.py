@@ -1,0 +1,186 @@
+"""GAME data IO, model IO, and end-to-end GAME driver tests.
+
+Mirrors the reference's driver integration tests (SURVEY.md §4: full
+GameTrainingDriver runs on small resource fixtures asserting output model
+files + metric thresholds, and train→save→load→score round-trips)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.game_io import read_game_avro, write_game_avro
+from photon_tpu.data.synthetic import make_game_dataset
+from photon_tpu.game.model_io import load_game_model, save_game_model
+
+
+def small_game_data():
+    return make_game_dataset(
+        n_entities=25, rows_per_entity_mean=4, fixed_dim=6, random_dim=4, seed=3
+    )
+
+
+def test_game_avro_round_trip(tmp_path):
+    data, index_maps = small_game_data()
+    path = str(tmp_path / "train.avro")
+    write_game_avro(path, data, index_maps)
+
+    bags = {name: name for name in data.shards}
+    loaded, loaded_maps = read_game_avro(path, bags, ["re0"])
+
+    assert loaded.num_examples == data.num_examples
+    np.testing.assert_allclose(loaded.label, data.label)
+    np.testing.assert_allclose(loaded.weight, data.weight)
+    # Entity ids come back as strings of the original ints.
+    assert [int(x) for x in loaded.id_columns["re0"]] == list(
+        data.id_columns["re0"]
+    )
+    # Margins must agree under each side's own indexing: compare via a
+    # fixed coefficient vector keyed by feature name.
+    for shard_name in data.shards:
+        imap, lmap = index_maps[shard_name], loaded_maps[shard_name]
+        rng = np.random.default_rng(1)
+        w_by_key = {k: rng.standard_normal() for k in imap.keys()}
+        dense = data.shards[shard_name].x
+        w_orig = np.array([w_by_key[imap.get_key(i)] for i in range(len(imap))])
+        sp = loaded.shards[shard_name]
+        w_load = np.array(
+            [w_by_key.get(lmap.get_key(i), 0.0) for i in range(len(lmap))]
+        )
+        np.testing.assert_allclose(
+            dense @ w_orig,
+            (w_load[sp.ids] * sp.vals).sum(axis=1),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+def test_read_with_fixed_maps_drops_unknown_features(tmp_path):
+    data, index_maps = small_game_data()
+    path = str(tmp_path / "train.avro")
+    write_game_avro(path, data, index_maps)
+    bags = {name: name for name in data.shards}
+    # Re-read with the ORIGINAL maps: dims must match the training dims.
+    loaded, maps = read_game_avro(path, bags, ["re0"], index_maps=index_maps)
+    assert maps is index_maps
+    for name in data.shards:
+        assert loaded.shards[name].dim == data.shards[name].dim
+
+
+def test_game_model_io_round_trip(tmp_path, game_model_fixture):
+    model, index_maps, data = game_model_fixture
+    save_game_model(str(tmp_path / "m"), model, index_maps)
+    loaded, _ = load_game_model(str(tmp_path / "m"))
+    assert set(loaded.coordinates) == set(model.coordinates)
+    np.testing.assert_allclose(
+        loaded.score(data), model.score(data), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_game_model_io_json_round_trip(tmp_path, game_model_fixture):
+    model, index_maps, data = game_model_fixture
+    save_game_model(str(tmp_path / "mj"), model, index_maps, fmt="json")
+    loaded, _ = load_game_model(str(tmp_path / "mj"))
+    np.testing.assert_allclose(
+        loaded.score(data), model.score(data), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.fixture(scope="module")
+def game_model_fixture():
+    """A trained small GAME model (fixed + one random effect)."""
+    from photon_tpu.core.objective import RegularizationContext
+    from photon_tpu.core.optimizers import OptimizerConfig
+    from photon_tpu.core.problem import ProblemConfig
+    from photon_tpu.game.coordinate import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.game.estimator import GameEstimator, GameOptimizationConfiguration
+
+    data, index_maps = small_game_data()
+    problem = ProblemConfig(
+        regularization=RegularizationContext("l2", 1.0),
+        optimizer_config=OptimizerConfig(max_iterations=10),
+        variance_computation="simple",
+    )
+    config = GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", problem),
+            "per_entity": RandomEffectCoordinateConfig("re0", "re0", problem),
+        },
+        descent_iterations=1,
+    )
+    estimator = GameEstimator("logistic_regression", data)
+    result = estimator.fit([config])[0]
+    return result.model, index_maps, data
+
+
+def test_train_and_score_game_drivers_synthetic(tmp_path):
+    from photon_tpu.drivers import score_game, train_game
+
+    out = str(tmp_path / "out")
+    spec = "synthetic-game:40:4:8:4:1:7"
+    summary = train_game.run(train_game.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", spec,
+        "--coordinate", "fixed:type=fixed,shard=global,reg_weights=0.1+1,max_iters=15",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,reg_weights=1,max_iters=10",
+        "--descent-iterations", "2",
+        "--validation-split", "0.25",
+        "--output-dir", out,
+    ]))
+    assert os.path.isdir(os.path.join(out, "best_model", "fixed-effect", "fixed"))
+    assert os.path.isdir(
+        os.path.join(out, "best_model", "random-effect", "per_user")
+    )
+    assert len(summary["sweep"]) == 2  # reg sweep: 0.1 and 1 on the fixed coord
+    assert summary["best_metrics"]["AUC"] > 0.6
+
+    score_out = str(tmp_path / "scored")
+    result = score_game.run(score_game.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", spec,
+        "--model", os.path.join(out, "best_model"),
+        "--evaluators", "AUC,SHARDED_AUC:re0",
+        "--output-dir", score_out,
+    ]))
+    assert result["metrics"]["AUC"] > 0.6
+    assert os.path.exists(os.path.join(score_out, "scores.txt"))
+    with open(os.path.join(score_out, "metrics.json")) as f:
+        assert "SHARDED_AUC:re0" in json.load(f)
+
+
+def test_train_game_driver_avro_end_to_end(tmp_path):
+    """Full Avro path: synthetic -> Avro file -> train -> warm-start retrain."""
+    from photon_tpu.drivers import train_game
+
+    data, index_maps = small_game_data()
+    avro_path = str(tmp_path / "train.avro")
+    write_game_avro(avro_path, data, index_maps)
+
+    out = str(tmp_path / "out")
+    common_args = [
+        "--backend", "cpu",
+        "--input", avro_path,
+        "--feature-bags", "global=global,re0=re0",
+        "--id-columns", "re0",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=8",
+        "--validation-split", "0.25",
+    ]
+    summary = train_game.run(train_game.build_parser().parse_args(
+        common_args + ["--output-dir", out]
+    ))
+    assert summary["best_metrics"]["AUC"] > 0.55
+
+    # Warm start with the fixed coordinate locked (partial retraining).
+    out2 = str(tmp_path / "out2")
+    summary2 = train_game.run(train_game.build_parser().parse_args(
+        common_args + [
+            "--output-dir", out2,
+            "--initial-model", os.path.join(out, "best_model"),
+            "--locked-coordinates", "fixed",
+        ]
+    ))
+    assert summary2["best_metrics"]["AUC"] > 0.55
