@@ -70,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--locked-coordinates", default=None,
                    help="comma-separated coordinates to freeze at the "
                    "initial model (partial retraining)")
+    p.add_argument("--tuning", default="none",
+                   choices=("none", "random", "bayesian"),
+                   help="tune per-coordinate regularization weights on the "
+                   "validation metric (reference: hyperParameterTuning "
+                   "RANDOM|BAYESIAN) instead of the reg_weights grid")
+    p.add_argument("--tuning-iterations", type=int, default=10)
+    p.add_argument("--tuning-range", default="1e-4:1e4",
+                   help="lo:hi log-scale range for tuned reg weights")
     p.add_argument("--model-format", default="avro", choices=("avro", "json"))
     p.add_argument("--save-all-models", action="store_true")
     p.add_argument("--checkpoint", action=argparse.BooleanOptionalAction,
@@ -123,8 +131,8 @@ def _coordinate_specs(args) -> list[tuple[str, dict]]:
     return [parse_coordinate_spec(s) for s in args.coordinates]
 
 
-def _build_sweep(specs):
-    """Cross product of per-coordinate reg weights -> configuration list."""
+def _coord_config(kv: dict, lam: float):
+    """Build one coordinate's config with regularization weight ``lam``."""
     from photon_tpu.core.objective import RegularizationContext
     from photon_tpu.core.optimizers import OptimizerConfig
     from photon_tpu.core.problem import ProblemConfig
@@ -132,55 +140,56 @@ def _build_sweep(specs):
         FixedEffectCoordinateConfig,
         RandomEffectCoordinateConfig,
     )
-    from photon_tpu.game.estimator import GameOptimizationConfiguration
 
+    reg_type = kv.get("reg_type", "l2")
+    optimizer = kv.get("optimizer", "lbfgs")
+    if reg_type in ("l1", "elastic_net"):
+        optimizer = "owlqn"
+    problem = ProblemConfig(
+        optimizer=optimizer,
+        regularization=RegularizationContext(
+            reg_type, lam, float(kv.get("alpha", 0.5))
+        ),
+        optimizer_config=OptimizerConfig(
+            max_iterations=int(kv.get("max_iters", 50)),
+            tolerance=float(kv.get("tolerance", 1e-7)),
+        ),
+        variance_computation=kv.get("variance", "none"),
+    )
+    if kv.get("type", "fixed") == "fixed":
+        return FixedEffectCoordinateConfig(
+            shard_name=kv["shard"],
+            problem=problem,
+            downsampling_rate=float(kv.get("downsample", 1.0)),
+            seed=int(kv.get("seed", 0)),
+        )
+    cap = kv.get("active_row_cap")
+    return RandomEffectCoordinateConfig(
+        shard_name=kv["shard"],
+        entity_column=kv["entity"],
+        problem=problem,
+        active_row_cap=None if cap in (None, "") else int(cap),
+        seed=int(kv.get("seed", 0)),
+    )
+
+
+def _combo_label(specs, combo) -> str:
+    return ",".join(f"{name}={lam:g}" for (name, _), lam in zip(specs, combo))
+
+
+def _build_sweep(specs):
+    """Cross product of per-coordinate reg weights -> configuration list."""
     weight_lists = []
     for _, kv in specs:
         weights = [float(w) for w in str(kv.get("reg_weights", "1.0")).split("+")]
         weight_lists.append(weights)
 
-    def coord_config(kv: dict, lam: float):
-        reg_type = kv.get("reg_type", "l2")
-        optimizer = kv.get("optimizer", "lbfgs")
-        if reg_type in ("l1", "elastic_net"):
-            optimizer = "owlqn"
-        problem = ProblemConfig(
-            optimizer=optimizer,
-            regularization=RegularizationContext(
-                reg_type, lam, float(kv.get("alpha", 0.5))
-            ),
-            optimizer_config=OptimizerConfig(
-                max_iterations=int(kv.get("max_iters", 50)),
-                tolerance=float(kv.get("tolerance", 1e-7)),
-            ),
-            variance_computation=kv.get("variance", "none"),
-        )
-        if kv.get("type", "fixed") == "fixed":
-            return FixedEffectCoordinateConfig(
-                shard_name=kv["shard"],
-                problem=problem,
-                downsampling_rate=float(kv.get("downsample", 1.0)),
-                seed=int(kv.get("seed", 0)),
-            )
-        cap = kv.get("active_row_cap")
-        return RandomEffectCoordinateConfig(
-            shard_name=kv["shard"],
-            entity_column=kv["entity"],
-            problem=problem,
-            active_row_cap=None if cap in (None, "") else int(cap),
-            seed=int(kv.get("seed", 0)),
-        )
-
     configurations = []
     for combo in itertools.product(*weight_lists):
         coords = {
-            name: coord_config(kv, lam)
-            for (name, kv), lam in zip(specs, combo)
+            name: _coord_config(kv, lam) for (name, kv), lam in zip(specs, combo)
         }
-        label = ",".join(
-            f"{name}={lam:g}" for (name, _), lam in zip(specs, combo)
-        )
-        configurations.append((label, coords, combo))
+        configurations.append((_combo_label(specs, combo), coords, combo))
     return configurations
 
 
@@ -196,6 +205,18 @@ def _load_game_data(spec: str, args, index_maps=None):
         data, maps = make_game_dataset(
             n_e, rows, fdim, rdim, seed=seed, n_random_coords=n_random
         )
+        if index_maps is not None:
+            # Synthetic features are positional; a model trained on other
+            # data can only be applied if its maps agree key-for-key —
+            # otherwise coefficients would land on the wrong columns.
+            for name, imap in maps.items():
+                other = index_maps.get(name)
+                if other is not None and list(other.keys()) != list(imap.keys()):
+                    raise ValueError(
+                        f"model's index map for shard {name!r} does not match "
+                        "the synthetic-game feature layout; score the data "
+                        "the model was trained for"
+                    )
         return data, (index_maps or maps)
     from photon_tpu.data.game_io import read_game_avro
 
@@ -265,29 +286,74 @@ def run(args: argparse.Namespace) -> dict:
         logger=logger,
     )
 
-    sweep = _build_sweep(specs)
-    configurations = [
-        GameOptimizationConfiguration(
-            coordinates=coords,
-            descent_iterations=args.descent_iterations,
-            name=label,
-        )
-        for label, coords, _ in sweep
-    ]
+    results = []
+
+    def fit_config(config) -> "object":
+        result = estimator.fit(
+            [config], initial_model=initial_model, locked_coordinates=locked
+        )[0]
+        results.append(result)
+        if args.checkpoint or args.save_all_models:
+            save_game_model(
+                os.path.join(args.output_dir, f"model_{config.name}"),
+                result.model, index_maps, fmt=args.model_format,
+            )
+        return result
 
     with maybe_profile(args.profile_dir):
-        results = []
-        for config in configurations:
-            result = estimator.fit(
-                [config], initial_model=initial_model,
-                locked_coordinates=locked,
-            )[0]
-            results.append(result)
-            if args.checkpoint or args.save_all_models:
-                save_game_model(
-                    os.path.join(args.output_dir, f"model_{config.name}"),
-                    result.model, index_maps, fmt=args.model_format,
+        if args.tuning != "none":
+            # Tune per-coordinate reg weights on the validation metric
+            # (reference: hyperParameterTuning RANDOM|BAYESIAN, §3.5).
+            if val_data is None:
+                raise ValueError("--tuning needs validation data")
+            from photon_tpu.hyperparameter import (
+                GaussianProcessSearch,
+                RandomSearch,
+                SearchDimension,
+                SearchSpace,
+            )
+
+            lo, hi = (float(x) for x in args.tuning_range.split(":"))
+            # Locked coordinates keep their configured weight: their model is
+            # frozen, so searching their dimension would be dead weight.
+            space = SearchSpace([
+                SearchDimension(name, lo, hi, log_scale=True)
+                for name, _ in specs
+                if name not in locked
+            ])
+            primary = evaluators.primary
+
+            def weight_for(name: str, kv: dict, params) -> float:
+                if name in locked:
+                    return float(str(kv.get("reg_weights", "1.0")).split("+")[0])
+                return params[name]
+
+            def evaluate(params):
+                combo = [weight_for(name, kv, params) for name, kv in specs]
+                config = GameOptimizationConfiguration(
+                    coordinates={
+                        name: _coord_config(kv, weight_for(name, kv, params))
+                        for name, kv in specs
+                    },
+                    descent_iterations=args.descent_iterations,
+                    name=_combo_label(specs, combo),
                 )
+                result = fit_config(config)
+                return result.metrics[primary.name]
+
+            search_cls = (
+                GaussianProcessSearch if args.tuning == "bayesian" else RandomSearch
+            )
+            search_cls(
+                space, evaluate, maximize=primary.maximize
+            ).find(args.tuning_iterations)
+        else:
+            for label, coords, _ in _build_sweep(specs):
+                fit_config(GameOptimizationConfiguration(
+                    coordinates=coords,
+                    descent_iterations=args.descent_iterations,
+                    name=label,
+                ))
     best = estimator.select_best(results)
 
     with logger.timed("save-model"):

@@ -216,10 +216,10 @@ def entity_index_for(raw_keys: np.ndarray, vocab_keys: np.ndarray) -> np.ndarray
         if vocab_keys.dtype.kind in "iu" and raw.dtype.kind in "US":
             try:
                 raw = raw.astype(np.int64)
-            except ValueError as e:
+            except (ValueError, OverflowError) as e:
                 raise ValueError(
-                    "entity id column holds non-numeric strings but the "
-                    "vocabulary is integer-typed"
+                    "entity id column holds strings that are not valid int64 "
+                    "values but the vocabulary is integer-typed"
                 ) from e
         else:
             # astype(str) keeps each value's natural width; casting to the

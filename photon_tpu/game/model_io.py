@@ -162,11 +162,11 @@ def _load_random_effect(
     try:
         ints = [int(k) for k in raw_keys]
         parsed = (
-            np.asarray(ints)
+            np.asarray(ints, dtype=np.int64)
             if len(set(ints)) == len(ints)
             else np.asarray(raw_keys)
         )
-    except ValueError:
+    except (ValueError, OverflowError):  # non-numeric or beyond-int64 ids
         parsed = np.asarray(raw_keys)
     order = np.argsort(parsed, kind="stable")
     keys = parsed[order]

@@ -1,0 +1,19 @@
+"""Hyperparameter search (reference: photon-lib ``hyperparameter/`` —
+``RandomSearch``, ``GaussianProcessSearch`` with a Matérn-5/2 GP and
+expected-improvement acquisition; SURVEY.md §2.1, §3.5)."""
+
+from photon_tpu.hyperparameter.search import (
+    EvaluationRecord,
+    GaussianProcessSearch,
+    RandomSearch,
+    SearchDimension,
+    SearchSpace,
+)
+
+__all__ = [
+    "EvaluationRecord",
+    "GaussianProcessSearch",
+    "RandomSearch",
+    "SearchDimension",
+    "SearchSpace",
+]

@@ -1,0 +1,247 @@
+"""Random and Bayesian (GP) hyperparameter search.
+
+Rebuild of the reference's hyperparameter package (photon-lib
+``hyperparameter/``: ``RandomSearch``, ``GaussianProcessSearch`` — a
+Gaussian-process surrogate with a Matérn-5/2 kernel and an
+expected-improvement acquisition — and the ``EvaluationFunction`` contract;
+SURVEY.md §2.1 and §3.5).  The reference searches regularization weights in
+log space over a full GAME fit per trial; the search machinery itself is
+model-agnostic.
+
+TPU-native shape: the GP math (kernel, Cholesky solve, EI) is pure JAX and
+jit-compiled; trials are Python-side because each trial IS a full training
+run.  Candidate acquisition is maximized over a sampled candidate set — a
+quasi-random sweep is robust in the low-dimensional spaces (1-4 reg weights)
+this is used for, and avoids a second optimizer in the loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchDimension:
+    """One hyperparameter: a (low, high) range, optionally log-scaled
+    (regularization weights are log-scaled in the reference)."""
+
+    name: str
+    low: float
+    high: float
+    log_scale: bool = False
+
+    def __post_init__(self):
+        if not self.high > self.low:
+            raise ValueError(f"{self.name}: high must exceed low")
+        if self.log_scale and self.low <= 0:
+            raise ValueError(f"{self.name}: log scale needs low > 0")
+
+    def to_unit(self, value: float) -> float:
+        if self.log_scale:
+            return (math.log(value) - math.log(self.low)) / (
+                math.log(self.high) - math.log(self.low)
+            )
+        return (value - self.low) / (self.high - self.low)
+
+    def from_unit(self, u: float) -> float:
+        u = min(max(u, 0.0), 1.0)
+        if self.log_scale:
+            return math.exp(
+                math.log(self.low) + u * (math.log(self.high) - math.log(self.low))
+            )
+        return self.low + u * (self.high - self.low)
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchSpace:
+    dimensions: Sequence[SearchDimension]
+
+    @property
+    def ndim(self) -> int:
+        return len(self.dimensions)
+
+    def to_unit(self, params: Dict[str, float]) -> np.ndarray:
+        return np.asarray(
+            [d.to_unit(params[d.name]) for d in self.dimensions], np.float64
+        )
+
+    def from_unit(self, u: np.ndarray) -> Dict[str, float]:
+        return {d.name: d.from_unit(float(x)) for d, x in zip(self.dimensions, u)}
+
+
+@dataclasses.dataclass
+class EvaluationRecord:
+    params: Dict[str, float]
+    value: float
+
+
+class _SearchBase:
+    """Shared trial loop: propose → evaluate → record → track best.
+
+    ``evaluation_function`` maps a params dict to a scalar metric (the
+    reference's EvaluationFunction runs a full GameEstimator.fit per call —
+    SURVEY.md §3.5); ``maximize`` gives the metric direction.
+    """
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        evaluation_function: Callable[[Dict[str, float]], float],
+        maximize: bool = False,
+        seed: int = 0,
+    ):
+        self.space = space
+        self.fn = evaluation_function
+        self.maximize = maximize
+        self.rng = np.random.default_rng(seed)
+        self.history: List[EvaluationRecord] = []
+
+    # Internally everything MINIMIZES (negate for maximize).
+    def _observed(self) -> tuple[np.ndarray, np.ndarray]:
+        x = np.stack([self.space.to_unit(r.params) for r in self.history])
+        y = np.asarray([r.value for r in self.history], np.float64)
+        return x, (-y if self.maximize else y)
+
+    def _evaluate(self, unit_x: np.ndarray) -> EvaluationRecord:
+        params = self.space.from_unit(unit_x)
+        record = EvaluationRecord(params, float(self.fn(params)))
+        self.history.append(record)
+        return record
+
+    @property
+    def best(self) -> EvaluationRecord:
+        if not self.history:
+            raise RuntimeError("no trials evaluated yet")
+        pick = max if self.maximize else min
+        return pick(self.history, key=lambda r: r.value)
+
+    def _propose(self, trial_index: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def find(self, num_trials: int) -> EvaluationRecord:
+        for t in range(num_trials):
+            self._evaluate(self._propose(len(self.history)))
+        return self.best
+
+
+class RandomSearch(_SearchBase):
+    """Uniform sampling in the unit cube (log-uniform for log dims)."""
+
+    def _propose(self, trial_index: int) -> np.ndarray:
+        return self.rng.random(self.space.ndim)
+
+
+# ---------------------------------------------------------------------------
+# Gaussian-process surrogate (Matérn-5/2) + expected improvement
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _matern52(x1: Array, x2: Array, lengthscale: Array, amplitude: Array) -> Array:
+    """Matérn-5/2 kernel matrix (the reference GP's covariance choice)."""
+    d2 = jnp.sum((x1[:, None, :] - x2[None, :, :]) ** 2, axis=-1)
+    r = jnp.sqrt(jnp.maximum(d2, 1e-30)) / lengthscale
+    s5r = jnp.sqrt(5.0) * r
+    return amplitude * (1.0 + s5r + 5.0 * d2 / (3.0 * lengthscale**2)) * jnp.exp(-s5r)
+
+
+@jax.jit
+def _gp_log_marginal(x: Array, y: Array, lengthscale: Array, amplitude: Array,
+                     noise: Array) -> Array:
+    n = x.shape[0]
+    k = _matern52(x, x, lengthscale, amplitude) + noise * jnp.eye(n)
+    chol = jnp.linalg.cholesky(k)
+    alpha = jax.scipy.linalg.cho_solve((chol, True), y)
+    return (
+        -0.5 * jnp.dot(y, alpha)
+        - jnp.sum(jnp.log(jnp.diagonal(chol)))
+        - 0.5 * n * jnp.log(2.0 * jnp.pi)
+    )
+
+
+@jax.jit
+def _gp_posterior(
+    x: Array, y: Array, candidates: Array,
+    lengthscale: Array, amplitude: Array, noise: Array,
+) -> tuple[Array, Array]:
+    """Posterior mean + stddev at candidate points."""
+    n = x.shape[0]
+    k = _matern52(x, x, lengthscale, amplitude) + noise * jnp.eye(n)
+    chol = jnp.linalg.cholesky(k)
+    k_star = _matern52(candidates, x, lengthscale, amplitude)
+    alpha = jax.scipy.linalg.cho_solve((chol, True), y)
+    mean = k_star @ alpha
+    v = jax.scipy.linalg.solve_triangular(chol, k_star.T, lower=True)
+    var = amplitude - jnp.sum(v * v, axis=0)
+    return mean, jnp.sqrt(jnp.maximum(var, 1e-12))
+
+
+@jax.jit
+def _expected_improvement(mean: Array, std: Array, best: Array) -> Array:
+    """EI for MINIMIZATION: E[max(best - f, 0)]."""
+    z = (best - mean) / std
+    cdf = 0.5 * (1.0 + jax.scipy.special.erf(z / jnp.sqrt(2.0)))
+    pdf = jnp.exp(-0.5 * z * z) / jnp.sqrt(2.0 * jnp.pi)
+    return (best - mean) * cdf + std * pdf
+
+
+class GaussianProcessSearch(_SearchBase):
+    """Bayesian search: Matérn-5/2 GP surrogate + EI acquisition.
+
+    Reference semantics (GaussianProcessSearch [K?], SURVEY.md §2.1): first
+    ``num_seed`` trials are random, then each proposal fits the GP to the
+    standardized observations (lengthscale chosen by marginal likelihood over
+    a log grid) and picks the EI-argmax over a fresh random candidate set.
+    """
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        evaluation_function: Callable[[Dict[str, float]], float],
+        maximize: bool = False,
+        seed: int = 0,
+        num_seed_trials: int = 3,
+        num_candidates: int = 2048,
+        noise: float = 1e-6,
+    ):
+        super().__init__(space, evaluation_function, maximize, seed)
+        self.num_seed_trials = max(2, num_seed_trials)
+        self.num_candidates = num_candidates
+        self.noise = noise
+        self._lengthscale_grid = np.geomspace(0.05, 2.0, 8)
+
+    def _propose(self, trial_index: int) -> np.ndarray:
+        if trial_index < self.num_seed_trials:
+            return self.rng.random(self.space.ndim)
+
+        x, y = self._observed()
+        # Standardize targets so fixed amplitude=1 is a reasonable prior.
+        y_mean, y_std = y.mean(), max(y.std(), 1e-12)
+        y_n = (y - y_mean) / y_std
+
+        xj = jnp.asarray(x)
+        yj = jnp.asarray(y_n)
+        amplitude = jnp.asarray(1.0)
+        noise = jnp.asarray(self.noise)
+        best_ls, best_ml = None, -np.inf
+        for ls in self._lengthscale_grid:
+            ml = float(_gp_log_marginal(xj, yj, jnp.asarray(ls), amplitude, noise))
+            if np.isfinite(ml) and ml > best_ml:
+                best_ls, best_ml = ls, ml
+        if best_ls is None:  # degenerate observations: fall back to random
+            return self.rng.random(self.space.ndim)
+
+        candidates = self.rng.random((self.num_candidates, self.space.ndim))
+        mean, std = _gp_posterior(
+            xj, yj, jnp.asarray(candidates), jnp.asarray(best_ls), amplitude, noise
+        )
+        ei = _expected_improvement(mean, std, jnp.asarray(y_n.min()))
+        return candidates[int(np.argmax(np.asarray(ei)))]
