@@ -50,6 +50,10 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
                         help="validation data (same formats)")
     parser.add_argument("--intercept", action=argparse.BooleanOptionalAction,
                         default=True)
+    parser.add_argument("--data-validation", default="error",
+                        choices=("error", "warn", "off"),
+                        help="row sanity checks before training (the "
+                        "reference's DataValidators strictness)")
 
 
 BINARY_TASKS = ("logistic_regression", "smoothed_hinge_loss_linear_svm")

@@ -90,6 +90,13 @@ def run(args: argparse.Namespace) -> dict:
         )
         logger.info("train: %d examples, %d features", batch.num_examples, dim)
 
+    if args.data_validation != "off":
+        from photon_tpu.data.validation import apply_validation, validate_batch
+
+        apply_validation(
+            validate_batch(batch, args.task), args.data_validation, logger
+        )
+
     norm = None
     if args.normalization != "none":
         with logger.timed("summarize"):

@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id-columns", default=None,
                    help="entity id columns to read, comma separated "
                    "(Avro input only)")
+    p.add_argument("--data-validation", default="error",
+                   choices=("error", "warn", "off"),
+                   help="row sanity checks before training (the reference's "
+                   "DataValidators strictness)")
     p.add_argument("--task", default="logistic_regression",
                    choices=("logistic_regression", "linear_regression",
                             "poisson_regression", "smoothed_hinge_loss_linear_svm"))
@@ -90,7 +94,7 @@ def build_parser() -> argparse.ArgumentParser:
 _KNOWN_COORDINATE_KEYS = {
     "type", "shard", "entity", "optimizer", "reg_type", "reg_weights",
     "alpha", "max_iters", "tolerance", "variance", "active_row_cap",
-    "downsample", "seed",
+    "downsample", "downsampler", "seed",
 }
 
 
@@ -131,8 +135,12 @@ def _coordinate_specs(args) -> list[tuple[str, dict]]:
     return [parse_coordinate_spec(s) for s in args.coordinates]
 
 
-def _coord_config(kv: dict, lam: float):
-    """Build one coordinate's config with regularization weight ``lam``."""
+def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
+    """Build one coordinate's config with regularization weight ``lam``.
+
+    ``downsampler`` defaults to the task-appropriate sampler (binary for
+    logistic/hinge, uniform otherwise — the reference's rule).
+    """
     from photon_tpu.core.objective import RegularizationContext
     from photon_tpu.core.optimizers import OptimizerConfig
     from photon_tpu.core.problem import ProblemConfig
@@ -157,10 +165,22 @@ def _coord_config(kv: dict, lam: float):
         variance_computation=kv.get("variance", "none"),
     )
     if kv.get("type", "fixed") == "fixed":
+        downsampler = kv.get("downsampler") or "auto"
+        if downsampler == "auto":
+            from photon_tpu.data.sampling import BinaryClassificationDownSampler
+            from photon_tpu.data.sampling import down_sampler_for_task
+
+            sampler = down_sampler_for_task(task, 1.0)
+            downsampler = (
+                "binary"
+                if isinstance(sampler, BinaryClassificationDownSampler)
+                else "default"
+            )
         return FixedEffectCoordinateConfig(
             shard_name=kv["shard"],
             problem=problem,
             downsampling_rate=float(kv.get("downsample", 1.0)),
+            downsampler=downsampler,
             seed=int(kv.get("seed", 0)),
         )
     cap = kv.get("active_row_cap")
@@ -177,7 +197,7 @@ def _combo_label(specs, combo) -> str:
     return ",".join(f"{name}={lam:g}" for (name, _), lam in zip(specs, combo))
 
 
-def _build_sweep(specs):
+def _build_sweep(specs, task: str):
     """Cross product of per-coordinate reg weights -> configuration list."""
     weight_lists = []
     for _, kv in specs:
@@ -187,7 +207,8 @@ def _build_sweep(specs):
     configurations = []
     for combo in itertools.product(*weight_lists):
         coords = {
-            name: _coord_config(kv, lam) for (name, kv), lam in zip(specs, combo)
+            name: _coord_config(kv, lam, task)
+            for (name, kv), lam in zip(specs, combo)
         }
         configurations.append((_combo_label(specs, combo), coords, combo))
     return configurations
@@ -261,6 +282,16 @@ def run(args: argparse.Namespace) -> dict:
             {n: s.dim for n, s in data.shards.items()},
         )
 
+    if args.data_validation != "off":
+        from photon_tpu.data.validation import (
+            apply_validation,
+            validate_game_dataset,
+        )
+
+        apply_validation(
+            validate_game_dataset(data, args.task), args.data_validation, logger
+        )
+
     if args.evaluators:
         evaluators = MultiEvaluator(
             [get_evaluator(n) for n in args.evaluators.split(",")]
@@ -321,6 +352,10 @@ def run(args: argparse.Namespace) -> dict:
                 for name, _ in specs
                 if name not in locked
             ])
+            if not space.dimensions:
+                raise ValueError(
+                    "--tuning needs at least one unlocked coordinate"
+                )
             primary = evaluators.primary
 
             def weight_for(name: str, kv: dict, params) -> float:
@@ -332,7 +367,7 @@ def run(args: argparse.Namespace) -> dict:
                 combo = [weight_for(name, kv, params) for name, kv in specs]
                 config = GameOptimizationConfiguration(
                     coordinates={
-                        name: _coord_config(kv, weight_for(name, kv, params))
+                        name: _coord_config(kv, weight_for(name, kv, params), args.task)
                         for name, kv in specs
                     },
                     descent_iterations=args.descent_iterations,
@@ -348,7 +383,7 @@ def run(args: argparse.Namespace) -> dict:
                 space, evaluate, maximize=primary.maximize
             ).find(args.tuning_iterations)
         else:
-            for label, coords, _ in _build_sweep(specs):
+            for label, coords, _ in _build_sweep(specs, args.task):
                 fit_config(GameOptimizationConfiguration(
                     coordinates=coords,
                     descent_iterations=args.descent_iterations,

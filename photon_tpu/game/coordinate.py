@@ -68,12 +68,16 @@ class FixedEffectCoordinateConfig:
 
     shard_name: str
     problem: ProblemConfig = ProblemConfig()
-    downsampling_rate: float = 1.0  # <1: train on a uniform subsample
+    downsampling_rate: float = 1.0  # <1: train on a subsample
+    downsampler: str = "default"  # default (uniform) | binary (negatives only)
     seed: int = 0  # subsample seed
 
     @property
     def data_key(self):
-        return ("fixed", self.shard_name, self.downsampling_rate, self.seed)
+        return (
+            "fixed", self.shard_name, self.downsampling_rate,
+            self.downsampler, self.seed,
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -129,15 +133,18 @@ class FixedEffectDeviceData:
         self.train_rows: Optional[np.ndarray] = None
         label, offset, weight = data.label, data.offset, data.weight
         if config.downsampling_rate < 1.0:
-            # Uniform subsample with 1/rate weight correction (the
-            # reference's DefaultDownSampler on the fixed-effect dataset).
-            rng = np.random.default_rng(config.seed)
-            keep = np.nonzero(rng.random(data.num_examples) < config.downsampling_rate)[0]
+            # Weight-corrected subsample (the reference's DownSampler on the
+            # fixed-effect dataset; `binary` keeps positives and thins
+            # negatives — data.sampling).
+            from photon_tpu.data.sampling import get_down_sampler
+
+            sampler = get_down_sampler(config.downsampler, config.downsampling_rate)
+            keep, corrected = sampler.down_sample(label, weight, seed=config.seed)
             self.train_rows = keep
             shard = _gather_shard_rows(shard, keep)
             label = label[keep]
             offset = offset[keep]
-            weight = weight[keep] / config.downsampling_rate
+            weight = corrected
         self.batch = shard_to_batch(shard, label, offset, weight)
         self.unpadded_n = self.batch.num_examples
         if mesh is not None:

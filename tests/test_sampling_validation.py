@@ -1,0 +1,149 @@
+"""Down-sampler and data-validation tests (reference: photon-lib sampling/,
+photon-client DataValidators — SURVEY.md §2.1, §2.3)."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.sampling import (
+    BinaryClassificationDownSampler,
+    DefaultDownSampler,
+    down_sampler_for_task,
+    get_down_sampler,
+)
+from photon_tpu.data.validation import (
+    DataValidationError,
+    apply_validation,
+    validate_columns,
+    validate_game_dataset,
+)
+
+
+def test_default_down_sampler_unbiased_weight_sum():
+    rng = np.random.default_rng(0)
+    n = 20000
+    label = (rng.random(n) < 0.5).astype(np.float32)
+    weight = np.ones(n, np.float32)
+    rows, corrected = DefaultDownSampler(0.2).down_sample(label, weight, seed=1)
+    assert 0.15 * n < len(rows) < 0.25 * n
+    # Corrected weight total is an unbiased estimate of the original total.
+    assert abs(corrected.sum() - n) / n < 0.05
+
+
+def test_binary_down_sampler_keeps_positives():
+    rng = np.random.default_rng(2)
+    n = 10000
+    label = (rng.random(n) < 0.05).astype(np.float32)  # 5% positives
+    weight = np.full(n, 2.0, np.float32)
+    rows, corrected = BinaryClassificationDownSampler(0.1).down_sample(
+        label, weight, seed=3
+    )
+    kept_labels = label[rows]
+    assert kept_labels.sum() == label.sum()  # every positive survives
+    # Positive weights untouched; negative weights scaled by 1/rate.
+    assert np.all(corrected[kept_labels > 0.5] == 2.0)
+    assert np.all(corrected[kept_labels <= 0.5] == 20.0)
+    # Weighted negative mass is approximately preserved.
+    neg_mass = corrected[kept_labels <= 0.5].sum()
+    assert abs(neg_mass - 2.0 * (n - label.sum())) / (2.0 * n) < 0.12
+
+
+def test_sampler_registry_and_task_default():
+    assert isinstance(get_down_sampler("binary", 0.5), BinaryClassificationDownSampler)
+    assert isinstance(
+        down_sampler_for_task("logistic_regression", 0.5),
+        BinaryClassificationDownSampler,
+    )
+    assert isinstance(
+        down_sampler_for_task("poisson_regression", 0.5), DefaultDownSampler
+    )
+    with pytest.raises(KeyError):
+        get_down_sampler("nope", 0.5)
+    with pytest.raises(ValueError):
+        DefaultDownSampler(0.0)
+
+
+def test_rate_one_is_identity():
+    label = np.asarray([0.0, 1.0, 0.0])
+    weight = np.asarray([1.0, 2.0, 3.0], np.float32)
+    rows, corrected = BinaryClassificationDownSampler(1.0).down_sample(label, weight)
+    np.testing.assert_array_equal(rows, [0, 1, 2])
+    np.testing.assert_allclose(corrected, weight)
+
+
+def test_validate_columns_catches_each_issue():
+    label = np.asarray([0.0, 1.0, np.nan, 2.0])
+    weight = np.asarray([1.0, 0.0, 1.0, -1.0])
+    offset = np.asarray([0.0, np.inf, 0.0, 0.0])
+    issues = validate_columns(label, weight, offset, "logistic_regression")
+    checks = {i.check for i in issues}
+    assert checks == {
+        "non_finite_label", "non_binary_label", "invalid_weight",
+        "non_finite_offset",
+    }
+    # Poisson: negative labels flagged, 2.0 fine.
+    issues = validate_columns(
+        np.asarray([0.0, 2.0, -1.0]), None, None, "poisson_regression"
+    )
+    assert [i.check for i in issues] == ["negative_label"]
+
+
+def test_validate_game_dataset_and_modes():
+    from photon_tpu.data.synthetic import make_game_dataset
+
+    data, _ = make_game_dataset(10, 3, 5, 3, seed=0)
+    assert validate_game_dataset(data, "logistic_regression") == []
+
+    bad = data.shards["global"].x.copy()
+    bad[0, 0] = np.nan
+    data2 = type(data)(
+        label=data.label, offset=data.offset, weight=data.weight,
+        shards={**data.shards, "global": type(data.shards["global"])(bad)},
+        id_columns=data.id_columns,
+    )
+    issues = validate_game_dataset(data2, "logistic_regression")
+    assert issues and issues[0].check.startswith("non_finite_features")
+    with pytest.raises(DataValidationError):
+        apply_validation(issues, "error")
+    apply_validation(issues, "warn")  # logs only
+    apply_validation(issues, "off")
+    with pytest.raises(ValueError):
+        apply_validation(issues, "bogus")
+
+
+def test_train_driver_rejects_bad_labels(tmp_path):
+    from photon_tpu.drivers import train
+
+    libsvm = tmp_path / "bad.libsvm"
+    libsvm.write_text("nan 1:1.0\n0 2:2.0\n1 1:0.5\n")
+    with pytest.raises(DataValidationError):
+        train.run(train.build_parser().parse_args([
+            "--backend", "cpu",
+            "--input", str(libsvm),
+            "--task", "linear_regression",
+            "--max-iterations", "2",
+            "--output-dir", str(tmp_path / "out"),
+        ]))
+
+
+def test_fixed_coordinate_binary_downsampler():
+    """Fixed-effect coordinate with binary down-sampling still trains."""
+    from photon_tpu.core.optimizers import OptimizerConfig
+    from photon_tpu.core.problem import ProblemConfig
+    from photon_tpu.data.synthetic import make_game_dataset
+    from photon_tpu.game.coordinate import FixedEffectCoordinateConfig
+    from photon_tpu.game.estimator import GameEstimator, GameOptimizationConfiguration
+
+    data, _ = make_game_dataset(40, 6, 6, 3, seed=1)
+    config = GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                "global",
+                ProblemConfig(optimizer_config=OptimizerConfig(max_iterations=10)),
+                downsampling_rate=0.5,
+                downsampler="binary",
+            ),
+        },
+    )
+    result = GameEstimator("logistic_regression", data).fit([config])[0]
+    table = result.model.coordinates["fixed"].coefficients.means
+    assert np.all(np.isfinite(np.asarray(table)))
