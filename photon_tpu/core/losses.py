@@ -143,6 +143,10 @@ TASK_TO_LOSS: dict[str, PointwiseLoss] = {
     "smoothed_hinge_loss_linear_svm": SMOOTHED_HINGE,
 }
 
+# Tasks whose labels live in {0, 1} — drives label validation, LIBSVM label
+# normalization, and the task-default (binary) down-sampler.
+BINARY_TASKS = ("logistic_regression", "smoothed_hinge_loss_linear_svm")
+
 
 def get_loss(name: str) -> PointwiseLoss:
     key = name.lower()

@@ -79,8 +79,7 @@ def get_down_sampler(kind: str, rate: float) -> DownSampler:
 
 
 def down_sampler_for_task(task_type: str, rate: float) -> DownSampler:
-    binary = task_type.lower() in (
-        "logistic_regression",
-        "smoothed_hinge_loss_linear_svm",
-    )
+    from photon_tpu.core.losses import BINARY_TASKS
+
+    binary = task_type.lower() in BINARY_TASKS
     return get_down_sampler("binary" if binary else "default", rate)

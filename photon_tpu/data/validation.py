@@ -16,7 +16,7 @@ from typing import List, Optional
 
 import numpy as np
 
-BINARY_TASKS = ("logistic_regression", "smoothed_hinge_loss_linear_svm")
+from photon_tpu.core.losses import BINARY_TASKS
 
 
 class DataValidationError(ValueError):

@@ -56,7 +56,7 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
                         "reference's DataValidators strictness)")
 
 
-BINARY_TASKS = ("logistic_regression", "smoothed_hinge_loss_linear_svm")
+from photon_tpu.core.losses import BINARY_TASKS  # noqa: E402  (single source)
 
 
 def load_dataset(spec: str, intercept: bool, task: str = "logistic_regression"):

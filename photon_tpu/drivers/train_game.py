@@ -94,7 +94,7 @@ def build_parser() -> argparse.ArgumentParser:
 _KNOWN_COORDINATE_KEYS = {
     "type", "shard", "entity", "optimizer", "reg_type", "reg_weights",
     "alpha", "max_iters", "tolerance", "variance", "active_row_cap",
-    "downsample", "downsampler", "seed",
+    "downsample", "downsampler", "projection", "projected_dim", "seed",
 }
 
 
@@ -167,15 +167,9 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
     if kv.get("type", "fixed") == "fixed":
         downsampler = kv.get("downsampler") or "auto"
         if downsampler == "auto":
-            from photon_tpu.data.sampling import BinaryClassificationDownSampler
-            from photon_tpu.data.sampling import down_sampler_for_task
+            from photon_tpu.core.losses import BINARY_TASKS
 
-            sampler = down_sampler_for_task(task, 1.0)
-            downsampler = (
-                "binary"
-                if isinstance(sampler, BinaryClassificationDownSampler)
-                else "default"
-            )
+            downsampler = "binary" if task.lower() in BINARY_TASKS else "default"
         return FixedEffectCoordinateConfig(
             shard_name=kv["shard"],
             problem=problem,
@@ -184,11 +178,14 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
             seed=int(kv.get("seed", 0)),
         )
     cap = kv.get("active_row_cap")
+    pdim = kv.get("projected_dim")
     return RandomEffectCoordinateConfig(
         shard_name=kv["shard"],
         entity_column=kv["entity"],
         problem=problem,
         active_row_cap=None if cap in (None, "") else int(cap),
+        projection=kv.get("projection", "none"),
+        projected_dim=None if pdim in (None, "") else int(pdim),
         seed=int(kv.get("seed", 0)),
     )
 

@@ -89,7 +89,18 @@ class RandomEffectCoordinateConfig:
     entity_column: str
     problem: ProblemConfig = ProblemConfig()
     active_row_cap: Optional[int] = None
+    # Feature projection for the per-entity solves (reference: data/projectors
+    # — SURVEY.md §2.2): none | index_map (per-entity active features) |
+    # random (sparse-sign matrix to projected_dim).
+    projection: str = "none"
+    projected_dim: Optional[int] = None
     seed: int = 0
+
+    def __post_init__(self):
+        if self.projection not in ("none", "index_map", "random"):
+            raise ValueError(f"unknown projection {self.projection!r}")
+        if self.projection == "random" and not self.projected_dim:
+            raise ValueError("random projection needs projected_dim")
 
     @property
     def data_key(self):
@@ -98,6 +109,8 @@ class RandomEffectCoordinateConfig:
             self.shard_name,
             self.entity_column,
             self.active_row_cap,
+            self.projection,
+            self.projected_dim,
             self.seed,
         )
 
@@ -185,10 +198,29 @@ class RandomEffectDeviceData:
             pad_bucket_entities(b, n_shards, self.dataset.num_entities)
             for b in self.dataset.buckets
         ]
+        # Optional feature projection shrinks each bucket's solve dimension
+        # (reference: data/projectors — see game.projection).
+        self.random_matrix = None
+        if config.projection == "random":
+            from photon_tpu.game.projection import build_random_projection
+
+            self.random_matrix = build_random_projection(
+                self.dim, config.projected_dim, seed=config.seed
+            )
         # Device-resident static parts: features / label / weight / entity idx.
         self.device_buckets = []
         for bucket in self.buckets:
             feats = bucket.features
+            proj = None
+            if config.projection == "index_map":
+                from photon_tpu.game.projection import build_index_map_projection
+
+                proj = build_index_map_projection(bucket)
+            elif config.projection == "random":
+                proj = self.random_matrix
+            if proj is not None:
+                feats = proj.project(feats)
+            solve_dim = self.dim if proj is None else proj.projected_dim
             if isinstance(feats, DenseShard):
                 dev_feats = (self._place(jnp.asarray(feats.x)),)
             else:
@@ -203,8 +235,10 @@ class RandomEffectDeviceData:
                     "label": self._place(jnp.asarray(bucket.label)),
                     "weight": self._place(jnp.asarray(bucket.row_weight)),
                     "entity_index": jnp.asarray(bucket.entity_index),
+                    "proj": proj,
+                    "solve_dim": solve_dim,
                     "w0": self._place(
-                        jnp.zeros((bucket.num_entities, self.dim), jnp.float32)
+                        jnp.zeros((bucket.num_entities, solve_dim), jnp.float32)
                     ),
                 }
             )
@@ -366,20 +400,55 @@ class RandomEffectCoordinate:
             None if initial_model is None else self._initial_table(initial_model)
         )
         stats = {"entities": 0, "converged": 0, "iterations_max": 0}
+        from photon_tpu.game.projection import (
+            IndexMapBucketProjection,
+            RandomProjectionMatrix,
+        )
+
         for i, bucket in enumerate(self.device_data.buckets):
             offsets_b = jnp.asarray(
                 offsets[bucket.row_index] * (bucket.row_weight > 0), jnp.float32
             )
             batch = self.device_data.batch_for(i, offsets_b)
-            entity_idx = self.device_data.device_buckets[i]["entity_index"]
+            dev = self.device_data.device_buckets[i]
+            entity_idx = dev["entity_index"]
+            proj = dev["proj"]
             if init_table is not None:
-                w0 = self.device_data._place(init_table[entity_idx])
+                if proj is None:
+                    w0 = self.device_data._place(init_table[entity_idx])
+                else:
+                    # Projection restriction is host-side numpy (built once
+                    # per descent iteration per bucket; warm-start only).
+                    w0_global = np.asarray(init_table)[np.asarray(entity_idx)]
+                    w0 = self.device_data._place(
+                        jnp.asarray(proj.restrict_table(w0_global))
+                    )
             else:
-                w0 = self.device_data.device_buckets[i]["w0"]
+                w0 = dev["w0"]
             coefficients, result = self._solver(batch, w0)
-            table = table.at[entity_idx].set(coefficients.means)
-            if var_table is not None:
-                var_table = var_table.at[entity_idx].set(coefficients.variances)
+            means, variances = coefficients.means, coefficients.variances
+            if proj is None:
+                table = table.at[entity_idx].set(means)
+                if var_table is not None:
+                    var_table = var_table.at[entity_idx].set(variances)
+            elif isinstance(proj, IndexMapBucketProjection):
+                # Scatter each local slot back to its global column; slots
+                # are unique per entity, so add-on-zero-rows equals set, and
+                # masked pad slots contribute exactly 0.
+                proj_ids, mask = proj.scatter_args()
+                ids_j, mask_j = jnp.asarray(proj_ids), jnp.asarray(mask)
+                table = table.at[entity_idx[:, None], ids_j].add(means * mask_j)
+                if var_table is not None:
+                    var_table = var_table.at[entity_idx[:, None], ids_j].add(
+                        variances * mask_j
+                    )
+            else:
+                assert isinstance(proj, RandomProjectionMatrix)
+                table = table.at[entity_idx].set(proj.lift(means))
+                if var_table is not None:
+                    var_table = var_table.at[entity_idx].set(
+                        proj.lift_variance(variances)
+                    )
             real = bucket.entity_index < num_entities
             stats["entities"] += int(real.sum())
             stats["converged"] += int(np.asarray(result.converged)[real].sum())

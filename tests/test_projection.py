@@ -1,0 +1,235 @@
+"""Feature-projection tests (reference: photon-api data/projectors —
+IndexMapProjection, RandomProjection, ProjectionMatrix; SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.game.data import DenseShard, SparseShard, build_random_effect_dataset
+from photon_tpu.game.projection import (
+    build_index_map_projection,
+    build_random_projection,
+)
+
+
+def _sparse_bucket():
+    """One bucket: 4 entities x 2 rows, global dim 32, few active features."""
+    from photon_tpu.game.data import EntityBucket
+
+    rng = np.random.default_rng(0)
+    ids = np.zeros((4, 2, 3), np.int32)
+    vals = np.zeros((4, 2, 3), np.float32)
+    for e in range(4):
+        active = rng.choice(np.arange(1, 32), size=4, replace=False)
+        for r in range(2):
+            chosen = rng.choice(active, size=3, replace=False)
+            ids[e, r] = np.sort(chosen)
+            vals[e, r] = rng.standard_normal(3)
+    return EntityBucket(
+        row_capacity=2,
+        entity_index=np.arange(4, dtype=np.int32),
+        row_index=np.zeros((4, 2), np.int64),
+        row_weight=np.ones((4, 2), np.float32),
+        label=np.zeros((4, 2), np.float32),
+        features=SparseShard(ids, vals, 32),
+    )
+
+
+def test_index_map_projection_sparse_margins_exact():
+    bucket = _sparse_bucket()
+    proj = build_index_map_projection(bucket)
+    assert proj is not None
+    assert proj.projected_dim < 32
+    local = proj.project(bucket.features)
+    # Any global coefficient vector restricted per entity gives identical
+    # margins on the local ids/vals.
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal(32).astype(np.float32)
+    table = np.tile(w, (4, 1))
+    w_local = proj.restrict_table(table)  # [4, p]
+    ids, vals = bucket.features.ids, bucket.features.vals
+    for e in range(4):
+        for r in range(2):
+            global_margin = (w[ids[e, r]] * vals[e, r]).sum()
+            local_margin = (w_local[e][local.ids[e, r]] * local.vals[e, r]).sum()
+            np.testing.assert_allclose(local_margin, global_margin, rtol=1e-5)
+
+
+def test_index_map_projection_dense_and_no_savings():
+    # Dense [E, R, d] with few active columns.
+    x = np.zeros((3, 2, 16), np.float32)
+    x[0, :, 2] = 1.0
+    x[1, :, [5, 7]] = 2.0
+    x[2, 0, 11] = 3.0
+    from photon_tpu.game.data import EntityBucket
+
+    bucket = EntityBucket(
+        row_capacity=2,
+        entity_index=np.arange(3, dtype=np.int32),
+        row_index=np.zeros((3, 2), np.int64),
+        row_weight=np.ones((3, 2), np.float32),
+        label=np.zeros((3, 2), np.float32),
+        features=DenseShard(x),
+    )
+    proj = build_index_map_projection(bucket)
+    assert proj is not None and proj.projected_dim == 2
+    local = proj.project(bucket.features)
+    w = np.arange(16, dtype=np.float32)
+    w_local = proj.restrict_table(np.tile(w, (3, 1)))
+    np.testing.assert_allclose(
+        np.einsum("erd,ed->er", x, np.tile(w, (3, 1))),
+        np.einsum("erp,ep->er", local.x, w_local),
+        rtol=1e-5,
+    )
+    # Dense bucket with every column active -> no savings -> None.
+    full = DenseShard(np.ones((2, 2, 4), np.float32))
+    bucket_full = EntityBucket(
+        row_capacity=2,
+        entity_index=np.arange(2, dtype=np.int32),
+        row_index=np.zeros((2, 2), np.int64),
+        row_weight=np.ones((2, 2), np.float32),
+        label=np.zeros((2, 2), np.float32),
+        features=full,
+    )
+    assert build_index_map_projection(bucket_full) is None
+
+
+def test_random_projection_lift_preserves_margins():
+    rng = np.random.default_rng(2)
+    dim, p = 64, 16
+    proj = build_random_projection(dim, p, seed=3)
+    assert proj.matrix.shape == (dim, p)
+    x = rng.standard_normal((5, 3, dim)).astype(np.float32)
+    local = proj.project(DenseShard(x))
+    assert local.x.shape == (5, 3, p)
+    w_local = rng.standard_normal((5, p)).astype(np.float32)
+    lifted = proj.lift(w_local)  # [5, dim]
+    # (R^T x)^T w_local == x^T (R w_local) exactly.
+    np.testing.assert_allclose(
+        np.einsum("erp,ep->er", local.x, w_local),
+        np.einsum("erd,ed->er", x, lifted),
+        rtol=1e-4, atol=1e-4,
+    )
+    with pytest.raises(ValueError):
+        build_random_projection(8, 8)
+
+
+def test_random_projection_restrict_inverts_lift():
+    """restrict(lift(w)) ≈ w — warm starts across descent iterations must
+    not be rescaled (a raw Rᵀ pullback would inflate them by ~dim/p)."""
+    rng = np.random.default_rng(4)
+    dim, p = 512, 32
+    proj = build_random_projection(dim, p, seed=1)
+    w = rng.standard_normal((6, p)).astype(np.float32)
+    back = proj.restrict_table(np.asarray(proj.lift(w)))
+    ratio = np.linalg.norm(back) / np.linalg.norm(w)
+    assert 0.7 < ratio < 1.4
+    # Norm preservation in expectation: E[||Rᵀx||²] = ||x||².
+    x = rng.standard_normal((200, dim)).astype(np.float32)
+    from photon_tpu.game.data import DenseShard as DS
+
+    projected = proj.project(DS(x[:, None, :])).x[:, 0, :]
+    norm_ratio = (projected**2).sum() / (x**2).sum()
+    assert 0.8 < norm_ratio < 1.2
+
+
+def test_random_projection_sparse_matches_dense():
+    proj = build_random_projection(32, 8, seed=0)
+    bucket = _sparse_bucket()
+    sp = proj.project(bucket.features)
+    # Densify the sparse rows and project to compare.
+    ids, vals = bucket.features.ids, bucket.features.vals
+    dense = np.zeros((4, 2, 32), np.float32)
+    for e in range(4):
+        for r in range(2):
+            np.add.at(dense[e, r], ids[e, r], vals[e, r])
+    np.testing.assert_allclose(
+        sp.x, proj.project(DenseShard(dense)).x, rtol=1e-4, atol=1e-5
+    )
+
+
+def _game_sparse_data(seed=0):
+    """GAME-style dataset with a SPARSE random-effect shard."""
+    rng = np.random.default_rng(seed)
+    n_entities, rows_mean, dim = 20, 4, 64
+    counts = np.maximum(1, rng.geometric(1.0 / rows_mean, n_entities))
+    n = int(counts.sum())
+    entity = np.repeat(np.arange(n_entities), counts)
+    k = 4
+    ids = np.zeros((n, k), np.int32)
+    vals = np.zeros((n, k), np.float32)
+    w_true = rng.standard_normal((n_entities, dim)).astype(np.float32) * 0.5
+    z = np.zeros(n, np.float32)
+    for i in range(n):
+        active = rng.choice(dim, size=k, replace=False)
+        ids[i] = np.sort(active)
+        vals[i] = rng.standard_normal(k)
+        z[i] = (w_true[entity[i], ids[i]] * vals[i]).sum()
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    from photon_tpu.game.data import GameDataset
+
+    return GameDataset.create(
+        y, {"re": SparseShard(ids, vals, dim)}, id_columns={"re": entity}
+    )
+
+
+def _train_re(data, **config_kw):
+    from photon_tpu.core.objective import RegularizationContext
+    from photon_tpu.core.optimizers import OptimizerConfig
+    from photon_tpu.core.problem import ProblemConfig
+    from photon_tpu.game.coordinate import (
+        RandomEffectCoordinate,
+        RandomEffectCoordinateConfig,
+    )
+
+    config = RandomEffectCoordinateConfig(
+        shard_name="re",
+        entity_column="re",
+        problem=ProblemConfig(
+            regularization=RegularizationContext("l2", 1.0),
+            optimizer_config=OptimizerConfig(max_iterations=25),
+        ),
+        **config_kw,
+    )
+    coord = RandomEffectCoordinate(data, config, "logistic_regression")
+    model, stats = coord.train(np.zeros(data.num_examples, np.float32))
+    return model, stats, coord
+
+
+def test_index_map_projected_solve_matches_unprojected():
+    """The projection is exact: projected and unprojected coordinate solves
+    must land on the same model (same objective, same optimizer)."""
+    data = _game_sparse_data()
+    model_plain, _, coord = _train_re(data)
+    model_proj, stats, _ = _train_re(data, projection="index_map")
+    assert stats["entities"] == model_proj.num_entities
+    np.testing.assert_allclose(
+        np.asarray(model_proj.table), np.asarray(model_plain.table),
+        rtol=2e-3, atol=2e-4,
+    )
+    # Scores agree too.
+    np.testing.assert_allclose(
+        model_proj.score(data), model_plain.score(data), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_random_projected_solve_trains_and_scores():
+    """Random projection is lossy but must train finite and score sanely."""
+    data = _game_sparse_data(seed=1)
+    model, stats, _ = _train_re(data, projection="random", projected_dim=16)
+    table = np.asarray(model.table)
+    assert np.all(np.isfinite(table))
+    assert stats["converged"] > 0
+    # Lifted-model scores correlate with the labels' direction.
+    scores = model.score(data)
+    assert np.isfinite(scores).all()
+
+
+def test_projection_with_active_row_cap_and_vocab():
+    data = _game_sparse_data(seed=2)
+    ds = build_random_effect_dataset(
+        data, "re", "re", active_row_cap=4
+    )
+    for bucket in ds.buckets:
+        proj = build_index_map_projection(bucket)
+        if proj is not None:
+            assert proj.proj_ids.shape[0] == bucket.num_entities
