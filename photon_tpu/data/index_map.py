@@ -87,3 +87,80 @@ class IndexMap:
         with open(path) as f:
             payload = json.load(f)
         return cls(payload["keys"])
+
+
+class OffHeapIndexMap:
+    """Memory-mapped feature index map (the reference's PalDBIndexMap).
+
+    Same lookup interface as :class:`IndexMap`, but keys live in an mmap'd
+    native store (photon_tpu.native.index_store) instead of a Python dict —
+    the off-heap design the reference uses when feature vocabularies exceed
+    driver memory.  ``build_file``/``open`` raise when the native library is
+    unavailable; callers that can fall back should catch OSError and use
+    :class:`IndexMap`.
+    """
+
+    def __init__(self, handle, path: str):
+        self._handle = handle
+        self.path = path
+        self.intercept_id: Optional[int] = None
+        iid = handle.get_id(INTERCEPT_KEY)
+        if iid >= 0:
+            self.intercept_id = iid
+
+    # -- construction ---------------------------------------------------------
+    @classmethod
+    def build_file(
+        cls, path: str, keys: Iterable[str], intercept: bool = True
+    ) -> "OffHeapIndexMap":
+        from photon_tpu.native import index_store
+
+        seen = dict.fromkeys(keys)  # first-seen order, like IndexMap.build
+        if intercept and INTERCEPT_KEY not in seen:
+            seen[INTERCEPT_KEY] = None
+        if not index_store.build_store(path, list(seen)):
+            raise OSError("native index store unavailable (toolchain missing?)")
+        return cls.open(path)
+
+    @classmethod
+    def open(cls, path: str) -> "OffHeapIndexMap":
+        from photon_tpu.native import index_store
+
+        handle = index_store.open_store(path)
+        if handle is None:
+            raise OSError(f"cannot open index store {path!r}")
+        return cls(handle, path)
+
+    # -- lookups (IndexMap interface) ----------------------------------------
+    def __len__(self) -> int:
+        return len(self._handle)
+
+    def __contains__(self, key: str) -> bool:
+        return self._handle.get_id(key) >= 0
+
+    def get_id(self, key: str, default: int = -1) -> int:
+        return self._handle.get_id(key, default)
+
+    def get_key(self, idx: int) -> str:
+        return self._handle.get_key(idx)
+
+    def keys(self) -> Iterator[str]:
+        for i in range(len(self)):
+            yield self.get_key(i)
+
+    def ids_for(self, keys: Iterable[str]) -> np.ndarray:
+        return np.asarray([self.get_id(k) for k in keys], np.int32)
+
+    def save(self, path: str) -> None:
+        """Export as the JSON format for interop with :class:`IndexMap`."""
+        IndexMap(list(self.keys())).save(path)
+
+    # -- lifecycle ------------------------------------------------------------
+    def close(self) -> None:
+        self._handle.close()
+
+    def __enter__(self) -> "OffHeapIndexMap":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

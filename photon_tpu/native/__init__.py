@@ -1,0 +1,17 @@
+"""Native (C++) runtime components: compiled on demand, always optional.
+
+The reference's runtime leans on native code via the JVM (netlib BLAS JNI,
+PalDB off-heap maps — SURVEY.md §2.4); this package is the rebuild's native
+layer for the HOST side of the pipeline (device compute is XLA/Pallas):
+
+- ``libsvm_native`` — multi-threaded mmap LIBSVM parser (data loader)
+- ``index_store`` — PalDB-equivalent read-only mmap feature-index store
+
+The shared library builds lazily with ``g++ -O3`` on first use and every
+entry point degrades to pure Python when the toolchain or build is
+unavailable (``PHOTON_TPU_NO_NATIVE=1`` forces the fallback).
+"""
+
+from photon_tpu.native.build import get_lib, native_disabled
+
+__all__ = ["get_lib", "native_disabled"]

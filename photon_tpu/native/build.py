@@ -1,0 +1,125 @@
+"""Lazy on-demand build of the native shared library.
+
+Compiles ``src/*.cpp`` into ``_photon_native.so`` with g++ the first time a
+native entry point is used (and whenever a source is newer than the built
+library).  Failures are cached for the process so a missing toolchain costs
+one attempt, not one per call.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(__file__)
+_SRC_DIR = os.path.join(_HERE, "src")
+_LIB_PATH = os.path.join(_HERE, "_photon_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def native_disabled() -> bool:
+    return os.environ.get("PHOTON_TPU_NO_NATIVE", "") not in ("", "0")
+
+
+def _needs_build(sources: list[str]) -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in sources)
+
+
+def _compile(sources: list[str]) -> bool:
+    # Compile to a process-unique temp path and os.replace() atomically:
+    # concurrent first-use builds must never CDLL a half-written .so.
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", tmp_path, *sources,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300
+        )
+        if proc.returncode != 0 or not os.path.exists(tmp_path):
+            return False
+        os.replace(tmp_path, _LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp_path):
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+    return True
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.svm_open.restype = c.c_void_p
+    lib.svm_open.argtypes = [c.c_char_p]
+    lib.svm_rows.restype = c.c_int64
+    lib.svm_rows.argtypes = [c.c_void_p]
+    lib.svm_total_nnz.restype = c.c_int64
+    lib.svm_total_nnz.argtypes = [c.c_void_p]
+    lib.svm_row_nnz.restype = None
+    lib.svm_row_nnz.argtypes = [c.c_void_p, c.POINTER(c.c_int64)]
+    lib.svm_parse.restype = c.c_int64
+    lib.svm_parse.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int64), c.POINTER(c.c_float),
+        c.POINTER(c.c_int32), c.POINTER(c.c_float), c.c_int,
+    ]
+    lib.svm_close.restype = None
+    lib.svm_close.argtypes = [c.c_void_p]
+
+    lib.ixs_build.restype = c.c_int
+    lib.ixs_build.argtypes = [
+        c.c_char_p, c.c_char_p, c.POINTER(c.c_int64),
+        c.POINTER(c.c_int64), c.c_int64,
+    ]
+    lib.ixs_open.restype = c.c_void_p
+    lib.ixs_open.argtypes = [c.c_char_p]
+    lib.ixs_n_keys.restype = c.c_int64
+    lib.ixs_n_keys.argtypes = [c.c_void_p]
+    lib.ixs_get.restype = c.c_int64
+    lib.ixs_get.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.ixs_key_at.restype = c.c_int64
+    lib.ixs_key_at.argtypes = [c.c_void_p, c.c_int64, c.c_char_p, c.c_int64]
+    lib.ixs_close.restype = None
+    lib.ixs_close.argtypes = [c.c_void_p]
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it if needed; None when unavailable."""
+    global _lib, _failed
+    if native_disabled():
+        return None
+    if _lib is not None:
+        return _lib
+    if _failed:
+        return None
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        sources = sorted(glob.glob(os.path.join(_SRC_DIR, "*.cpp")))
+        if not sources:
+            _failed = True
+            return None
+        if _needs_build(sources) and not _compile(sources):
+            _failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+        except OSError:
+            _failed = True
+            return None
+        _lib = lib
+    return _lib

@@ -1,0 +1,85 @@
+"""ctypes binding for the mmap feature-index store (src/index_store.cpp).
+
+The native half of :class:`photon_tpu.data.index_map.OffHeapIndexMap` — the
+rebuild of the reference's PalDBIndexMap (SURVEY.md §2.3)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterable, Optional
+
+import numpy as np
+
+from photon_tpu.native.build import get_lib
+
+
+def build_store(path: str, keys: Iterable[str]) -> bool:
+    """Write a store file mapping each key to its position.  False when the
+    native library is unavailable (caller falls back to JSON)."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    encoded = [k.encode() for k in keys]
+    blob = b"".join(encoded)
+    lens = np.asarray([len(k) for k in encoded], np.int64)
+    offs = np.zeros(len(encoded), np.int64)
+    if len(encoded) > 1:
+        np.cumsum(lens[:-1], out=offs[1:])
+    rc = lib.ixs_build(
+        path.encode(),
+        blob,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(encoded),
+    )
+    return rc == 0
+
+
+class StoreHandle:
+    """Open store with key<->id lookups; close()s on GC."""
+
+    def __init__(self, path: str):
+        lib = get_lib()
+        if lib is None:
+            raise OSError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.ixs_open(path.encode())
+        if not self._handle:
+            raise OSError(f"cannot open index store {path!r}")
+        self.path = path
+
+    def __len__(self) -> int:
+        return int(self._lib.ixs_n_keys(self._handle))
+
+    def get_id(self, key: str, default: int = -1) -> int:
+        raw = key.encode()
+        out = int(self._lib.ixs_get(self._handle, raw, len(raw)))
+        return default if out < 0 else out
+
+    def get_key(self, idx: int) -> str:
+        buf = ctypes.create_string_buffer(256)
+        n = int(self._lib.ixs_key_at(self._handle, idx, buf, 256))
+        if n < 0:
+            raise IndexError(f"id {idx} out of range")
+        if n > 256:  # rare long key: retry with the exact size
+            buf = ctypes.create_string_buffer(n)
+            self._lib.ixs_key_at(self._handle, idx, buf, n)
+        return buf.raw[: min(n, len(buf.raw))].decode()
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ixs_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def open_store(path: str) -> Optional[StoreHandle]:
+    try:
+        return StoreHandle(path)
+    except OSError:
+        return None

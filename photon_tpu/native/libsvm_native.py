@@ -1,0 +1,63 @@
+"""ctypes binding for the native LIBSVM parser (src/libsvm_parser.cpp).
+
+``parse_file`` returns the same (rows, labels, dim) triple as the pure
+Python parser in :mod:`photon_tpu.data.libsvm` — per-row (ids, vals) arrays
+are zero-copy views into one flat CSR allocation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu.native.build import get_lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def parse_file(path: str, zero_based: bool = False) -> Optional[tuple]:
+    """(rows, labels, dim) or None when the native path is unavailable.
+
+    Raises ValueError on malformed input (matching the Python parser's
+    failure behavior rather than silently falling back to it, which would
+    parse the bad file a second time just to fail again).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    handle = lib.svm_open(path.encode())
+    if not handle:
+        return None  # IO error/empty: let the Python path report it
+    try:
+        n = lib.svm_rows(handle)
+        if n == 0:
+            return [], np.zeros(0, np.float32), 0
+        nnz = np.empty(n, np.int64)
+        lib.svm_row_nnz(handle, _ptr(nnz, ctypes.c_int64))
+        row_ptr = np.zeros(n + 1, np.int64)
+        np.cumsum(nnz, out=row_ptr[1:])
+        total = int(row_ptr[-1])
+        labels = np.empty(n, np.float32)
+        ids = np.empty(total, np.int32)
+        vals = np.empty(total, np.float32)
+        max_id = lib.svm_parse(
+            handle,
+            _ptr(row_ptr, ctypes.c_int64),
+            _ptr(labels, ctypes.c_float),
+            _ptr(ids, ctypes.c_int32),
+            _ptr(vals, ctypes.c_float),
+            1 if zero_based else 0,
+        )
+        if max_id == -2:
+            raise ValueError(f"{path}: malformed LIBSVM input")
+        rows = [
+            (ids[row_ptr[i]: row_ptr[i + 1]], vals[row_ptr[i]: row_ptr[i + 1]])
+            for i in range(n)
+        ]
+        return rows, labels, int(max_id) + 1
+    finally:
+        lib.svm_close(handle)

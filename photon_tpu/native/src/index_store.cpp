@@ -1,0 +1,194 @@
+// Read-only mmap'd feature-index store.
+//
+// Native equivalent of the reference's PalDB index maps (photon-client
+// index/PalDBIndexMap — SURVEY.md §2.3/§2.4): feature-key -> id lookups
+// against an off-heap, memory-mapped file, so huge feature vocabularies
+// never materialize as in-process hash maps.  Open-addressed FNV-1a hash
+// table at load factor <= 0.5, plus an id -> key table for reverse lookup.
+//
+// File layout (little-endian):
+//   Header{magic, version, n_keys, n_buckets, blob_bytes}
+//   int64 buckets[n_buckets]   — blob offset of the record, or -1
+//   int64 by_id[n_keys]        — blob offset per id (reverse lookup)
+//   blob: records [int32 key_len][key bytes][int64 id]
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x53584950;  // "PIXS"
+
+struct Header {
+  uint32_t magic;
+  uint32_t version;
+  int64_t n_keys;
+  int64_t n_buckets;
+  int64_t blob_bytes;
+};
+
+struct Store {
+  char* data;
+  size_t size;
+  int fd;
+  const Header* hdr;
+  const int64_t* buckets;
+  const int64_t* by_id;
+  const char* blob;
+};
+
+uint64_t fnv1a(const char* s, int64_t n) {
+  uint64_t h = 1469598103934665603ULL;
+  for (int64_t i = 0; i < n; ++i) {
+    h ^= static_cast<unsigned char>(s[i]);
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Build the store file from n keys packed into one blob (offs/lens per key).
+// Ids are assigned in input order. Returns 0 on success.
+int ixs_build(const char* path, const char* keys, const int64_t* offs,
+              const int64_t* lens, int64_t n) {
+  int64_t n_buckets = 16;
+  while (n_buckets < 2 * n) n_buckets <<= 1;
+
+  std::vector<char> blob;
+  std::vector<int64_t> recoff(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    recoff[i] = static_cast<int64_t>(blob.size());
+    int32_t len = static_cast<int32_t>(lens[i]);
+    const char* lp = reinterpret_cast<const char*>(&len);
+    blob.insert(blob.end(), lp, lp + 4);
+    blob.insert(blob.end(), keys + offs[i], keys + offs[i] + lens[i]);
+    int64_t id = i;
+    const char* ip = reinterpret_cast<const char*>(&id);
+    blob.insert(blob.end(), ip, ip + 8);
+  }
+
+  std::vector<int64_t> buckets(static_cast<size_t>(n_buckets), -1);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t b = fnv1a(keys + offs[i], lens[i]) &
+                 static_cast<uint64_t>(n_buckets - 1);
+    while (buckets[b] != -1) b = (b + 1) & static_cast<uint64_t>(n_buckets - 1);
+    buckets[b] = recoff[i];
+  }
+
+  FILE* fp = fopen(path, "wb");
+  if (!fp) return -1;
+  Header hdr{kMagic, 1, n, n_buckets, static_cast<int64_t>(blob.size())};
+  int ok = fwrite(&hdr, sizeof hdr, 1, fp) == 1 &&
+           fwrite(buckets.data(), 8, buckets.size(), fp) == buckets.size() &&
+           (n == 0 ||
+            fwrite(recoff.data(), 8, recoff.size(), fp) == recoff.size()) &&
+           (blob.empty() ||
+            fwrite(blob.data(), 1, blob.size(), fp) == blob.size());
+  if (fclose(fp) != 0) ok = 0;
+  return ok ? 0 : -1;
+}
+
+void* ixs_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 ||
+      st.st_size < static_cast<off_t>(sizeof(Header))) {
+    close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  auto* s = new Store;
+  s->data = static_cast<char*>(map);
+  s->size = static_cast<size_t>(st.st_size);
+  s->fd = fd;
+  s->hdr = reinterpret_cast<const Header*>(s->data);
+  // Validate the header AND that every declared section fits inside the
+  // file — a truncated store must fail open, not segfault on first lookup.
+  bool ok = s->hdr->magic == kMagic && s->hdr->version == 1 &&
+            s->hdr->n_keys >= 0 && s->hdr->n_buckets > 0 &&
+            s->hdr->blob_bytes >= 0 &&
+            ((s->hdr->n_buckets & (s->hdr->n_buckets - 1)) == 0);
+  if (ok) {
+    const uint64_t need = sizeof(Header) +
+                          8ull * static_cast<uint64_t>(s->hdr->n_buckets) +
+                          8ull * static_cast<uint64_t>(s->hdr->n_keys) +
+                          static_cast<uint64_t>(s->hdr->blob_bytes);
+    ok = need <= static_cast<uint64_t>(s->size);
+  }
+  if (!ok) {
+    munmap(map, s->size);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  s->buckets = reinterpret_cast<const int64_t*>(s->data + sizeof(Header));
+  s->by_id = s->buckets + s->hdr->n_buckets;
+  s->blob = reinterpret_cast<const char*>(s->by_id + s->hdr->n_keys);
+  return s;
+}
+
+int64_t ixs_n_keys(void* h) { return static_cast<Store*>(h)->hdr->n_keys; }
+
+// key -> id, or -1 when absent.
+int64_t ixs_get(void* h, const char* key, int64_t len) {
+  auto* s = static_cast<Store*>(h);
+  const int64_t nb = s->hdr->n_buckets;
+  uint64_t b = fnv1a(key, len) & static_cast<uint64_t>(nb - 1);
+  const int64_t blob_bytes = s->hdr->blob_bytes;
+  for (int64_t probe = 0; probe < nb; ++probe) {
+    int64_t off = s->buckets[b];
+    if (off < 0) return -1;
+    if (off + 12 > blob_bytes) return -1;  // corrupt bucket entry
+    const char* rec = s->blob + off;
+    int32_t rlen;
+    memcpy(&rlen, rec, 4);
+    if (rlen < 0 || off + 12 + rlen > blob_bytes) return -1;
+    if (rlen == len && memcmp(rec + 4, key, len) == 0) {
+      int64_t id;
+      memcpy(&id, rec + 4 + rlen, 8);
+      return id;
+    }
+    b = (b + 1) & static_cast<uint64_t>(nb - 1);
+  }
+  return -1;
+}
+
+// id -> key bytes (copied into buf, truncated to cap); returns the key's
+// full length, or -1 for an out-of-range id.
+int64_t ixs_key_at(void* h, int64_t id, char* buf, int64_t cap) {
+  auto* s = static_cast<Store*>(h);
+  if (id < 0 || id >= s->hdr->n_keys) return -1;
+  const int64_t off = s->by_id[id];
+  if (off < 0 || off + 12 > s->hdr->blob_bytes) return -1;
+  const char* rec = s->blob + off;
+  int32_t rlen;
+  memcpy(&rlen, rec, 4);
+  if (rlen < 0 || off + 12 + rlen > s->hdr->blob_bytes) return -1;
+  int64_t n = rlen < cap ? rlen : cap;
+  memcpy(buf, rec + 4, static_cast<size_t>(n));
+  return rlen;
+}
+
+void ixs_close(void* h) {
+  auto* s = static_cast<Store*>(h);
+  munmap(s->data, s->size);
+  close(s->fd);
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,214 @@
+"""Native-component tests: C++ LIBSVM parser and mmap index store
+(SURVEY.md §2.4 native inventory — the rebuild's host-side native layer).
+
+Every test skips cleanly when the toolchain is unavailable; a separate test
+asserts the pure-Python fallback engages under PHOTON_TPU_NO_NATIVE=1.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.libsvm import _parse_libsvm_py, parse_libsvm
+from photon_tpu.native.build import get_lib
+
+needs_native = pytest.mark.skipif(
+    get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+def _write_libsvm(path, n=500, dim=100, seed=0, comments=True):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(n):
+            k = int(rng.integers(1, 12))
+            ids = np.sort(rng.choice(np.arange(1, dim), size=k, replace=False))
+            lab = int(rng.choice([-1, 1]))
+            f.write(
+                f"{lab} " + " ".join(
+                    f"{j}:{rng.standard_normal():.6g}" for j in ids
+                )
+            )
+            if comments and i % 5 == 0:
+                f.write(" # trailing comment")
+            f.write("\n")
+        if comments:
+            f.write("\n# whole-line comment\n")
+
+
+@needs_native
+def test_native_parser_matches_python(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    _write_libsvm(path)
+    from photon_tpu.native import libsvm_native
+
+    nat = libsvm_native.parse_file(path, False)
+    assert nat is not None
+    rows_n, labels_n, dim_n = nat
+    py = _parse_libsvm_py(path, False)
+    assert dim_n == py.dim
+    np.testing.assert_allclose(labels_n, py.labels)
+    assert len(rows_n) == len(py.rows)
+    for (i1, v1), (i2, v2) in zip(rows_n, py.rows):
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_allclose(v1, v2)
+
+
+@needs_native
+def test_native_parser_zero_based_and_empty_rows(tmp_path):
+    path = str(tmp_path / "zb.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.5\n")
+        f.write("0\n")  # label-only row (no features)
+        f.write("-1 7:0.25\n")
+    from photon_tpu.native import libsvm_native
+
+    rows, labels, dim = libsvm_native.parse_file(path, True)
+    assert dim == 8
+    np.testing.assert_allclose(labels, [1.0, 0.0, -1.0])
+    assert len(rows[1][0]) == 0
+    np.testing.assert_array_equal(rows[0][0], [0, 3])
+
+
+@needs_native
+def test_native_parser_malformed_raises(tmp_path):
+    path = str(tmp_path / "bad.libsvm")
+    with open(path, "w") as f:
+        f.write("1 3:not_a_number\n")
+    from photon_tpu.native import libsvm_native
+
+    with pytest.raises(ValueError):
+        libsvm_native.parse_file(path, False)
+
+
+def test_parse_libsvm_fallback_env(tmp_path):
+    """PHOTON_TPU_NO_NATIVE forces the Python path (subprocess: the flag is
+    read at library-load time)."""
+    path = str(tmp_path / "data.libsvm")
+    _write_libsvm(path, n=50)
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from photon_tpu.native.build import get_lib; "
+        "assert get_lib() is None; "
+        "from photon_tpu.data.libsvm import parse_libsvm; "
+        "d = parse_libsvm(%r); print(d.num_examples, d.dim)"
+        % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), path)
+    )
+    env = dict(os.environ, PHOTON_TPU_NO_NATIVE="1")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    n, dim = out.stdout.split()
+    assert int(n) == 50
+
+
+@needs_native
+def test_native_parser_page_boundary_no_trailing_newline(tmp_path):
+    """A file sized to an exact page multiple with no final newline must not
+    read past the buffer (heap-copy guard in svm_open)."""
+    path = str(tmp_path / "page.libsvm")
+    tail = "1 7:2.5"
+    page = os.sysconf("SC_PAGESIZE")
+    # One comment line padding to exactly (page - len(tail)) bytes + tail.
+    content = "#" + "x" * (page - len(tail) - 2) + "\n" + tail
+    assert len(content) == page and not content.endswith("\n")
+    with open(path, "w") as f:
+        f.write(content)
+    from photon_tpu.native import libsvm_native
+
+    rows, labels, dim = libsvm_native.parse_file(path, False)
+    assert len(rows) == 1 and dim == 7
+    np.testing.assert_allclose(labels, [1.0])
+    np.testing.assert_allclose(rows[0][1], [2.5])
+
+
+@needs_native
+def test_native_parser_rejects_space_after_colon(tmp_path):
+    """'id: val' must fail in the native path exactly as in Python."""
+    path = str(tmp_path / "gap.libsvm")
+    with open(path, "w") as f:
+        f.write("1 2: 3\n")
+    from photon_tpu.native import libsvm_native
+
+    with pytest.raises(ValueError):
+        libsvm_native.parse_file(path, False)
+    with pytest.raises(ValueError):
+        _parse_libsvm_py(path, False)
+
+
+@needs_native
+def test_index_store_rejects_truncated_file(tmp_path):
+    from photon_tpu.data.index_map import OffHeapIndexMap
+
+    path = str(tmp_path / "t.pixs")
+    OffHeapIndexMap.build_file(path, [f"k{i}" for i in range(100)]).close()
+    data = open(path, "rb").read()
+    trunc = str(tmp_path / "trunc.pixs")
+    with open(trunc, "wb") as f:
+        f.write(data[: len(data) // 2])
+    with pytest.raises(OSError):
+        OffHeapIndexMap.open(trunc)
+
+
+@needs_native
+def test_index_store_round_trip(tmp_path):
+    from photon_tpu.data.index_map import IndexMap, OffHeapIndexMap
+    from photon_tpu.data.index_map import feature_key
+
+    path = str(tmp_path / "features.pixs")
+    keys = [feature_key(f"f{i}", f"t{i % 3}") for i in range(5000)]
+    m = OffHeapIndexMap.build_file(path, keys, intercept=True)
+    assert len(m) == 5001
+    assert m.intercept_id == 5000
+    for i in (0, 1234, 4999):
+        assert m.get_id(keys[i]) == i
+        assert m.get_key(i) == keys[i]
+    assert m.get_id("nope") == -1
+    assert keys[17] in m and "nope" not in m
+    # Reopen from disk.
+    m2 = OffHeapIndexMap.open(path)
+    assert m2.get_id(keys[42]) == 42
+    # JSON export interops with the in-memory map.
+    jpath = str(tmp_path / "features.json")
+    m.save(jpath)
+    m3 = IndexMap.load(jpath)
+    assert m3.get_id(keys[42]) == 42
+    assert m3.intercept_id == m.intercept_id
+    m.close() if hasattr(m, "close") else None
+
+
+@needs_native
+def test_index_store_duplicate_keys_deduped(tmp_path):
+    from photon_tpu.data.index_map import OffHeapIndexMap
+
+    path = str(tmp_path / "dup.pixs")
+    m = OffHeapIndexMap.build_file(path, ["a", "b", "a", "c"], intercept=False)
+    assert len(m) == 3
+    assert [m.get_key(i) for i in range(3)] == ["a", "b", "c"]
+
+
+@needs_native
+def test_train_driver_uses_native_parser(tmp_path):
+    """End-to-end: the train driver parses LIBSVM through the native path
+    (implicitly — parse_libsvm prefers it) and converges."""
+    from photon_tpu.data.synthetic import make_glm_data, write_libsvm
+    from photon_tpu.drivers import train
+
+    batch, _ = make_glm_data(400, 10, seed=0)
+    path = str(tmp_path / "train.libsvm")
+    write_libsvm(path, np.asarray(batch.x)[:, :-1], np.asarray(batch.label))
+    out = train.run(train.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", path,
+        "--task", "logistic_regression",
+        "--max-iterations", "30",
+        "--output-dir", str(tmp_path / "out"),
+    ]))
+    assert out["sweep"][0]["convergence_reason"] in (
+        "GRADIENT_CONVERGED", "FUNCTION_VALUES_TOLERANCE", "MAX_ITERATIONS"
+    )
