@@ -127,6 +127,37 @@ class GlmObjective:
         return v
 
     def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
+        if (
+            not isinstance(batch, DenseBatch)
+            and batch.ids.ndim == 2
+            and self.normalization is None
+        ):
+            from photon_tpu.ops.pallas_sparse import (
+                fused_value_and_grad,
+                pallas_enabled,
+            )
+
+            if pallas_enabled():
+                # Fused Pallas pass: gather + loss + dz + scatter in one
+                # kernel (photon_tpu.ops.pallas_sparse); L2 added
+                # analytically, as in the XLA path.  Mosaic gather/scatter
+                # support varies by TPU generation: fall back to the XLA
+                # path when the kernel cannot lower.
+                try:
+                    v, g = fused_value_and_grad(
+                        self.loss, w, batch.ids, batch.vals,
+                        batch.label, batch.offset, batch.weight,
+                    )
+                except (NotImplementedError, ValueError):
+                    # Mosaic on this TPU generation cannot lower the
+                    # kernel's gather/scatter (verified on v5e: scatter-add
+                    # is unimplemented, gather shape rules differ) — XLA's
+                    # native scatter path is the fast one there.
+                    return jax.value_and_grad(self.value)(w, batch)
+                if self.l2_weight:
+                    v = v + 0.5 * self.l2_weight * jnp.dot(w, w)
+                    g = g + self.l2_weight * w
+                return v, g
         return jax.value_and_grad(self.value)(w, batch)
 
     def grad(self, w: Array, batch: Batch) -> Array:

@@ -315,10 +315,21 @@ def run(args: argparse.Namespace) -> dict:
     )
 
     results = []
+    checkpoint_fn = None
+    if args.checkpoint:
+        # Per-descent-iteration intermediate model (SURVEY.md §5): each
+        # completed coordinate pass overwrites checkpoint/latest, so a
+        # killed run resumes via --initial-model <out>/checkpoint/latest.
+        ckpt_dir = os.path.join(args.output_dir, "checkpoint", "latest")
+
+        def checkpoint_fn(iteration, model):
+            save_game_model(ckpt_dir, model, index_maps, fmt=args.model_format)
+            logger.info("checkpoint: iteration %d -> %s", iteration, ckpt_dir)
 
     def fit_config(config) -> "object":
         result = estimator.fit(
-            [config], initial_model=initial_model, locked_coordinates=locked
+            [config], initial_model=initial_model, locked_coordinates=locked,
+            checkpoint_fn=checkpoint_fn,
         )[0]
         results.append(result)
         if args.checkpoint or args.save_all_models:

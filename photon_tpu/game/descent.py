@@ -77,7 +77,13 @@ class CoordinateDescent:
         num_iterations: int,
         initial_model: Optional[GameModel] = None,
         locked_coordinates: Sequence[str] = (),
+        checkpoint_fn=None,
     ) -> DescentResult:
+        """``checkpoint_fn(iteration, model)``, when given, is called after
+        every full coordinate pass with the current composite model — the
+        reference's per-iteration intermediate model output (SURVEY.md §5
+        'Failure detection': restart-from-checkpoint is the recovery story).
+        """
         locked = set(locked_coordinates)
         unknown = locked - set(self.coordinates)
         if unknown:
@@ -129,6 +135,8 @@ class CoordinateDescent:
                 self.logger.info("iter %d coordinate %s: %s", it, name, summary)
 
             game_model = GameModel(dict(models), self.task_type)
+            if checkpoint_fn is not None:
+                checkpoint_fn(it, game_model)
             metrics = self._evaluate(game_model)
             if metrics:
                 self.logger.info("iter %d validation %s", it, metrics)

@@ -126,7 +126,10 @@ class GameEstimator:
         configurations: Sequence[GameOptimizationConfiguration],
         initial_model: Optional[GameModel] = None,
         locked_coordinates: Sequence[str] = (),
+        checkpoint_fn=None,
     ) -> List[GameResult]:
+        """``checkpoint_fn(iteration, model)`` is forwarded to each descent
+        run (per-iteration intermediate model output — SURVEY.md §5)."""
         if not configurations:
             raise ValueError("fit() needs at least one configuration")
         results = []
@@ -144,6 +147,7 @@ class GameEstimator:
                     config.descent_iterations,
                     initial_model=initial_model,
                     locked_coordinates=locked_coordinates,
+                    checkpoint_fn=checkpoint_fn,
                 )
             results.append(
                 GameResult(

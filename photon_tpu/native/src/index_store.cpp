@@ -124,11 +124,14 @@ void* ixs_open(const char* path) {
             s->hdr->blob_bytes >= 0 &&
             ((s->hdr->n_buckets & (s->hdr->n_buckets - 1)) == 0);
   if (ok) {
-    const uint64_t need = sizeof(Header) +
-                          8ull * static_cast<uint64_t>(s->hdr->n_buckets) +
-                          8ull * static_cast<uint64_t>(s->hdr->n_keys) +
-                          static_cast<uint64_t>(s->hdr->blob_bytes);
-    ok = need <= static_cast<uint64_t>(s->size);
+    // Divide instead of multiply: a corrupt header with n_buckets ~ 2^61
+    // would overflow 8 * n_buckets and sneak past a multiplied bound.
+    const uint64_t avail = static_cast<uint64_t>(s->size) - sizeof(Header);
+    const uint64_t nb = static_cast<uint64_t>(s->hdr->n_buckets);
+    const uint64_t nk = static_cast<uint64_t>(s->hdr->n_keys);
+    const uint64_t bb = static_cast<uint64_t>(s->hdr->blob_bytes);
+    ok = nb <= avail / 8 && nk <= (avail - 8 * nb) / 8 &&
+         bb <= avail - 8 * nb - 8 * nk;
   }
   if (!ok) {
     munmap(map, s->size);

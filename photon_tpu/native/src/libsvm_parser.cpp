@@ -189,9 +189,13 @@ int64_t svm_parse(void* h, const int64_t* row_ptr, float* labels,
             return;
           }
           p = endp + 1;
-          // Reject "id: val" — strtof would skip the gap, but the Python
-          // parser errors on it, and both paths must accept the same files.
-          if (p < e && (*p == ' ' || *p == '\t')) {
+          // The value must start immediately after the colon: a bare "id:"
+          // at end of line (p >= e) or "id: val" would otherwise let strtof
+          // skip whitespace — including the newline, stealing the NEXT
+          // line's label as this feature's value.  The Python parser errors
+          // on both, and both paths must accept the same files.
+          if (p >= e || *p == ' ' || *p == '\t' || *p == '\r' ||
+              *p == '\n' || *p == '\v' || *p == '\f') {
             errs[t] = 1;
             return;
           }

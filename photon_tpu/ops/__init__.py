@@ -1,0 +1,13 @@
+"""Custom TPU kernels (Pallas/Mosaic) for the framework's hot ops.
+
+The reference's innermost loops run per-partition on Breeze/BLAS via JNI
+(SURVEY.md §2.4); here the device compute path is XLA, with Pallas kernels
+where fusion beyond XLA's reach pays — currently the fused sparse GLM
+value-and-gradient pass (:mod:`photon_tpu.ops.pallas_sparse`)."""
+
+from photon_tpu.ops.pallas_sparse import (
+    fused_value_and_grad,
+    pallas_enabled,
+)
+
+__all__ = ["fused_value_and_grad", "pallas_enabled"]

@@ -151,6 +151,34 @@ def test_train_and_score_game_drivers_synthetic(tmp_path):
         assert "SHARDED_AUC:re0" in json.load(f)
 
 
+def test_train_game_checkpoint_and_resume(tmp_path):
+    """--checkpoint writes a per-iteration model; a resumed run warm-starts
+    from it (SURVEY.md §5 restart-from-checkpoint)."""
+    from photon_tpu.drivers import train_game
+
+    out = str(tmp_path / "out")
+    spec = "synthetic-game:30:4:8:4:1:11"
+    base = [
+        "--backend", "cpu",
+        "--input", spec,
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=6",
+        "--descent-iterations", "2",
+        "--validation-split", "0.25",
+    ]
+    train_game.run(train_game.build_parser().parse_args(
+        base + ["--checkpoint", "--output-dir", out]
+    ))
+    ckpt = os.path.join(out, "checkpoint", "latest")
+    assert os.path.exists(os.path.join(ckpt, "metadata.json"))
+
+    out2 = str(tmp_path / "resumed")
+    summary = train_game.run(train_game.build_parser().parse_args(
+        base + ["--output-dir", out2, "--initial-model", ckpt]
+    ))
+    assert summary["best_metrics"]["AUC"] > 0.55
+
+
 def test_train_game_driver_avro_end_to_end(tmp_path):
     """Full Avro path: synthetic -> Avro file -> train -> warm-start retrain."""
     from photon_tpu.drivers import train_game

@@ -142,6 +142,39 @@ def test_native_parser_rejects_space_after_colon(tmp_path):
 
 
 @needs_native
+def test_native_parser_rejects_bare_colon_at_eol(tmp_path):
+    """'id:' at end of line must error, not steal the next line's label."""
+    path = str(tmp_path / "steal.libsvm")
+    with open(path, "w") as f:
+        f.write("1 2:\n3 1:1\n")
+    from photon_tpu.native import libsvm_native
+
+    with pytest.raises(ValueError):
+        libsvm_native.parse_file(path, False)
+    with pytest.raises(ValueError):
+        _parse_libsvm_py(path, False)
+
+
+@needs_native
+def test_index_store_rejects_overflowing_header(tmp_path):
+    """A corrupt header with n_buckets ~ 2^61 must fail open (the size
+    check divides instead of multiplying, so it cannot overflow)."""
+    import struct
+
+    from photon_tpu.data.index_map import OffHeapIndexMap
+
+    path = str(tmp_path / "o.pixs")
+    OffHeapIndexMap.build_file(path, ["a", "b"]).close()
+    data = bytearray(open(path, "rb").read())
+    # Header: magic(4) version(4) n_keys(8) n_buckets(8) blob_bytes(8).
+    data[16:24] = struct.pack("<q", 1 << 61)
+    bad = str(tmp_path / "bad.pixs")
+    open(bad, "wb").write(bytes(data))
+    with pytest.raises(OSError):
+        OffHeapIndexMap.open(bad)
+
+
+@needs_native
 def test_index_store_rejects_truncated_file(tmp_path):
     from photon_tpu.data.index_map import OffHeapIndexMap
 
