@@ -134,26 +134,22 @@ class GlmObjective:
         ):
             from photon_tpu.ops.pallas_sparse import (
                 fused_value_and_grad,
+                kernel_supported,
                 pallas_enabled,
             )
 
-            if pallas_enabled():
-                # Fused Pallas pass: gather + loss + dz + scatter in one
-                # kernel (photon_tpu.ops.pallas_sparse); L2 added
-                # analytically, as in the XLA path.  Mosaic gather/scatter
-                # support varies by TPU generation: fall back to the XLA
-                # path when the kernel cannot lower.
-                try:
-                    v, g = fused_value_and_grad(
-                        self.loss, w, batch.ids, batch.vals,
-                        batch.label, batch.offset, batch.weight,
-                    )
-                except (NotImplementedError, ValueError):
-                    # Mosaic on this TPU generation cannot lower the
-                    # kernel's gather/scatter (verified on v5e: scatter-add
-                    # is unimplemented, gather shape rules differ) — XLA's
-                    # native scatter path is the fast one there.
-                    return jax.value_and_grad(self.value)(w, batch)
+            # Fused Pallas pass: gather + loss + dz + scatter in one kernel
+            # (photon_tpu.ops.pallas_sparse); L2 added analytically, as in
+            # the XLA path.  kernel_supported() is an EAGER one-time Mosaic
+            # capability probe — a try/except here could not catch lowering
+            # failures, which surface when the enclosing jit (the
+            # optimizer's while_loop) compiles.  On v5e Mosaic lacks vector
+            # scatter-add, so this routes back to XLA there.
+            if pallas_enabled() and kernel_supported():
+                v, g = fused_value_and_grad(
+                    self.loss, w, batch.ids, batch.vals,
+                    batch.label, batch.offset, batch.weight,
+                )
                 if self.l2_weight:
                     v = v + 0.5 * self.l2_weight * jnp.dot(w, w)
                     g = g + self.l2_weight * w

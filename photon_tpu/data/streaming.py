@@ -1,0 +1,474 @@
+"""Large-scale input pipeline: sharded files, chunked batches, streaming.
+
+Rebuild of the reference's billion-row story (SURVEY.md §7 step 7).  The
+reference leans on Spark: executors each own partitions, ``treeAggregate``
+folds them, and the "pipeline" is the cluster.  The TPU equivalents, by
+dataset size:
+
+1. **Fits in HBM** — one :class:`photon_tpu.data.batch.SparseBatch` (the
+   default path everywhere else in the framework).
+2. **Fits in HBM, but intermediates don't** — :class:`ChunkedBatch`: the
+   batch stacked as ``[num_chunks, rows_per_chunk, ...]``; the objective
+   folds chunks with ``lax.scan``, bounding peak activation memory while
+   remaining ONE jittable function — it slots into the existing jitted
+   optimizers unchanged (chunk loop ≙ the reference's per-partition fold).
+3. **Host RAM only** — :func:`stream_chunks` + :func:`streaming_lbfgs`:
+   per-file host parsing sharded across processes, double-buffered
+   host→device transfer, and a host-loop L-BFGS whose every objective
+   evaluation is one streamed pass (what a Spark scan of a disk-persisted
+   RDD does, minus the JVM).
+
+Multi-host: :func:`shard_files_for_process` gives each host its file slice
+and :func:`make_global_batch` assembles per-process arrays into one global
+sharded array (``jax.make_array_from_process_local_data``) over the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from photon_tpu.core.optimizers.base import (
+    ConvergenceReason,
+    OptimizerConfig,
+    OptimizerResult,
+    init_history,
+)
+from photon_tpu.core.optimizers.lbfgs import _two_loop_direction
+from photon_tpu.data.batch import SparseBatch
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: device-resident chunked batch (lax.scan fold inside jit)
+# ---------------------------------------------------------------------------
+
+
+class ChunkedBatch(NamedTuple):
+    """A sparse batch stacked into fixed-size chunks.
+
+    Shapes: ids/vals ``[C, R, k]``; label/offset/weight ``[C, R]``.  Padding
+    rows carry zero weight.  The per-chunk fold bounds peak memory for the
+    gather intermediates at one chunk's worth (the reference's
+    per-partition aggregator fold — SURVEY.md §3.4).
+    """
+
+    ids: Array
+    vals: Array
+    label: Array
+    offset: Array
+    weight: Array
+
+    @property
+    def num_chunks(self) -> int:
+        return self.ids.shape[0]
+
+    @property
+    def num_examples(self) -> int:
+        # Physical rows incl. padding; objectives ignore zero-weight rows.
+        return self.ids.shape[0] * self.ids.shape[1]
+
+    def chunk(self, c: int) -> SparseBatch:
+        return SparseBatch(
+            self.ids[c], self.vals[c], self.label[c],
+            self.offset[c], self.weight[c],
+        )
+
+
+def chunk_batch(batch: SparseBatch, rows_per_chunk: int) -> ChunkedBatch:
+    """Stack a flat SparseBatch into ``[C, rows_per_chunk, ...]`` chunks."""
+    n, k = batch.ids.shape
+    c = max(1, -(-n // rows_per_chunk))
+    pad = c * rows_per_chunk - n
+
+    def pad_rows(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    return ChunkedBatch(
+        ids=pad_rows(batch.ids).reshape(c, rows_per_chunk, k),
+        vals=pad_rows(batch.vals).reshape(c, rows_per_chunk, k),
+        label=pad_rows(batch.label).reshape(c, rows_per_chunk),
+        offset=pad_rows(batch.offset).reshape(c, rows_per_chunk),
+        weight=pad_rows(batch.weight).reshape(c, rows_per_chunk),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkedGlmObjective:
+    """GlmObjective adapter folding a ChunkedBatch with ``lax.scan``.
+
+    Exposes the same (value / value_and_grad / hessian_vector) surface the
+    optimization problems use, so the existing jitted optimizers run
+    unchanged on chunked data.
+    """
+
+    objective: object  # GlmObjective
+
+    @property
+    def l1_weight(self) -> float:
+        return self.objective.l1_weight
+
+    @property
+    def l2_weight(self) -> float:
+        return self.objective.l2_weight
+
+    def _fold(self, fn, w: Array, chunks: ChunkedBatch, init):
+        def step(acc, chunk_leaves):
+            chunk = SparseBatch(*chunk_leaves)
+            out = fn(w, chunk)
+            return jax.tree.map(jnp.add, acc, out), None
+
+        acc, _ = lax.scan(step, init, tuple(chunks))
+        return acc
+
+    def value(self, w: Array, chunks: ChunkedBatch) -> Array:
+        data = self._fold(self.objective.data_value, w, chunks, jnp.zeros(()))
+        if self.objective.l2_weight:
+            data = data + 0.5 * self.objective.l2_weight * jnp.dot(w, w)
+        return data
+
+    def value_and_grad(self, w: Array, chunks: ChunkedBatch) -> tuple[Array, Array]:
+        value, grad = self._fold(
+            lambda w_, c: jax.value_and_grad(self.objective.data_value)(w_, c),
+            w, chunks, (jnp.zeros(()), jnp.zeros_like(w)),
+        )
+        l2 = self.objective.l2_weight
+        if l2:
+            value = value + 0.5 * l2 * jnp.dot(w, w)
+            grad = grad + l2 * w
+        return value, grad
+
+    def grad(self, w: Array, chunks: ChunkedBatch) -> Array:
+        return self.value_and_grad(w, chunks)[1]
+
+    def hessian_vector(self, w: Array, v: Array, chunks: ChunkedBatch) -> Array:
+        hv = self._fold(
+            lambda w_, c: jax.jvp(
+                lambda u: jax.grad(self.objective.data_value)(u, c), (w,), (v,)
+            )[1],
+            w, chunks, jnp.zeros_like(w),
+        )
+        return hv + self.objective.l2_weight * v
+
+    def hessian_diagonal(self, w: Array, chunks: ChunkedBatch) -> Array:
+        diag = self._fold(
+            # data-only diagonal: subtract the per-chunk l2 the underlying
+            # objective adds, then add it back once.
+            lambda w_, c: self.objective.hessian_diagonal(w_, c)
+            - self.objective.l2_weight,
+            w, chunks, jnp.zeros_like(w),
+        )
+        return diag + self.objective.l2_weight
+
+
+# ---------------------------------------------------------------------------
+# Tier 3: host streaming
+# ---------------------------------------------------------------------------
+
+
+def shard_files_for_process(
+    files: Sequence[str],
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> List[str]:
+    """This host's slice of the input file list (round-robin by index) —
+    the multi-host replacement for Spark's partition assignment."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    return [f for i, f in enumerate(sorted(files)) if i % pc == pi]
+
+
+def stream_chunks(
+    load_chunk: Callable[[int], Optional[SparseBatch]],
+    num_chunks: int,
+    prefetch: int = 2,
+) -> Iterator[SparseBatch]:
+    """Iterate device-ready chunks with background prefetch.
+
+    ``load_chunk(i)`` runs on a worker thread (parse + device_put); the
+    consumer overlaps device compute with the next chunk's host work —
+    the double-buffering SURVEY.md §7 calls for.  Abandoning the generator
+    mid-pass (e.g. an exception in the consumer) stops the worker and
+    releases its prefetched device batches instead of pinning them.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+    sentinel = object()
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for i in range(num_chunks):
+                if stop.is_set() or not put(load_chunk(i)):
+                    return
+        except BaseException as e:  # surface worker errors to the consumer
+            put(e)
+        finally:
+            put(sentinel)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            if item is not None:
+                yield item
+    finally:
+        stop.set()
+        # Drain so a blocked worker can observe the stop event and exit.
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+
+
+@functools.partial(jax.jit, static_argnames=("objective",))
+def _chunk_value_and_grad(objective, w: Array, chunk: SparseBatch):
+    """Shared jitted per-chunk kernel: module-level with the (hashable)
+    objective static, so a lambda sweep reuses one compilation per chunk
+    shape instead of recompiling per StreamingObjective instance."""
+    return jax.value_and_grad(objective.data_value)(w, chunk)
+
+
+@dataclasses.dataclass
+class StreamingObjective:
+    """Objective whose every evaluation is one streamed pass over chunks.
+
+    ``chunk_iter_factory`` yields device SparseBatches (typically via
+    :func:`stream_chunks`); evaluation accumulates a jitted per-chunk
+    value+grad.  In multi-process runs each process streams its own file
+    shard and ``all_reduce`` sums across hosts (psum over DCN).
+    """
+
+    objective: object  # GlmObjective
+    chunk_iter_factory: Callable[[], Iterable[SparseBatch]]
+    all_reduce: Optional[Callable[[Array], Array]] = None
+
+    def value_and_grad(self, w: Array) -> tuple[Array, Array]:
+        # Strip the reg weights from the static jit key: data_value ignores
+        # them, so every lambda in a sweep shares one compilation.
+        data_obj = dataclasses.replace(
+            self.objective, l2_weight=0.0, l1_weight=0.0
+        )
+        total_v = jnp.zeros(())
+        total_g = jnp.zeros_like(w)
+        for chunk in self.chunk_iter_factory():
+            v, g = _chunk_value_and_grad(data_obj, w, chunk)
+            total_v = total_v + v
+            total_g = total_g + g
+        if self.all_reduce is not None:
+            total_v = self.all_reduce(total_v)
+            total_g = self.all_reduce(total_g)
+        l2 = self.objective.l2_weight
+        if l2:
+            total_v = total_v + 0.5 * l2 * jnp.dot(w, w)
+            total_g = total_g + l2 * w
+        return total_v, total_g
+
+
+def streaming_lbfgs(
+    objective: StreamingObjective,
+    w0: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+) -> OptimizerResult:
+    """Host-loop L-BFGS for datasets that only fit on the host.
+
+    Same math as :func:`photon_tpu.core.optimizers.lbfgs` (shared two-loop
+    recursion, Armijo backtracking, cautious pair updates) but each function
+    evaluation is a streamed pass, so the outer loop lives in Python — the
+    shape of the reference's driver loop, where every evaluation is a
+    cluster scan (SURVEY.md §3.4).
+    """
+    m = config.history_length
+    d = w0.shape[0]
+    dtype = w0.dtype
+
+    direction = jax.jit(_two_loop_direction, static_argnames=("m",))
+
+    w = w0
+    f, g = objective.value_and_grad(w)
+    f, gnorm0 = float(f), float(jnp.linalg.norm(g))
+    hv, hg, hvalid = init_history(
+        config.max_iterations, jnp.asarray(f), jnp.asarray(gnorm0)
+    )
+    # np.array (copy): asarray of a jax array is a read-only view.
+    hv, hg, hvalid = np.array(hv), np.array(hg), np.array(hvalid)
+
+    S = jnp.zeros((m, d), dtype)
+    Y = jnp.zeros((m, d), dtype)
+    rho = jnp.zeros(m, dtype)
+    num_pairs, insert_pos, gamma = 0, 0, 1.0
+    reason = ConvergenceReason.NOT_CONVERGED
+    it = 0
+
+    if gnorm0 == 0.0:
+        reason = ConvergenceReason.GRADIENT_TOLERANCE
+
+    while reason == ConvergenceReason.NOT_CONVERGED:
+        dvec = direction(
+            g, S, Y, rho,
+            jnp.asarray(num_pairs, jnp.int32),
+            jnp.asarray(insert_pos, jnp.int32),
+            jnp.asarray(gamma, dtype), m,
+        )
+        dir_deriv = float(jnp.dot(g, dvec))
+        if dir_deriv >= 0.0:
+            dvec = -g
+            dir_deriv = -float(jnp.dot(g, g))
+        t = 1.0 if num_pairs else 1.0 / max(float(jnp.linalg.norm(g)), 1.0)
+
+        ls_ok = False
+        for _ in range(config.max_line_search):
+            w_try = w + t * dvec
+            f_try, g_try = objective.value_and_grad(w_try)
+            f_try = float(f_try)
+            if np.isfinite(f_try) and f_try <= f + 1e-4 * t * dir_deriv:
+                ls_ok = True
+                break
+            t *= 0.5
+        if not ls_ok:
+            reason = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+            break
+
+        svec = w_try - w
+        yvec = g_try - g
+        sy = float(jnp.dot(svec, yvec))
+        if sy > 1e-10:
+            S = S.at[insert_pos].set(svec)
+            Y = Y.at[insert_pos].set(yvec)
+            rho = rho.at[insert_pos].set(1.0 / sy)
+            num_pairs = min(num_pairs + 1, m)
+            insert_pos = (insert_pos + 1) % m
+            gamma = sy / max(float(jnp.dot(yvec, yvec)), 1e-30)
+
+        gnorm_new = float(jnp.linalg.norm(g_try))
+        it += 1
+        if it < hv.shape[0]:
+            hv[it], hg[it], hvalid[it] = f_try, gnorm_new, True
+        # Same tolerance semantics as base.check_convergence.
+        if gnorm_new <= config.gradient_tolerance * max(gnorm0, 1.0):
+            reason = ConvergenceReason.GRADIENT_TOLERANCE
+        elif abs(f - f_try) / max(abs(f), 1e-12) <= config.tolerance:
+            reason = ConvergenceReason.FUNCTION_VALUES_TOLERANCE
+        elif it >= config.max_iterations:
+            reason = ConvergenceReason.MAX_ITERATIONS
+        w, f, g = w_try, f_try, g_try
+
+    return OptimizerResult(
+        w=w,
+        value=jnp.asarray(f),
+        grad_norm=jnp.linalg.norm(g),
+        iterations=jnp.asarray(it, jnp.int32),
+        converged=jnp.asarray(
+            reason in (
+                ConvergenceReason.GRADIENT_TOLERANCE,
+                ConvergenceReason.FUNCTION_VALUES_TOLERANCE,
+            )
+        ),
+        reason=jnp.asarray(reason, jnp.int32),
+        history_value=jnp.asarray(hv),
+        history_grad_norm=jnp.asarray(hg),
+        history_valid=jnp.asarray(hvalid),
+    )
+
+
+class LibsvmFileSource:
+    """Streamed LIBSVM input: one chunk per file, re-parsed each pass.
+
+    A cheap metadata scan (native parser) fixes the global feature
+    dimension and nonzero capacity up front so every chunk shares one
+    padded layout (one XLA program).  Each objective evaluation then
+    re-streams the files — the disk-persisted-RDD behavior of the
+    reference's scans, with parse/transfer overlapped via
+    :func:`stream_chunks`.
+    """
+
+    def __init__(
+        self,
+        files: Sequence[str],
+        intercept: bool = True,
+        binary_labels: bool = True,
+    ):
+        from photon_tpu.data.libsvm import parse_libsvm
+
+        if not files:
+            raise ValueError("LibsvmFileSource needs at least one file")
+        self.files = list(files)
+        self.intercept = intercept
+        self.binary_labels = binary_labels
+        # Metadata scan: global dim + max row nnz (+1 for the intercept).
+        dim, capacity, total = 0, 1, 0
+        for f in self.files:
+            data = parse_libsvm(f)
+            dim = max(dim, data.dim)
+            if data.rows:
+                capacity = max(capacity, max(len(r[0]) for r in data.rows))
+            total += data.num_examples
+        self.feature_dim = dim
+        self.capacity = capacity + (1 if intercept else 0)
+        self.num_examples = total
+        self.dim = dim + (1 if intercept else 0)
+
+    def _load_chunk(self, i: int) -> SparseBatch:
+        from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
+
+        data = parse_libsvm(self.files[i])
+        # self.capacity already counts the appended intercept column; the
+        # padding in to_sparse_batch applies after that append.
+        batch, _ = to_sparse_batch(
+            data,
+            dim=self.feature_dim,
+            intercept=self.intercept,
+            capacity=self.capacity,
+            binary_labels=self.binary_labels,
+        )
+        return batch
+
+    def chunk_iter_factory(self) -> Iterable[SparseBatch]:
+        return stream_chunks(self._load_chunk, len(self.files))
+
+
+# ---------------------------------------------------------------------------
+# Multi-host assembly
+# ---------------------------------------------------------------------------
+
+
+def make_global_batch(local_batch: SparseBatch, mesh, axis: str = "data"):
+    """Assemble per-process local rows into one globally-sharded batch
+    (``jax.make_array_from_process_local_data`` over the mesh's data axis —
+    the multi-host path SURVEY.md §7 names).  Single-process meshes reduce
+    to a plain shard placement."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def build(leaf):
+        sharding = NamedSharding(
+            mesh, P(axis, *([None] * (leaf.ndim - 1)))
+        )
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(leaf)
+        )
+
+    return SparseBatch(*(build(leaf) for leaf in local_batch))

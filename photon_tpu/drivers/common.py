@@ -138,6 +138,60 @@ def scores_on(batch, model) -> np.ndarray:
     return np.asarray(model.compute_score(batch))
 
 
+def select_and_save_sweep(
+    sweep: list, evaluators, has_validation: bool, index_map, args, logger,
+    extra_summary: Optional[dict] = None,
+) -> dict:
+    """Shared tail of the GLM training drivers: pick the best lambda (by
+    primary evaluator, falling back to final objective value), save model
+    file(s) + feature index, and write training_summary.json."""
+    import json
+
+    from photon_tpu.data.model_io import save_glm_model
+
+    primary = evaluators.primary
+    if has_validation:
+        best = sweep[0]
+        for entry in sweep[1:]:
+            if primary.better_than(
+                entry["metrics"][primary.name], best["metrics"][primary.name]
+            ):
+                best = entry
+    else:
+        best = min(sweep, key=lambda e: e["final_value"])
+
+    with logger.timed("save-models"):
+        index_map.save(os.path.join(args.output_dir, "feature_index.json"))
+        ext = "avro" if args.model_format == "avro" else "json"
+        save_glm_model(
+            os.path.join(args.output_dir, f"best_model.{ext}"),
+            best["model"], index_map, fmt=args.model_format,
+        )
+        if args.save_all_models:
+            for entry in sweep:
+                save_glm_model(
+                    os.path.join(
+                        args.output_dir, f"model_lambda_{entry['lambda']:g}.{ext}"
+                    ),
+                    entry["model"], index_map, fmt=args.model_format,
+                )
+        summary_payload = {
+            "task": args.task,
+            "best_lambda": best["lambda"],
+            "sweep": [
+                {k: v for k, v in entry.items() if k != "model"}
+                for entry in sweep
+            ],
+            "phase_times": logger.phase_times,
+            **(extra_summary or {}),
+        }
+        with open(os.path.join(args.output_dir, "training_summary.json"), "w") as f:
+            json.dump(summary_payload, f, indent=1)
+    logger.info("best lambda=%g -> %s/best_model.%s",
+                best["lambda"], args.output_dir, ext)
+    return summary_payload
+
+
 def build_flat_evaluators(spec: str, driver_kind: str):
     """Build a MultiEvaluator from a comma-separated ``--evaluators`` spec,
     rejecting sharded (per-entity) evaluators up front — LIBSVM/synthetic

@@ -17,7 +17,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import time
 
@@ -55,11 +54,126 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-format", default="avro", choices=("avro", "json"))
     p.add_argument("--save-all-models", action="store_true",
                    help="write every sweep model, not just the best")
+    p.add_argument("--stream", action="store_true",
+                   help="host-streamed training for data beyond device "
+                   "memory: --input is a glob/dir of LIBSVM files, each "
+                   "re-streamed per objective evaluation (lbfgs only)")
     return p
+
+
+def _run_streaming(args: argparse.Namespace) -> dict:
+    """Host-streamed lambda sweep (data beyond device memory; lbfgs)."""
+    import glob as globmod
+
+    import jax
+
+    from photon_tpu.core.losses import BINARY_TASKS
+    from photon_tpu.core.objective import GlmObjective, RegularizationContext
+    from photon_tpu.core.optimizers import OptimizationStatesTracker, OptimizerConfig
+    from photon_tpu.data.index_map import IndexMap, feature_key
+    from photon_tpu.data.streaming import (
+        LibsvmFileSource,
+        StreamingObjective,
+        shard_files_for_process,
+        streaming_lbfgs,
+    )
+    from photon_tpu.evaluation.evaluators import (
+        MultiEvaluator,
+        default_evaluators_for_task,
+    )
+    from photon_tpu.models.glm import Coefficients, model_for_task
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.train", args.log_file)
+    os.makedirs(args.output_dir, exist_ok=True)
+    if args.normalization != "none":
+        raise ValueError("--stream does not support --normalization")
+    if args.optimizer != "lbfgs" or args.reg_type in ("l1", "elastic_net"):
+        raise ValueError("--stream supports the lbfgs optimizer with l2/none "
+                         "regularization")
+
+    if os.path.isdir(args.input):
+        files = sorted(
+            os.path.join(args.input, f) for f in os.listdir(args.input)
+            if not f.startswith((".", "_"))
+        )
+    else:
+        files = sorted(globmod.glob(args.input)) or [args.input]
+    files = shard_files_for_process(files)
+    with logger.timed("scan-metadata"):
+        source = LibsvmFileSource(
+            files, intercept=args.intercept,
+            binary_labels=args.task in BINARY_TASKS,
+        )
+    logger.info(
+        "streaming %d files, %d rows, dim %d, nnz capacity %d",
+        len(files), source.num_examples, source.dim, source.capacity,
+    )
+    val_batch = common.load_validation(
+        args.validation_input, source.dim, args.intercept, args.task
+    )
+    if args.evaluators:
+        evaluators = common.build_flat_evaluators(args.evaluators, "training")
+    else:
+        evaluators = MultiEvaluator(default_evaluators_for_task(args.task))
+
+    opt_config = OptimizerConfig(
+        max_iterations=args.max_iterations, tolerance=args.tolerance
+    )
+    # Multi-process runs: each host streams its file shard; gradients sum
+    # across hosts so every process optimizes the GLOBAL objective.
+    all_reduce = None
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        def all_reduce(x):
+            return multihost_utils.process_allgather(x).sum(axis=0)
+
+    sweep = []
+    for lam in common.parse_weights_list(args.reg_weights):
+        reg = RegularizationContext(args.reg_type, lam, args.elastic_net_alpha)
+        objective = StreamingObjective(
+            GlmObjective.create(args.task, reg), source.chunk_iter_factory,
+            all_reduce=all_reduce,
+        )
+        with logger.timed(f"train-lambda-{lam}"):
+            t0 = time.monotonic()
+            result = streaming_lbfgs(
+                objective, jnp.zeros(source.dim, jnp.float32), opt_config
+            )
+            jax.block_until_ready(result.w)
+            wall = time.monotonic() - t0
+        tracker = OptimizationStatesTracker(result, wall)
+        logger.info("lambda=%g %s", lam, tracker.summary().splitlines()[0])
+        model = model_for_task(args.task, Coefficients(result.w))
+        metrics = {}
+        if val_batch is not None:
+            scores = common.scores_on(val_batch, model)
+            metrics = evaluators.evaluate(
+                scores, np.asarray(val_batch.label), np.asarray(val_batch.weight)
+            )
+            logger.info("lambda=%g validation %s", lam, metrics)
+        sweep.append({
+            "lambda": lam, "model": model, "metrics": metrics,
+            "iterations": tracker.iterations,
+            "convergence_reason": tracker.convergence_reason,
+            "wall_time_s": wall, "final_value": float(result.value),
+        })
+
+    index_map = IndexMap.build(
+        [feature_key(f"f{i}") for i in range(source.feature_dim)],
+        intercept=args.intercept,
+    )
+    return common.select_and_save_sweep(
+        sweep, evaluators, val_batch is not None, index_map, args, logger,
+        extra_summary={"optimizer": "lbfgs", "streaming": True},
+    )
 
 
 def run(args: argparse.Namespace) -> dict:
     common.select_backend(args.backend)
+    if getattr(args, "stream", False):
+        return _run_streaming(args)
     # Imports after backend pinning (device init happens on first jax use).
     import jax
 
@@ -68,7 +182,6 @@ def run(args: argparse.Namespace) -> dict:
     from photon_tpu.core.optimizers import OptimizationStatesTracker, OptimizerConfig
     from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
     from photon_tpu.core.stats import BasicStatisticalSummary
-    from photon_tpu.data.model_io import save_glm_model
     from photon_tpu.evaluation.evaluators import (
         MultiEvaluator,
         default_evaluators_for_task,
@@ -176,49 +289,10 @@ def run(args: argparse.Namespace) -> dict:
             }
         )
 
-    # Best-model selection by the primary evaluator (falls back to final
-    # objective value when there is no validation set).
-    primary = evaluators.primary
-    if val_batch is not None:
-        best = sweep[0]
-        for entry in sweep[1:]:
-            if primary.better_than(
-                entry["metrics"][primary.name], best["metrics"][primary.name]
-            ):
-                best = entry
-    else:
-        best = min(sweep, key=lambda e: e["final_value"])
-
-    with logger.timed("save-models"):
-        index_map.save(os.path.join(args.output_dir, "feature_index.json"))
-        ext = "avro" if args.model_format == "avro" else "json"
-        save_glm_model(
-            os.path.join(args.output_dir, f"best_model.{ext}"),
-            best["model"], index_map, fmt=args.model_format,
-        )
-        if args.save_all_models:
-            for entry in sweep:
-                save_glm_model(
-                    os.path.join(
-                        args.output_dir, f"model_lambda_{entry['lambda']:g}.{ext}"
-                    ),
-                    entry["model"], index_map, fmt=args.model_format,
-                )
-        summary_payload = {
-            "task": args.task,
-            "optimizer": optimizer,
-            "best_lambda": best["lambda"],
-            "sweep": [
-                {k: v for k, v in entry.items() if k != "model"}
-                for entry in sweep
-            ],
-            "phase_times": logger.phase_times,
-        }
-        with open(os.path.join(args.output_dir, "training_summary.json"), "w") as f:
-            json.dump(summary_payload, f, indent=1)
-    logger.info("best lambda=%g -> %s/best_model.%s",
-                best["lambda"], args.output_dir, ext)
-    return summary_payload
+    return common.select_and_save_sweep(
+        sweep, evaluators, val_batch is not None, index_map, args, logger,
+        extra_summary={"optimizer": optimizer},
+    )
 
 
 def main(argv=None) -> None:

@@ -52,6 +52,38 @@ def pallas_enabled() -> bool:
     return os.environ.get("PHOTON_TPU_PALLAS", "") not in ("", "0")
 
 
+_KERNEL_SUPPORTED: Optional[bool] = None
+
+
+def kernel_supported() -> bool:
+    """One-time eager capability probe: can Mosaic lower the fused kernel
+    on this backend?  A try/except around the traced call cannot catch
+    lowering failures (they surface when the ENCLOSING jit compiles, e.g.
+    inside the optimizer's while_loop), so the decision must be made
+    eagerly, once, before any tracing routes through the kernel."""
+    global _KERNEL_SUPPORTED
+    if _KERNEL_SUPPORTED is None:
+        from photon_tpu.core.losses import get_loss
+
+        try:
+            args = (
+                get_loss("logistic"),
+                jnp.zeros(8, jnp.float32),
+                jnp.zeros((8, 2), jnp.int32),
+                jnp.zeros((8, 2), jnp.float32),
+                jnp.zeros(8, jnp.float32),
+                jnp.zeros(8, jnp.float32),
+                jnp.ones(8, jnp.float32),
+            )
+            # .lower().compile() exercises the full Mosaic pipeline without
+            # polluting the ambient trace (fused_value_and_grad is jitted).
+            fused_value_and_grad.lower(*args).compile()
+            _KERNEL_SUPPORTED = True
+        except Exception:
+            _KERNEL_SUPPORTED = False
+    return _KERNEL_SUPPORTED
+
+
 def _kernel(loss: PointwiseLoss, w_ref, ids_ref, vals_ref, y_ref, off_ref,
             wt_ref, val_ref, grad_ref):
     """One row block: fused margin -> loss/dz -> loss sum + grad scatter."""

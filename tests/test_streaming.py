@@ -1,0 +1,185 @@
+"""Large-scale input pipeline tests (SURVEY.md §7 step 7): chunked in-HBM
+folds, host streaming with prefetch, file sharding, multi-host assembly."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.optimizers.lbfgs import lbfgs
+from photon_tpu.data.batch import SparseBatch
+from photon_tpu.data.streaming import (
+    ChunkedGlmObjective,
+    LibsvmFileSource,
+    StreamingObjective,
+    chunk_batch,
+    make_global_batch,
+    shard_files_for_process,
+    stream_chunks,
+    streaming_lbfgs,
+)
+
+
+def _sparse_data(n=900, k=5, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, d, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    w_true = (rng.standard_normal(d) * 0.4).astype(np.float32)
+    m = (w_true[ids] * vals).sum(1)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-m))).astype(np.float32)
+    return SparseBatch(
+        jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(y),
+        jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32),
+    )
+
+
+def test_chunked_objective_matches_flat():
+    batch = _sparse_data()
+    chunks = chunk_batch(batch, rows_per_chunk=128)
+    assert chunks.num_chunks == 8  # ceil(900/128), padded
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.5))
+    cobj = ChunkedGlmObjective(obj)
+    w = jnp.asarray(np.random.default_rng(1).standard_normal(64), jnp.float32)
+    v1, g1 = obj.value_and_grad(w, batch)
+    v2, g2 = cobj.value_and_grad(w, chunks)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(obj.value(w, batch)), float(cobj.value(w, chunks)), rtol=1e-5)
+    v = jnp.ones(64, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(obj.hessian_vector(w, v, batch)),
+        np.asarray(cobj.hessian_vector(w, v, chunks)),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(obj.hessian_diagonal(w, batch)),
+        np.asarray(cobj.hessian_diagonal(w, chunks)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_chunked_objective_full_fit_matches():
+    """The chunked objective slots into the jitted L-BFGS unchanged."""
+    batch = _sparse_data(seed=2)
+    chunks = chunk_batch(batch, rows_per_chunk=256)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    cobj = ChunkedGlmObjective(obj)
+    config = OptimizerConfig(max_iterations=40)
+    w0 = jnp.zeros(64, jnp.float32)
+    r1 = lbfgs(lambda w: obj.value_and_grad(w, batch), w0, config)
+    r2 = lbfgs(lambda w: cobj.value_and_grad(w, chunks), w0, config)
+    np.testing.assert_allclose(float(r1.value), float(r2.value), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(r1.w), np.asarray(r2.w), rtol=1e-2, atol=1e-3)
+
+
+def test_stream_chunks_order_and_prefetch():
+    seen = []
+
+    def load(i):
+        seen.append(i)
+        return jnp.full((2,), float(i))
+
+    out = list(stream_chunks(load, 5, prefetch=2))
+    assert [int(o[0]) for o in out] == [0, 1, 2, 3, 4]
+    assert seen == [0, 1, 2, 3, 4]
+
+
+def test_stream_chunks_propagates_worker_error():
+    def load(i):
+        if i == 2:
+            raise RuntimeError("disk error")
+        return jnp.zeros(1)
+
+    with pytest.raises(RuntimeError, match="disk error"):
+        list(stream_chunks(load, 4))
+
+
+def test_shard_files_for_process():
+    files = [f"part-{i:03d}" for i in range(10)]
+    shards = [shard_files_for_process(files, p, 3) for p in range(3)]
+    assert sorted(sum(shards, [])) == files
+    assert abs(len(shards[0]) - len(shards[2])) <= 1
+    assert shard_files_for_process(files, 0, 1) == files
+
+
+def _write_files(tmp_path, n_files=3, rows=120, d=40, seed=0):
+    from photon_tpu.data.synthetic import make_glm_data, write_libsvm
+
+    paths = []
+    full_x, full_y = [], []
+    for i in range(n_files):
+        b, _ = make_glm_data(rows, d, seed=seed + i, weight_seed=7)
+        x = np.asarray(b.x)[:, :-1]
+        y = np.asarray(b.label)
+        p = str(tmp_path / f"part-{i}.libsvm")
+        write_libsvm(p, x, y)
+        paths.append(p)
+        full_x.append(x)
+        full_y.append(y)
+    return paths, np.concatenate(full_x), np.concatenate(full_y)
+
+
+def test_streaming_lbfgs_matches_in_memory(tmp_path):
+    paths, x, y = _write_files(tmp_path)
+    source = LibsvmFileSource(paths)
+    assert source.num_examples == len(y)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    sobj = StreamingObjective(obj, source.chunk_iter_factory)
+    config = OptimizerConfig(max_iterations=40)
+    result = streaming_lbfgs(sobj, jnp.zeros(source.dim, jnp.float32), config)
+    assert bool(result.converged)
+
+    # In-memory reference on the concatenated data.
+    from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
+
+    batches = [parse_libsvm(p) for p in paths]
+    rows = [r for b in batches for r in b.rows]
+    labels = np.concatenate([b.labels for b in batches])
+    from photon_tpu.data.libsvm import LibsvmData
+
+    flat, dim = to_sparse_batch(
+        LibsvmData(rows, labels, max(b.dim for b in batches)),
+        capacity=source.capacity,
+    )
+    r_ref = lbfgs(lambda w: obj.value_and_grad(w, flat),
+                  jnp.zeros(dim, jnp.float32), config)
+    np.testing.assert_allclose(float(result.value), float(r_ref.value), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(result.w), np.asarray(r_ref.w), rtol=5e-2, atol=5e-3
+    )
+
+
+def test_streaming_train_driver(tmp_path):
+    paths, _, _ = _write_files(tmp_path, n_files=2, rows=150)
+    from photon_tpu.drivers import train
+
+    out = str(tmp_path / "out")
+    summary = train.run(train.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", str(tmp_path / "part-*.libsvm"),
+        "--stream",
+        "--validation-input", "synthetic:logistic_regression:200:40:5:7",
+        "--max-iterations", "30",
+        "--output-dir", out,
+    ]))
+    assert summary["streaming"] is True
+    assert os.path.exists(os.path.join(out, "best_model.avro"))
+    assert summary["sweep"][0]["metrics"]["AUC"] > 0.6
+
+
+def test_make_global_batch_single_process():
+    from photon_tpu.parallel import create_mesh
+
+    batch = _sparse_data(n=64)
+    mesh = create_mesh()
+    global_batch = make_global_batch(batch, mesh)
+    np.testing.assert_array_equal(np.asarray(global_batch.ids), np.asarray(batch.ids))
+    obj = GlmObjective.create("logistic")
+    w = jnp.zeros(64, jnp.float32)
+    v1, _ = obj.value_and_grad(w, batch)
+    v2, _ = obj.value_and_grad(w, global_batch)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
