@@ -145,7 +145,9 @@ class GlmObjective:
             # failures, which surface when the enclosing jit (the
             # optimizer's while_loop) compiles.  On v5e Mosaic lacks vector
             # scatter-add, so this routes back to XLA there.
-            if pallas_enabled() and kernel_supported():
+            if pallas_enabled() and kernel_supported(
+                self.loss, int(batch.ids.shape[1])
+            ):
                 v, g = fused_value_and_grad(
                     self.loss, w, batch.ids, batch.vals,
                     batch.label, batch.offset, batch.weight,
@@ -195,6 +197,42 @@ class GlmObjective:
         if factors is not None:
             diag = diag * factors * factors
         return diag + self.l2_weight
+
+    def hessian_matrix(self, w: Array, batch: Batch) -> Array:
+        """Full Hessian ``H = Xᵀ diag(weight·d2) X + l2·I`` (the reference's
+        HessianMatrixAggregator; used by VarianceComputationType.FULL).
+        Feasible for modest dims — per-entity random effects and small
+        fixed effects.  Under normalization the Hessian is taken in the
+        normalized feature space (matching hessian_diagonal), expanded as
+        ``F (A - B sᵀ - s Bᵀ + C s sᵀ) F`` with ``A = Xᵀ D X``,
+        ``B = Xᵀ D 1``, ``C = Σ D`` so sparse batches stay sparse."""
+        z = self._margins(w, batch)
+        d2w = batch.weight * self.loss.d2(z, batch.label)
+        d = w.shape[0]
+        if isinstance(batch, DenseBatch):
+            a = jnp.einsum("ni,n,nj->ij", batch.x, d2w, batch.x)
+            b = batch.x.T @ d2w
+        else:
+            c_i = d2w[:, None, None] * batch.vals[:, :, None] * batch.vals[:, None, :]
+            a = jnp.zeros((d, d), w.dtype).at[
+                batch.ids[:, :, None], batch.ids[:, None, :]
+            ].add(c_i)
+            b = jnp.zeros(d, w.dtype).at[batch.ids].add(d2w[:, None] * batch.vals)
+        h = a
+        norm = self.normalization
+        if norm is not None:
+            shifts = norm.shifts
+            if shifts is not None:
+                c = jnp.sum(d2w)
+                h = (
+                    h
+                    - b[:, None] * shifts[None, :]
+                    - shifts[:, None] * b[None, :]
+                    + c * shifts[:, None] * shifts[None, :]
+                )
+            factors = norm.factors_or_ones(d)
+            h = h * factors[:, None] * factors[None, :]
+        return h + self.l2_weight * jnp.eye(d, dtype=w.dtype)
 
     # -- prediction ------------------------------------------------------------
     def predict_mean(self, w: Array, batch: Batch) -> Array:

@@ -27,7 +27,7 @@ from photon_tpu.models.glm import Coefficients
 
 Array = jax.Array
 
-VARIANCE_TYPES = ("none", "simple")
+VARIANCE_TYPES = ("none", "simple", "full")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,9 +98,20 @@ class GlmOptimizationProblem:
         return coefficients, result
 
     def compute_variances(self, w: Array, batch: Batch) -> Optional[Array]:
-        """SIMPLE variance: 1 / diag(H) at the optimum (SURVEY.md §2.2
-        'L2 + variance')."""
-        if self.config.variance_computation == "none":
+        """Per-coefficient posterior variances at the optimum (SURVEY.md
+        §2.2 'L2 + variance'): SIMPLE = 1/diag(H); FULL = diag(H⁻¹) via a
+        Cholesky solve of the full Hessian (reference's
+        VarianceComputationType)."""
+        kind = self.config.variance_computation
+        if kind == "none":
             return None
+        if kind == "full":
+            h = self.objective.hessian_matrix(w, batch)
+            d = h.shape[0]
+            # Tiny jitter keeps the factorization defined for flat
+            # directions (e.g. unreached features with zero curvature).
+            chol = jax.scipy.linalg.cho_factor(h + 1e-9 * jnp.eye(d, dtype=h.dtype))
+            inv = jax.scipy.linalg.cho_solve(chol, jnp.eye(d, dtype=h.dtype))
+            return jnp.maximum(jnp.diagonal(inv), 0.0)
         diag = self.objective.hessian_diagonal(w, batch)
         return 1.0 / jnp.maximum(diag, 1e-12)

@@ -170,6 +170,16 @@ class ChunkedGlmObjective:
         )
         return diag + self.objective.l2_weight
 
+    def hessian_matrix(self, w: Array, chunks: ChunkedBatch) -> Array:
+        d = w.shape[0]
+        eye = jnp.eye(d, dtype=w.dtype)
+        h = self._fold(
+            lambda w_, c: self.objective.hessian_matrix(w_, c)
+            - self.objective.l2_weight * eye,
+            w, chunks, jnp.zeros((d, d), w.dtype),
+        )
+        return h + self.objective.l2_weight * eye
+
 
 # ---------------------------------------------------------------------------
 # Tier 3: host streaming
@@ -395,6 +405,20 @@ def streaming_lbfgs(
     )
 
 
+def _scan_rows_nnz(path: str) -> tuple[int, int]:
+    """(row count, max nnz per row) without materializing values — the
+    metadata-only pass used when the feature dimension is already known."""
+    rows, max_nnz = 0, 0
+    with open(path, "rb") as f:
+        for raw in f:
+            line = raw.split(b"#", 1)[0].strip()
+            if not line:
+                continue
+            rows += 1
+            max_nnz = max(max_nnz, line.count(b":"))
+    return rows, max_nnz
+
+
 class LibsvmFileSource:
     """Streamed LIBSVM input: one chunk per file, re-parsed each pass.
 
@@ -411,26 +435,49 @@ class LibsvmFileSource:
         files: Sequence[str],
         intercept: bool = True,
         binary_labels: bool = True,
+        feature_dim: Optional[int] = None,
     ):
-        from photon_tpu.data.libsvm import parse_libsvm
+        """Metadata must cover the GLOBAL file list (multi-process runs
+        shard files AFTER construction via :meth:`with_files` — scanning a
+        local shard would give hosts divergent coefficient dimensions).
 
+        With ``feature_dim`` given (e.g. from a feature-indexing job's index
+        map), only a cheap row/nnz line scan runs; otherwise each file is
+        parsed once to discover the max feature id.
+        """
         if not files:
             raise ValueError("LibsvmFileSource needs at least one file")
         self.files = list(files)
         self.intercept = intercept
         self.binary_labels = binary_labels
-        # Metadata scan: global dim + max row nnz (+1 for the intercept).
-        dim, capacity, total = 0, 1, 0
-        for f in self.files:
-            data = parse_libsvm(f)
-            dim = max(dim, data.dim)
-            if data.rows:
-                capacity = max(capacity, max(len(r[0]) for r in data.rows))
-            total += data.num_examples
+        dim, capacity, total = feature_dim or 0, 1, 0
+        if feature_dim is None:
+            from photon_tpu.data.libsvm import parse_libsvm
+
+            for f in self.files:
+                data = parse_libsvm(f)
+                dim = max(dim, data.dim)
+                if data.rows:
+                    capacity = max(capacity, max(len(r[0]) for r in data.rows))
+                total += data.num_examples
+        else:
+            for f in self.files:
+                rows, max_nnz = _scan_rows_nnz(f)
+                capacity = max(capacity, max_nnz)
+                total += rows
         self.feature_dim = dim
         self.capacity = capacity + (1 if intercept else 0)
         self.num_examples = total
         self.dim = dim + (1 if intercept else 0)
+
+    def with_files(self, files: Sequence[str]) -> "LibsvmFileSource":
+        """Same (global) metadata, restricted stream list — each process
+        calls this with its shard from :func:`shard_files_for_process`."""
+        import copy
+
+        out = copy.copy(self)
+        out.files = list(files)
+        return out
 
     def _load_chunk(self, i: int) -> SparseBatch:
         from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch

@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--evaluators", default=None,
                    help="comma-separated evaluator names; default per task")
     p.add_argument("--variance-computation", default="none",
-                   choices=("none", "simple"))
+                   choices=("none", "simple", "full"))
     p.add_argument("--model-format", default="avro", choices=("avro", "json"))
     p.add_argument("--save-all-models", action="store_true",
                    help="write every sweep model, not just the best")
@@ -58,6 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host-streamed training for data beyond device "
                    "memory: --input is a glob/dir of LIBSVM files, each "
                    "re-streamed per objective evaluation (lbfgs only)")
+    p.add_argument("--feature-dim", type=int, default=None,
+                   help="with --stream: known feature dimension (e.g. from "
+                   "a feature-indexing run) — skips the full metadata "
+                   "parse in favor of a cheap row/nnz scan")
     return p
 
 
@@ -99,15 +103,18 @@ def _run_streaming(args: argparse.Namespace) -> dict:
         )
     else:
         files = sorted(globmod.glob(args.input)) or [args.input]
-    files = shard_files_for_process(files)
     with logger.timed("scan-metadata"):
+        # Metadata over the GLOBAL list (all hosts must agree on dim);
+        # each process then streams only its file shard.
         source = LibsvmFileSource(
             files, intercept=args.intercept,
             binary_labels=args.task in BINARY_TASKS,
-        )
+            feature_dim=args.feature_dim,
+        ).with_files(shard_files_for_process(files))
     logger.info(
-        "streaming %d files, %d rows, dim %d, nnz capacity %d",
-        len(files), source.num_examples, source.dim, source.capacity,
+        "streaming %d of %d files, %d rows total, dim %d, nnz capacity %d",
+        len(source.files), len(files), source.num_examples, source.dim,
+        source.capacity,
     )
     val_batch = common.load_validation(
         args.validation_input, source.dim, args.intercept, args.task
@@ -164,6 +171,9 @@ def _run_streaming(args: argparse.Namespace) -> dict:
         [feature_key(f"f{i}") for i in range(source.feature_dim)],
         intercept=args.intercept,
     )
+    if jax.process_index() != 0:
+        # Every host trained the same global model; only rank 0 writes.
+        return {"streaming": True, "rank": jax.process_index()}
     return common.select_and_save_sweep(
         sweep, evaluators, val_batch is not None, index_map, args, logger,
         extra_summary={"optimizer": "lbfgs", "streaming": True},

@@ -52,25 +52,24 @@ def pallas_enabled() -> bool:
     return os.environ.get("PHOTON_TPU_PALLAS", "") not in ("", "0")
 
 
-_KERNEL_SUPPORTED: Optional[bool] = None
+_KERNEL_SUPPORTED: dict = {}
 
 
-def kernel_supported() -> bool:
-    """One-time eager capability probe: can Mosaic lower the fused kernel
-    on this backend?  A try/except around the traced call cannot catch
-    lowering failures (they surface when the ENCLOSING jit compiles, e.g.
-    inside the optimizer's while_loop), so the decision must be made
-    eagerly, once, before any tracing routes through the kernel."""
-    global _KERNEL_SUPPORTED
-    if _KERNEL_SUPPORTED is None:
-        from photon_tpu.core.losses import get_loss
-
+def kernel_supported(loss: PointwiseLoss, nnz_capacity: int) -> bool:
+    """Eager capability probe, cached per (loss, nnz capacity): can Mosaic
+    lower the fused kernel for THIS loss and row layout?  A try/except
+    around the traced call cannot catch lowering failures (they surface
+    when the ENCLOSING jit compiles, e.g. inside the optimizer's
+    while_loop), and support is shape-dependent across TPU generations —
+    so probe the configuration actually about to run, eagerly, once."""
+    key = (loss.name, nnz_capacity)
+    if key not in _KERNEL_SUPPORTED:
         try:
             args = (
-                get_loss("logistic"),
+                loss,
                 jnp.zeros(8, jnp.float32),
-                jnp.zeros((8, 2), jnp.int32),
-                jnp.zeros((8, 2), jnp.float32),
+                jnp.zeros((8, nnz_capacity), jnp.int32),
+                jnp.zeros((8, nnz_capacity), jnp.float32),
                 jnp.zeros(8, jnp.float32),
                 jnp.zeros(8, jnp.float32),
                 jnp.ones(8, jnp.float32),
@@ -78,10 +77,10 @@ def kernel_supported() -> bool:
             # .lower().compile() exercises the full Mosaic pipeline without
             # polluting the ambient trace (fused_value_and_grad is jitted).
             fused_value_and_grad.lower(*args).compile()
-            _KERNEL_SUPPORTED = True
+            _KERNEL_SUPPORTED[key] = True
         except Exception:
-            _KERNEL_SUPPORTED = False
-    return _KERNEL_SUPPORTED
+            _KERNEL_SUPPORTED[key] = False
+    return _KERNEL_SUPPORTED[key]
 
 
 def _kernel(loss: PointwiseLoss, w_ref, ids_ref, vals_ref, y_ref, off_ref,

@@ -97,6 +97,27 @@ class DistributedGlmObjective:
 
         return _hd(w, batch) + l2
 
+    def hessian_matrix(self, w: Array, batch: Batch) -> Array:
+        """Full Hessian: psum of per-shard ``Xᵀ D X`` blocks + l2·I once
+        (the treeAggregate of HessianMatrixAggregator — SURVEY.md §2.2)."""
+        ax = self.axis_name
+        l2 = self.obj.l2_weight
+        d = w.shape[0]
+
+        @partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(), self._batch_specs(batch)),
+            out_specs=P(),
+        )
+        def _hm(w, local):
+            local_h = self.obj.hessian_matrix(w, local) - l2 * jnp.eye(
+                d, dtype=w.dtype
+            )
+            return lax.psum(local_h, ax)
+
+        return _hm(w, batch) + l2 * jnp.eye(d, dtype=w.dtype)
+
     # -- optimizer binding ----------------------------------------------------
     def bind(self, batch: Batch) -> Callable[[Array], tuple[Array, Array]]:
         return lambda w: self.value_and_grad(w, batch)

@@ -153,6 +153,22 @@ def test_streaming_lbfgs_matches_in_memory(tmp_path):
     )
 
 
+def test_source_with_files_and_known_dim(tmp_path):
+    """Global metadata + per-process file restriction; known feature_dim
+    skips the full parse but yields identical layout."""
+    paths, _, _ = _write_files(tmp_path)
+    full = LibsvmFileSource(paths)
+    fast = LibsvmFileSource(paths, feature_dim=full.feature_dim)
+    assert fast.dim == full.dim
+    assert fast.capacity == full.capacity
+    assert fast.num_examples == full.num_examples
+    shard = full.with_files(paths[:1])
+    assert shard.dim == full.dim  # metadata survives restriction
+    chunks = list(shard.chunk_iter_factory())
+    assert len(chunks) == 1
+    assert chunks[0].ids.shape[1] == full.capacity
+
+
 def test_streaming_train_driver(tmp_path):
     paths, _, _ = _write_files(tmp_path, n_files=2, rows=150)
     from photon_tpu.drivers import train

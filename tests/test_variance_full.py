@@ -1,0 +1,137 @@
+"""FULL variance computation tests (reference: VarianceComputationType
+NONE/SIMPLE/FULL — SURVEY.md §2.2 'L2 + variance')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.core.normalization import NormalizationContext
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
+from photon_tpu.data.batch import SparseBatch, dense_batch
+from photon_tpu.data.synthetic import make_glm_data
+
+
+def _sparse(n=300, k=4, d=24, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    return SparseBatch(
+        jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(y),
+        jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("kind", ["dense", "sparse"])
+def test_hessian_matrix_matches_autodiff(kind):
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.7))
+    if kind == "dense":
+        batch, _ = make_glm_data(200, 12, seed=1)
+        d = 12
+    else:
+        batch = _sparse(d=16)
+        d = 16
+    w = jnp.asarray(np.random.default_rng(2).standard_normal(d) * 0.3, jnp.float32)
+    h = obj.hessian_matrix(w, batch)
+    h_ref = jax.hessian(obj.value)(w, batch)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref), rtol=1e-3, atol=1e-3)
+
+
+def test_hessian_matrix_under_normalization():
+    batch, _ = make_glm_data(150, 8, seed=3)
+    from photon_tpu.core.stats import BasicStatisticalSummary
+
+    summary = BasicStatisticalSummary.from_batch(batch, 8)
+    norm = NormalizationContext.build(
+        "standardization", summary, intercept_id=7
+    )
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.5),
+                              normalization=norm)
+    w = jnp.asarray(np.random.default_rng(4).standard_normal(8) * 0.2, jnp.float32)
+    h = obj.hessian_matrix(w, batch)
+    h_ref = jax.hessian(obj.value)(w, batch)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref), rtol=1e-3, atol=1e-3)
+
+
+def test_full_variance_is_diag_of_inverse_hessian():
+    batch = _sparse(d=20, seed=5)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    problem = GlmOptimizationProblem(
+        obj,
+        ProblemConfig(
+            optimizer_config=OptimizerConfig(max_iterations=30),
+            regularization=RegularizationContext("l2", 1.0),
+            variance_computation="full",
+        ),
+    )
+    coeffs, result = problem.run(batch, jnp.zeros(20, jnp.float32))
+    assert coeffs.variances is not None
+    h = np.asarray(obj.hessian_matrix(coeffs.means, batch))
+    expected = np.diag(np.linalg.inv(h))
+    np.testing.assert_allclose(
+        np.asarray(coeffs.variances), expected, rtol=1e-3, atol=1e-5
+    )
+    # FULL >= off-diagonal-blind SIMPLE is not guaranteed, but both must be
+    # positive and finite.
+    assert np.all(np.asarray(coeffs.variances) > 0)
+
+
+def test_full_variance_distributed_matches_single():
+    from photon_tpu.parallel import DistributedGlmObjective, create_mesh, shard_batch
+
+    batch = _sparse(n=320, d=16, seed=6)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    mesh = create_mesh()
+    sharded = shard_batch(batch, mesh)
+    dobj = DistributedGlmObjective(obj, mesh)
+    w = jnp.asarray(np.random.default_rng(7).standard_normal(16) * 0.2, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(dobj.hessian_matrix(w, sharded)),
+        np.asarray(obj.hessian_matrix(w, batch)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_chunked_hessian_matrix_matches():
+    from photon_tpu.data.streaming import ChunkedGlmObjective, chunk_batch
+
+    batch = _sparse(n=300, d=16, seed=8)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.3))
+    cobj = ChunkedGlmObjective(obj)
+    chunks = chunk_batch(batch, 64)
+    w = jnp.asarray(np.random.default_rng(9).standard_normal(16) * 0.2, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(cobj.hessian_matrix(w, chunks)),
+        np.asarray(obj.hessian_matrix(w, batch)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_game_random_effect_full_variance():
+    """Per-entity FULL variances through the vmapped solver."""
+    from photon_tpu.data.synthetic import make_game_dataset
+    from photon_tpu.game.coordinate import (
+        RandomEffectCoordinate,
+        RandomEffectCoordinateConfig,
+    )
+
+    data, _ = make_game_dataset(15, 3, 6, 4, seed=2)
+    config = RandomEffectCoordinateConfig(
+        shard_name="re0",
+        entity_column="re0",
+        problem=ProblemConfig(
+            regularization=RegularizationContext("l2", 1.0),
+            optimizer_config=OptimizerConfig(max_iterations=15),
+            variance_computation="full",
+        ),
+    )
+    coord = RandomEffectCoordinate(data, config, "logistic_regression")
+    model, stats = coord.train(np.zeros(data.num_examples, np.float32))
+    assert model.variances is not None
+    v = np.asarray(model.variances)
+    assert np.all(np.isfinite(v)) and np.all(v >= 0)
+    # Entities with data have strictly positive variances (l2 bounds them).
+    assert v.max() > 0
