@@ -1,0 +1,100 @@
+"""Feature indexing driver (the reference's ``FeatureIndexingDriver``).
+
+A standalone job (SURVEY.md §2.3 'Feature indexing job') that scans Avro
+training data once, builds the (name, term) -> id map per feature bag, and
+writes them for later training/scoring runs — the reference materializes
+PalDB stores consumed executor-side; here the output is the JSON index
+format plus, optionally, the native mmap store
+(photon_tpu.data.index_map.OffHeapIndexMap) for vocabularies that should
+not live in process memory.
+
+    python -m photon_tpu.drivers.index_features \\
+        --input 'train/*.avro' \\
+        --feature-bags global=features,per_user=userFeatures \\
+        --output-dir maps [--store mmap]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from photon_tpu.drivers import common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon_tpu.drivers.index_features", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--input", required=True,
+                   help="Avro training data: file, directory, or glob")
+    p.add_argument("--feature-bags", required=True,
+                   help="shard=recordField pairs, comma separated")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--intercept", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--store", default="json", choices=("json", "mmap"),
+                   help="mmap additionally writes the native off-heap store "
+                   "(PalDB equivalent)")
+    p.add_argument("--log-file", default=None)
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    from photon_tpu.data import avro_codec
+    from photon_tpu.data.game_io import _input_files
+    from photon_tpu.data.index_map import INTERCEPT_KEY, IndexMap, feature_key
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.index_features", args.log_file)
+    os.makedirs(args.output_dir, exist_ok=True)
+    bags = dict(tok.split("=", 1) for tok in args.feature_bags.split(","))
+
+    key_order: dict[str, dict] = {shard: {} for shard in bags}
+    n_records = 0
+    with logger.timed("scan"):
+        for path in _input_files(args.input):
+            _, records = avro_codec.read_container(path)
+            n_records += len(records)
+            for rec in records:
+                for shard, field in bags.items():
+                    seen = key_order[shard]
+                    for ntv in rec.get(field, ()):
+                        key = feature_key(ntv["name"], ntv["term"])
+                        if key != INTERCEPT_KEY:  # implicit on read
+                            seen.setdefault(key, None)
+
+    summary = {"num_records": n_records, "shards": {}}
+    with logger.timed("write"):
+        for shard, seen in key_order.items():
+            imap = IndexMap.build(list(seen), intercept=args.intercept)
+            json_path = os.path.join(
+                args.output_dir, f"feature_index_{shard}.json"
+            )
+            imap.save(json_path)
+            entry = {"num_features": len(imap), "json": json_path}
+            if args.store == "mmap":
+                from photon_tpu.data.index_map import OffHeapIndexMap
+
+                store_path = os.path.join(
+                    args.output_dir, f"feature_index_{shard}.pixs"
+                )
+                OffHeapIndexMap.build_file(
+                    store_path, seen, intercept=args.intercept
+                ).close()
+                entry["mmap"] = store_path
+            summary["shards"][shard] = entry
+            logger.info("shard %s: %d features", shard, len(imap))
+    with open(os.path.join(args.output_dir, "indexing_summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    return summary
+
+
+def main(argv=None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

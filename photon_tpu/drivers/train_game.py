@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id-columns", default=None,
                    help="entity id columns to read, comma separated "
                    "(Avro input only)")
+    p.add_argument("--index-maps", default=None,
+                   help="directory of feature_index_<shard>.json maps from "
+                   "the index_features driver; features absent from a map "
+                   "are dropped (fixed-index training)")
     p.add_argument("--data-validation", default="error",
                    choices=("error", "warn", "off"),
                    help="row sanity checks before training (the reference's "
@@ -265,8 +269,22 @@ def run(args: argparse.Namespace) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
     specs = _coordinate_specs(args)
 
+    prebuilt_maps = None
+    if args.index_maps:
+        if not args.feature_bags:
+            raise ValueError("--index-maps needs --feature-bags")
+        from photon_tpu.data.index_map import IndexMap
+
+        bags = dict(tok.split("=", 1) for tok in args.feature_bags.split(","))
+        prebuilt_maps = {
+            shard: IndexMap.load(
+                os.path.join(args.index_maps, f"feature_index_{shard}.json")
+            )
+            for shard in bags
+        }
+
     with logger.timed("load-data"):
-        data, index_maps = _load_game_data(args.input, args)
+        data, index_maps = _load_game_data(args.input, args, index_maps=prebuilt_maps)
         val_data = None
         if args.validation_input:
             val_data, _ = _load_game_data(
