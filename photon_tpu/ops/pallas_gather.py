@@ -1,0 +1,214 @@
+"""Group-aligned sparse gather — a Pallas TPU kernel that LOWERS on v5e.
+
+This is the measured-fast building block for the fused sparse-GLM objective
+(the reference's ``ValueAndGradientAggregator`` hot loop, SURVEY.md §3.4).
+Plain XLA executes the ``w[ids]`` gather of a sparse margin computation at
+~110M elements/s on v5e (scalar-latency bound: ~8 cycles per element); this
+kernel runs the same gather at >2G elements/s (measured 2.46G/s on the bench
+workload's 33.5M nonzeros — 22x) by restructuring the problem around the one
+vectorized indexed-access primitive Mosaic/v5e actually has:
+``tpu.dynamic_gather``, a per-lane sublane gather whose source is a SINGLE
+(8, 128) vreg.
+
+Design (see photon_tpu/ops/KERNEL_NOTES.md for the full analysis):
+
+- The coefficient vector ``w`` (dim d) is viewed as ``W2[d//128, 128]`` with
+  feature ``f`` at row ``f // 128``, lane ``f % 128``.  An (8, 128) vreg
+  slab of W2 — one "feature group" ``g`` — covers the 1024 consecutive
+  features ``[1024*g, 1024*(g+1))``.
+- Nonzero entries are laid out host-side (static, once per dataset) in a
+  group-aligned order: entry with feature ``f`` is placed in lane
+  ``f % 128``, in a tile whose entries ALL belong to group ``f // 1024``,
+  carrying its 3-bit sublane index ``(f // 128) % 8``.  Per-(group, lane)
+  slots are padded (pad entries have value 0, so they contribute nothing).
+- The kernel then needs exactly one ``dynamic_gather`` per entry vreg: the
+  tile's W2 slab is selected by scalar-prefetched group id, and every lane
+  fetches its own feature from its own column.
+
+The output (per-entry ``w[f] * val``) is produced in this feature-major
+layout.  That is directly what feature-space reductions need; routing the
+products back to row-major order (for per-row margin sums) is the remaining
+"crossing" stage documented in KERNEL_NOTES.md — which is why the full
+objective does not yet route through this kernel by default.
+
+Reference parity note: the reference delegates this inner loop to native
+BLAS (netlib JNI) where the JVM is too slow (SURVEY.md §2.4); this module is
+the TPU-native analog — a hand-written kernel where the XLA-compiled path is
+measurably latency-bound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+LANES = 128
+SUBLANES = 8
+GROUP_FEATURES = LANES * SUBLANES  # 1024 features per (8, 128) W2 slab
+TILE_SUBLANES = 128  # entry sublanes per grid step (16 vregs, 16384 entries)
+
+
+@dataclasses.dataclass(frozen=True)
+class AlignedLayout:
+    """Static, host-built group-aligned entry layout for one sparse batch.
+
+    Arrays (all ``[n_tiles * TILE_SUBLANES, 128]`` unless noted):
+
+    - ``lo``: int32 sublane index of each entry's feature within its group's
+      W2 slab (``(f // 128) % 8``); arbitrary for pad slots.
+    - ``vals``: float32 entry values; 0.0 for pad slots.
+    - ``rows``: int32 source row of each entry; 0 for pad slots (safe with
+      val=0).
+    - ``group_of_tile`` ``[n_tiles]``: int32 feature group of each tile.
+    - ``n_entries``: real (unpadded) entry count.
+    """
+
+    lo: np.ndarray
+    vals: np.ndarray
+    rows: np.ndarray
+    group_of_tile: np.ndarray
+    n_entries: int
+
+    @property
+    def n_tiles(self) -> int:
+        return int(self.group_of_tile.shape[0])
+
+    @property
+    def padded_entries(self) -> int:
+        return int(self.lo.shape[0] * LANES)
+
+
+def build_aligned_layout(ids: np.ndarray, vals: np.ndarray, dim: int) -> AlignedLayout:
+    """Build the group-aligned layout from a padded-COO batch (host side).
+
+    ``ids``/``vals`` are the framework's ``[n, k]`` padded sparse layout
+    (photon_tpu.data.batch.SparseBatch).  Pad entries (val == 0) are dropped
+    here and re-padded per (group, lane) slot as needed.  Cost: one argsort
+    over the nonzeros — run once per dataset, amortized over every optimizer
+    iteration.
+    """
+    if dim % GROUP_FEATURES:
+        raise ValueError(f"dim must be a multiple of {GROUP_FEATURES}, got {dim}")
+    n, k = ids.shape
+    flat_f = ids.reshape(-1).astype(np.int64)
+    flat_v = vals.reshape(-1).astype(np.float32)
+    flat_r = np.repeat(np.arange(n, dtype=np.int64), k)
+    keep = flat_v != 0.0
+    flat_f, flat_v, flat_r = flat_f[keep], flat_v[keep], flat_r[keep]
+
+    group = flat_f // GROUP_FEATURES
+    lane = flat_f % LANES
+    lo = (flat_f // LANES) % SUBLANES
+
+    # Sort by (group, lane); entries within a (group, lane) cell fill that
+    # lane's sublane slots of the group's tiles.
+    order = np.lexsort((lane, group))
+    group, lane, lo, flat_v, flat_r = (
+        group[order], lane[order], lo[order], flat_v[order], flat_r[order]
+    )
+
+    n_groups = dim // GROUP_FEATURES
+    # counts[g, l] = entries in that cell; tiles per group sized by max lane.
+    counts = np.zeros((n_groups, LANES), np.int64)
+    np.add.at(counts, (group, lane), 1)
+    sub_per_group = counts.max(axis=1)  # sublane slots needed per group
+    # Round up to the tile granularity so every tile is group-pure.
+    sub_per_group = np.ceil(sub_per_group / TILE_SUBLANES).astype(np.int64) * TILE_SUBLANES
+    sub_per_group = np.maximum(sub_per_group, TILE_SUBLANES)
+    sub_start = np.zeros(n_groups + 1, np.int64)
+    np.cumsum(sub_per_group, out=sub_start[1:])
+    total_sub = int(sub_start[-1])
+
+    lo_arr = np.zeros((total_sub, LANES), np.int32)
+    val_arr = np.zeros((total_sub, LANES), np.float32)
+    row_arr = np.zeros((total_sub, LANES), np.int32)
+
+    # Slot index of each entry within its (group, lane) cell = rank in the
+    # lexsorted order (stable within cell).
+    cell_key = group * LANES + lane
+    first = np.empty_like(cell_key, dtype=bool)
+    first[0] = True
+    np.not_equal(cell_key[1:], cell_key[:-1], out=first[1:])
+    run_start = np.repeat(np.flatnonzero(first), np.diff(
+        np.append(np.flatnonzero(first), cell_key.size)))
+    slot = np.arange(cell_key.size, dtype=np.int64) - run_start
+
+    dest_sub = sub_start[group] + slot
+    lo_arr[dest_sub, lane] = lo.astype(np.int32)
+    val_arr[dest_sub, lane] = flat_v
+    row_arr[dest_sub, lane] = flat_r.astype(np.int32)
+
+    group_of_tile = np.repeat(
+        np.arange(n_groups, dtype=np.int32), sub_per_group // TILE_SUBLANES
+    )
+    return AlignedLayout(
+        lo=lo_arr, vals=val_arr, rows=row_arr,
+        group_of_tile=group_of_tile, n_entries=int(flat_v.size),
+    )
+
+
+def _gather_kernel(gmap_ref, w_ref, lo_ref, v_ref, o_ref):
+    """One tile: 16 single-vreg dynamic_gathers + multiply."""
+    del gmap_ref  # consumed by the index_map only
+    w = w_ref[...]  # [8, 128] — this tile's feature-group slab of W2
+    for i in range(TILE_SUBLANES // SUBLANES):
+        sl = slice(i * SUBLANES, (i + 1) * SUBLANES)
+        o_ref[sl, :] = (
+            jnp.take_along_axis(w, lo_ref[sl, :], axis=0) * v_ref[sl, :]
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def aligned_gather_products(
+    w: Array,
+    group_of_tile: Array,
+    lo: Array,
+    vals: Array,
+    interpret: bool = False,
+) -> Array:
+    """Per-entry ``w[f] * val`` over a group-aligned layout, feature-major.
+
+    ``w`` is the flat ``[d]`` coefficient vector; the layout arrays come from
+    :func:`build_aligned_layout` (device-put by the caller).  Returns
+    ``[total_sublanes, 128]`` float32 products (0.0 in pad slots).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    d = w.shape[0]
+    w2 = w.reshape(d // LANES, LANES)
+    n_tiles = group_of_tile.shape[0]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((SUBLANES, LANES), lambda i, gmap: (gmap[i], 0)),
+            pl.BlockSpec((TILE_SUBLANES, LANES), lambda i, gmap: (i, 0)),
+            pl.BlockSpec((TILE_SUBLANES, LANES), lambda i, gmap: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_SUBLANES, LANES), lambda i, gmap: (i, 0)),
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_tiles * TILE_SUBLANES, LANES), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(group_of_tile, w2, lo, vals)
+
+
+def gather_products_reference(w: np.ndarray, layout: AlignedLayout) -> np.ndarray:
+    """NumPy reference for tests: reconstruct f from (tile group, lo, lane)."""
+    n_sub = layout.lo.shape[0]
+    tile_of_sub = np.arange(n_sub) // TILE_SUBLANES
+    g = layout.group_of_tile[tile_of_sub]  # [n_sub]
+    f = (g[:, None] * GROUP_FEATURES
+         + layout.lo * LANES
+         + np.arange(LANES)[None, :])
+    return w[f] * layout.vals
