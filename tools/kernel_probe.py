@@ -1,0 +1,119 @@
+"""Probe Mosaic/v5e primitive throughput for the sparse fast-path design.
+
+Each probe is a tiny Pallas kernel over ~134MB of f32 so the numbers expose
+per-element op costs: copy (baseline), take_along_axis sublane gathers (8-deep
+and 128-deep), in-kernel [128,128] transpose, lane roll+select, and a
+masked-add accumulation loop.  Decides which router the crossing kernel uses.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+S, L = 128, 128  # tile sublanes x lanes
+N_TILES = 2048   # 2048 * 16K * 4B = 134 MB
+
+
+def tm(fn, *args, reps=10):
+    fj = jax.jit(fn)
+    out = fj(*args)
+    np.asarray(jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fj(*args)
+    np.asarray(jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def run(name, kernel, extra_inputs=(), out_shape=None, interpret=False):
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((N_TILES * S, L)).astype(np.float32))
+    nelem = x.size
+    out_shape = out_shape or jax.ShapeDtypeStruct((N_TILES * S, L), jnp.float32)
+    specs = [pl.BlockSpec((S, L), lambda i: (i, 0)) for _ in range(1 + len(extra_inputs))]
+    try:
+        f = pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            grid=(N_TILES,),
+            in_specs=specs,
+            out_specs=pl.BlockSpec((S, L), lambda i: (i, 0)),
+            interpret=interpret,
+        )
+        t = tm(f, x, *extra_inputs)
+        print(f"{name:42s} {t*1e3:8.2f} ms  {nelem/t/1e9:7.2f} Gelem/s")
+    except Exception as ex:  # noqa: BLE001
+        msg = str(ex).split(chr(10))[0][:120]
+        print(f"{name:42s} FAILED: {type(ex).__name__}: {msg}")
+
+
+def main():
+    rng = np.random.default_rng(1)
+
+    # baseline copy
+    run("copy", lambda x_ref, o_ref: o_ref.__setitem__(..., x_ref[...]))
+
+    # take_along_axis 8-deep per vreg (16 vregs per tile)
+    idx8 = jnp.asarray(rng.integers(0, 8, size=(N_TILES * S, L), dtype=np.int32))
+    def k_ta8(x_ref, i_ref, o_ref):
+        for v in range(S // 8):
+            sl = slice(v * 8, (v + 1) * 8)
+            o_ref[sl, :] = jnp.take_along_axis(x_ref[sl, :], i_ref[sl, :], axis=0)
+    run("take_along_axis 8-deep", k_ta8, (idx8,))
+
+    # take_along_axis 128-deep over whole tile
+    idx128 = jnp.asarray(rng.integers(0, S, size=(N_TILES * S, L), dtype=np.int32))
+    def k_ta128(x_ref, i_ref, o_ref):
+        o_ref[...] = jnp.take_along_axis(x_ref[...], i_ref[...], axis=0)
+    run("take_along_axis 128-deep", k_ta128, (idx128,))
+
+    # in-kernel transpose of the [128,128] tile
+    def k_t(x_ref, o_ref):
+        o_ref[...] = x_ref[...].T
+    run("transpose 128x128", k_t)
+
+    # lane roll + select, 16 radix-rolls per tile
+    mask = jnp.asarray(rng.integers(0, 2, size=(N_TILES * S, L), dtype=np.int32))
+    def k_roll(x_ref, m_ref, o_ref):
+        x = x_ref[...]
+        acc = jnp.zeros_like(x)
+        m = m_ref[...]
+        for g in range(16):
+            acc = acc + jnp.where(m == (g % 2), pltpu.roll(x, g, 1), 0.0)
+        o_ref[...] = acc
+    run("lane roll+select x16", k_roll, (mask,))
+
+    # masked-add: 8 select+adds per vreg into an [8,128] accumulator
+    lo = jnp.asarray(rng.integers(0, 8, size=(N_TILES * S, L), dtype=np.int32))
+    def k_acc(x_ref, lo_ref, o_ref):
+        x = x_ref[...]
+        lov = lo_ref[...]
+        acc = jnp.zeros((8, L), jnp.float32)
+        for v in range(S // 8):
+            sl = slice(v * 8, (v + 1) * 8)
+            xv = x[sl, :]
+            lv = lov[sl, :]
+            for t in range(8):
+                acc = acc.at[t, :].add(jnp.sum(jnp.where(lv == t, xv, 0.0), axis=0))
+        o_ref[...] = jnp.broadcast_to(acc, (S, L)).reshape(S, L)
+    run("masked-add 8-way per vreg", k_acc, (lo,))
+
+    # MXU routing: per-tile [128,128] @ [128,128] matmul
+    p = jnp.asarray(rng.standard_normal((N_TILES * S, L)).astype(np.float32))
+    def k_mm(x_ref, p_ref, o_ref):
+        o_ref[...] = jnp.dot(x_ref[...], p_ref[...],
+                             preferred_element_type=jnp.float32)
+    run("matmul 128x128 per tile", k_mm, (p,))
+
+
+if __name__ == "__main__":
+    main()

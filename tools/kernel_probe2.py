@@ -1,0 +1,104 @@
+"""Probe 2: verify the shuffle-pipeline primitives with scalar outputs.
+
+(a) in-kernel [128,128] transpose throughput
+(b) deep sublane gather: v-loop of take_along_axis+select over a 16-vreg block
+(c) P1 skeleton: gather + multiply + transpose + regrouped write
+All timed programs reduce outputs to a scalar inside jit (tunnel-safe).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+S, L = 128, 128
+N_TILES = 2048  # 134 MB of f32
+
+
+def tm(fn, *args, reps=10):
+    fj = jax.jit(fn)
+    out = fj(*args)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fj(*args)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def bench(name, kernel, inputs, n_in_blocks=1):
+    try:
+        f = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((N_TILES * S, L), jnp.float32),
+            grid=(N_TILES,),
+            in_specs=[pl.BlockSpec((S, L), lambda i: (i, 0))
+                      for _ in range(n_in_blocks)],
+            out_specs=pl.BlockSpec((S, L), lambda i: (i, 0)),
+        )
+        t = tm(lambda *a: jnp.sum(f(*a)), *inputs)
+        n = N_TILES * S * L
+        print(f"{name:40s} {t*1e3:8.2f} ms  {n/t/1e9:7.2f} Gelem/s")
+    except Exception as ex:  # noqa: BLE001
+        print(f"{name:40s} FAILED: {type(ex).__name__}: {str(ex)[:160]}")
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((N_TILES * S, L)).astype(np.float32))
+
+    def k_copy(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+    bench("copy", k_copy, (x,))
+
+    def k_t(x_ref, o_ref):
+        o_ref[...] = x_ref[...].T
+    bench("transpose 128x128", k_t, (x,))
+
+    # deep sublane gather: each output vreg gathers from 16 source vregs
+    # via hi/lo decomposition (the P3 assemble pattern)
+    hi = rng.integers(0, 16, size=(N_TILES * S, L), dtype=np.int32)
+    lo = rng.integers(0, 8, size=(N_TILES * S, L), dtype=np.int32)
+    hi_j, lo_j = jnp.asarray(hi), jnp.asarray(lo)
+
+    def k_deep(x_ref, hi_ref, lo_ref, o_ref):
+        for ov in range(16):
+            sl = slice(ov * 8, (ov + 1) * 8)
+            h = hi_ref[sl, :]
+            l = lo_ref[sl, :]
+            acc = jnp.zeros((8, L), jnp.float32)
+            for v in range(16):
+                src = x_ref[v * 8:(v + 1) * 8, :]
+                acc = jnp.where(h == v, jnp.take_along_axis(src, l, axis=0), acc)
+            o_ref[sl, :] = acc
+    bench("deep gather 128-deep (16x ta+sel)", k_deep, (x, hi_j, lo_j), 3)
+
+    # P1 skeleton: 8-deep gather + mul + transpose
+    idx8 = jnp.asarray(rng.integers(0, 8, size=(N_TILES * S, L), dtype=np.int32))
+
+    def k_p1(x_ref, i_ref, o_ref):
+        w = x_ref[0:8, :]
+        out = jnp.zeros((S, L), jnp.float32)
+        for v in range(16):
+            sl = slice(v * 8, (v + 1) * 8)
+            out = out.at[sl, :].set(
+                jnp.take_along_axis(w, i_ref[sl, :], axis=0) * x_ref[sl, :])
+        o_ref[...] = out.T
+    bench("gather8+mul+transpose (P1 skel)", k_p1, (x, idx8), 2)
+
+    # XLA big transpose for comparison
+    x4 = x.reshape(N_TILES, S // 8, 8, L)
+    t = tm(lambda a: jnp.sum(jnp.transpose(a, (1, 0, 2, 3))), x4)
+    print(f"{'XLA transpose [2048,16,8,128]->(1,0,..)':40s} {t*1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Micro-benchmarks of the sparse-GLM primitive ops on the local accelerator.
+
+Measures the candidate building blocks for the static-sparsity fast path
+(VERDICT round-1 item #2): gathers, scatters, sorted segment sums, cumsum
+tricks, and the Pallas aligned gather.  Run on the real chip to pick the
+architecture; numbers land in photon_tpu/ops/KERNEL_NOTES.md.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, reps=10, warmup=2):
+    fn_j = jax.jit(fn)
+    for _ in range(warmup):
+        out = fn_j(*args)
+    np.asarray(jax.tree.leaves(out)[0])  # force full device sync via host copy
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn_j(*args)
+    np.asarray(jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n, k, d = 1 << 20, 32, 1 << 18
+    e = n * k  # 33.5M entries
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, d, size=(n, k), dtype=np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    w = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+    ids_j = jnp.asarray(ids)
+    vals_j = jnp.asarray(vals)
+
+    flat = ids.reshape(-1)
+    order = np.argsort(flat, kind="stable").astype(np.int32)
+    sorted_ids = flat[order]
+    perm = jnp.asarray(order)
+    sorted_ids_j = jnp.asarray(sorted_ids)
+    # segment boundaries: starts[f] = first entry index of feature f
+    starts = np.searchsorted(sorted_ids, np.arange(d + 1)).astype(np.int32)
+    starts_j = jnp.asarray(starts)
+    u = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    qe = jnp.asarray(rng.standard_normal(e).astype(np.float32))
+
+    res = {}
+
+    res["gather_w[ids] 33.5M from 1MB"] = timeit(
+        lambda w, i: jnp.take(w, i, axis=0), w, ids_j)
+    res["gather flat[perm] 33.5M from 134MB"] = timeit(
+        lambda q, p: jnp.take(q, p, axis=0), qe, perm)
+    res["scatter-add unsorted (grad today)"] = timeit(
+        lambda q, i: jnp.zeros(d, jnp.float32).at[i.reshape(-1)].add(q), qe, ids_j)
+    res["segment_sum sorted flag"] = timeit(
+        lambda q, s: jax.ops.segment_sum(q, s, num_segments=d,
+                                         indices_are_sorted=True),
+        qe, sorted_ids_j)
+    res["cumsum 33.5M + boundary diff"] = timeit(
+        lambda q, st: jnp.diff(jnp.concatenate([jnp.zeros(1), jnp.cumsum(q)])[st]),
+        qe, starts_j)
+    # forward spread: w per entry in sorted order via diff/scatter-small/cumsum
+    def spread(w, st):
+        dw = jnp.diff(jnp.concatenate([jnp.zeros(1, w.dtype), w]))
+        delta = jnp.zeros(e, w.dtype).at[st[:-1]].add(dw)
+        return jnp.cumsum(delta)
+    res["spread w->entries via cumsum"] = timeit(spread, w, starts_j)
+    res["rowsum+loss elementwise"] = timeit(
+        lambda v, i, u: (v * u[:, None]).sum(axis=1), vals_j, ids_j, u)
+    res["u broadcast to entries [n,k]"] = timeit(
+        lambda v, u: (v * u[:, None]).reshape(-1), vals_j, u)
+
+    try:
+        from photon_tpu.ops.pallas_gather import (
+            aligned_gather_products, build_aligned_layout)
+        lay = build_aligned_layout(ids, vals, d)
+        gmap = jnp.asarray(lay.group_of_tile)
+        lo = jnp.asarray(lay.lo)
+        lvals = jnp.asarray(lay.vals)
+        res[f"pallas aligned gather ({lay.padded_entries/1e6:.1f}M slots)"] = timeit(
+            lambda w, g, lo, v: aligned_gather_products(w, g, lo, v),
+            w, gmap, lo, lvals)
+    except Exception as ex:  # noqa: BLE001
+        res["pallas aligned gather"] = f"FAILED: {ex}"
+
+    for name, t in res.items():
+        if isinstance(t, str):
+            print(f"{name:45s} {t}")
+        else:
+            print(f"{name:45s} {t*1e3:8.2f} ms   {e/t/1e9:7.2f} Gelem/s")
+
+
+if __name__ == "__main__":
+    main()

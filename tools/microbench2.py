@@ -1,0 +1,80 @@
+"""Re-run primitive benchmarks with scalar-reduced outputs.
+
+The axon-tunneled TPU platform makes device->host copies of large outputs
+dominate wall time (a 134MB fetch costs ~700ms), so every timed program here
+reduces its result to a scalar INSIDE jit; only 4 bytes cross the tunnel.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tm(fn, *args, reps=10):
+    fj = jax.jit(fn)
+    out = fj(*args)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fj(*args)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n, k, d = 1 << 20, 32, 1 << 18
+    e = n * k
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, d, size=(n, k), dtype=np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    w = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+    ids_j = jnp.asarray(ids)
+    vals_j = jnp.asarray(vals)
+
+    flat = ids.reshape(-1)
+    order = np.argsort(flat, kind="stable").astype(np.int32)
+    perm = jnp.asarray(order)
+    qe = jnp.asarray(rng.standard_normal(e).astype(np.float32))
+    u = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+    res = {}
+    res["fused margins rowsum (fwd today)"] = timeit = tm(
+        lambda w, i, v: jnp.sum((jnp.take(w, i, axis=0) * v).sum(axis=-1)),
+        w, ids_j, vals_j)
+    res["gather w[ids] + sum"] = tm(
+        lambda w, i: jnp.sum(jnp.take(w, i.reshape(-1), axis=0)), w, ids_j)
+    res["permute 33.5M + sum"] = tm(
+        lambda q, p: jnp.sum(jnp.take(q, p, axis=0)), qe, perm)
+    res["cumsum 33.5M + last"] = tm(lambda q: jnp.cumsum(q)[-1], qe)
+    res["scatter-add 33.5M->d + sum"] = tm(
+        lambda q, i: jnp.sum(jnp.zeros(d, jnp.float32).at[i.reshape(-1)].add(q)),
+        qe, ids_j)
+    res["u bcast [n,k] flat + sum"] = tm(
+        lambda v, u: jnp.sum((v * u[:, None]).reshape(-1)), vals_j, u)
+
+    try:
+        from photon_tpu.ops.pallas_gather import (
+            aligned_gather_products, build_aligned_layout)
+        lay = build_aligned_layout(ids, vals, d)
+        gmap = jnp.asarray(lay.group_of_tile)
+        lo = jnp.asarray(lay.lo)
+        lvals = jnp.asarray(lay.vals)
+        t = tm(lambda w, g, l, v: jnp.sum(aligned_gather_products(w, g, l, v)),
+               w, gmap, lo, lvals)
+        res[f"pallas aligned gather+sum ({lay.padded_entries/1e6:.0f}M slots)"] = t
+    except Exception as ex:  # noqa: BLE001
+        print("pallas aligned gather FAILED:", str(ex)[:200])
+
+    for name, t in res.items():
+        print(f"{name:45s} {t*1e3:8.2f} ms   {e/t/1e9:7.2f} Gelem/s")
+
+
+if __name__ == "__main__":
+    main()
