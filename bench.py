@@ -20,9 +20,83 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+# Resolved once by _acquire_backend(); recorded into every emitted line so a
+# CPU-fallback run is visibly not a TPU number.
+_PLATFORM_INFO = {"platform": None, "tpu_error": None}
+
+
+def _acquire_backend(timeout_s: float | None = None) -> None:
+    """Resolve a usable JAX backend WITHOUT ever hanging or crashing the bench.
+
+    Round 2 shipped zero perf data because ``jax.devices()`` hung when the
+    tunneled TPU backend was down and the driver recorded ``rc=1,
+    parsed=null``.  Backend initialization hangs cannot be interrupted
+    in-process, so the probe runs ``jax.devices()`` in a SUBPROCESS with a
+    bounded timeout; on any failure the parent forces the CPU backend via
+    ``jax.config.update`` (the env var is overridden by site customization)
+    and records the TPU error for the emitted JSON.
+    """
+    if _PLATFORM_INFO["platform"] is not None:
+        return
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("PHOTON_BENCH_PROBE_TIMEOUT", "120"))
+    # A round runs bench.py once plus five --config invocations; cache the
+    # probe outcome (with a TTL) so only the first invocation pays the
+    # subprocess backend init.
+    cache_path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "photon_bench_backend_probe.json"
+    )
+    try:
+        st = os.stat(cache_path)
+        if time.time() - st.st_mtime < 3600:
+            with open(cache_path) as f:
+                cached = json.load(f)
+            _PLATFORM_INFO.update(cached)
+            if _PLATFORM_INFO["platform"] == "cpu-fallback":
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            return
+    except Exception:  # noqa: BLE001 — unreadable cache means re-probe
+        pass
+    err = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if proc.returncode == 0 and proc.stdout.strip():
+            # Trust the probe: the parent must not run its own unbounded
+            # jax.devices() here — that is the exact hang this guards against.
+            _PLATFORM_INFO["platform"] = proc.stdout.strip().splitlines()[-1]
+        else:
+            err = (proc.stderr or "backend probe failed").strip()[-500:]
+    except subprocess.TimeoutExpired:
+        err = f"backend init timed out after {timeout_s:.0f}s"
+    except Exception as ex:  # noqa: BLE001 — any probe failure must degrade
+        err = f"{type(ex).__name__}: {ex}"
+    if err is not None:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — backend may already be initialized
+            pass
+        _PLATFORM_INFO["platform"] = "cpu-fallback"
+        _PLATFORM_INFO["tpu_error"] = err
+    try:
+        with open(cache_path + ".tmp", "w") as f:
+            json.dump(_PLATFORM_INFO, f)
+        os.replace(cache_path + ".tmp", cache_path)
+    except Exception:  # noqa: BLE001 — cache write failure is non-fatal
+        pass
 
 
 def _build_batch(n: int, k: int, d: int, seed: int = 0):
@@ -55,8 +129,16 @@ def _emit(metric: str, value: float, unit: str, detail: dict) -> None:
                 prior = json.load(f)
             if prior.get("metric") == metric and prior.get("value"):
                 vs_baseline = value / float(prior["value"])
-        except (ValueError, KeyError):
+        except Exception:  # noqa: BLE001 — a corrupt baseline must not kill the bench
             pass
+    if _PLATFORM_INFO["platform"] is not None:
+        detail = dict(detail)
+        if _PLATFORM_INFO["platform"] == "cpu-fallback":
+            detail["platform"] = "cpu-fallback"
+        else:
+            detail.setdefault("platform", _PLATFORM_INFO["platform"])
+        if _PLATFORM_INFO["tpu_error"]:
+            detail["tpu_error"] = _PLATFORM_INFO["tpu_error"]
     print(json.dumps({
         "metric": metric,
         "value": round(value, 3),
@@ -77,6 +159,9 @@ def _bench_config(num: int) -> None:
     import numpy as np
 
     from photon_tpu.data.synthetic import make_game_data, make_glm_data, write_libsvm
+
+    if num not in (1, 2, 3, 4, 5):
+        raise ValueError(f"unknown bench config {num}; valid: 1-5 (SURVEY.md §6)")
 
     platform = jax.devices()[0].platform
     big = platform != "cpu"
@@ -152,8 +237,7 @@ def _bench_config(num: int) -> None:
 
 
 def main() -> None:
-    import sys
-
+    _acquire_backend()
     if len(sys.argv) > 2 and sys.argv[1] == "--config":
         _bench_config(int(sys.argv[2]))
         return
@@ -198,35 +282,33 @@ def main() -> None:
     wall = time.perf_counter() - t0
     steps_per_sec = reps / wall
 
-    vs_baseline = 1.0
-    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
-    if os.path.exists(base_path):
-        try:
-            with open(base_path) as f:
-                prior = json.load(f)
-            if prior.get("value"):
-                vs_baseline = steps_per_sec / float(prior["value"])
-        except (ValueError, KeyError):
-            pass
-
-    print(
-        json.dumps(
-            {
-                "metric": "glm_grad_steps_per_sec",
-                "value": round(steps_per_sec, 3),
-                "unit": "steps/s",
-                "vs_baseline": round(vs_baseline, 3),
-                "detail": {
-                    "rows": n,
-                    "nnz_per_row": k,
-                    "dim": d,
-                    "platform": platform,
-                    "rows_per_sec": round(steps_per_sec * n, 1),
-                },
-            }
-        )
-    )
+    # Effective bandwidth: per step the sparse hot loop must touch ids+vals
+    # once in each direction (fwd gather products, bwd segment reduction).
+    nnz = n * k
+    eff_gb_s = steps_per_sec * nnz * 2 * 8 / 1e9  # 2 passes x (4B id + 4B val)
+    hbm_gb_s = 819.0  # v5e HBM peak; CPU numbers are sanity-only
+    _emit("glm_grad_steps_per_sec", steps_per_sec, "steps/s", {
+        "rows": n,
+        "nnz_per_row": k,
+        "dim": d,
+        "platform": platform,
+        "rows_per_sec": round(steps_per_sec * n, 1),
+        "effective_gb_per_sec": round(eff_gb_s, 2),
+        "pct_hbm_roofline": round(100.0 * eff_gb_s / hbm_gb_s, 2)
+        if platform == "tpu" else None,
+    })
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as ex:  # noqa: BLE001 — the driver must always get JSON
+        # Mode-specific metric name so a failed --config run is never
+        # mistaken for a collapse of the headline benchmark.
+        if len(sys.argv) > 2 and sys.argv[1] == "--config":
+            metric = f"config{sys.argv[2]}_error"
+        else:
+            metric = "bench_error"
+        _emit(metric, 0.0, "error", {
+            "error": f"{type(ex).__name__}: {ex}"[:500],
+        })
