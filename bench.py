@@ -47,8 +47,10 @@ def _acquire_backend(timeout_s: float | None = None) -> None:
     if timeout_s is None:
         timeout_s = float(os.environ.get("PHOTON_BENCH_PROBE_TIMEOUT", "120"))
     # A round runs bench.py once plus five --config invocations; cache the
-    # probe outcome (with a TTL) so only the first invocation pays the
-    # subprocess backend init.
+    # CPU-FALLBACK outcome (with a TTL) so they don't each wait out the
+    # probe timeout.  A successful TPU probe is deliberately NOT cached:
+    # the tunnel can drop mid-round, and a cached "tpu" would skip the
+    # subprocess guard and reintroduce the unbounded in-process hang.
     cache_path = os.path.join(
         os.environ.get("TMPDIR", "/tmp"), "photon_bench_backend_probe.json"
     )
@@ -57,12 +59,12 @@ def _acquire_backend(timeout_s: float | None = None) -> None:
         if time.time() - st.st_mtime < 3600:
             with open(cache_path) as f:
                 cached = json.load(f)
-            _PLATFORM_INFO.update(cached)
-            if _PLATFORM_INFO["platform"] == "cpu-fallback":
+            if cached.get("platform") == "cpu-fallback":
+                _PLATFORM_INFO.update(cached)
                 import jax
 
                 jax.config.update("jax_platforms", "cpu")
-            return
+                return
     except Exception:  # noqa: BLE001 — unreadable cache means re-probe
         pass
     err = None
@@ -91,12 +93,13 @@ def _acquire_backend(timeout_s: float | None = None) -> None:
             pass
         _PLATFORM_INFO["platform"] = "cpu-fallback"
         _PLATFORM_INFO["tpu_error"] = err
-    try:
-        with open(cache_path + ".tmp", "w") as f:
-            json.dump(_PLATFORM_INFO, f)
-        os.replace(cache_path + ".tmp", cache_path)
-    except Exception:  # noqa: BLE001 — cache write failure is non-fatal
-        pass
+    if _PLATFORM_INFO["platform"] == "cpu-fallback":
+        try:
+            with open(cache_path + ".tmp", "w") as f:
+                json.dump(_PLATFORM_INFO, f)
+            os.replace(cache_path + ".tmp", cache_path)
+        except Exception:  # noqa: BLE001 — cache write failure is non-fatal
+            pass
 
 
 def _build_batch(n: int, k: int, d: int, seed: int = 0):
@@ -111,13 +114,15 @@ def _build_batch(n: int, k: int, d: int, seed: int = 0):
     w_true = rng.standard_normal(d).astype(np.float32) * 0.1
     margin = (w_true[ids] * vals).sum(axis=1)
     label = (rng.random(n) < 1.0 / (1.0 + np.exp(-margin))).astype(np.float32)
-    return SparseBatch(
+    from photon_tpu.data.batch import attach_feature_major
+
+    return attach_feature_major(SparseBatch(
         ids=jnp.asarray(ids),
         vals=jnp.asarray(vals),
         label=jnp.asarray(label),
         offset=jnp.zeros(n, jnp.float32),
         weight=jnp.ones(n, jnp.float32),
-    )
+    ))
 
 
 def _emit(metric: str, value: float, unit: str, detail: dict) -> None:

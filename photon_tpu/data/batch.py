@@ -25,13 +25,41 @@ are needed in the hot loop.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Union
+from typing import NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 Array = jax.Array
+
+
+class FeatureMajorAux(NamedTuple):
+    """Static feature-major (sorted-by-feature-id) view of a batch's entries.
+
+    The production gradient of a sparse GLM is a scatter-add of per-entry
+    contributions into the coefficient vector; XLA lowers an unsorted
+    scatter-add on TPU as sort + segmented reduce, paying an O(E log E)
+    device sort on EVERY objective evaluation.  The sparsity pattern is
+    static across a whole optimizer run (the reference exploits the same
+    invariant by pre-building per-partition aggregator layouts — SURVEY.md
+    §3.4), so the sort is done ONCE host-side at batch build and the runtime
+    reduction becomes ``segment_sum(..., indices_are_sorted=True)``.
+
+    All arrays are ``[S, E_s]`` where ``S`` is the number of contiguous
+    row blocks (1 for single-device batches; the mesh axis size for sharded
+    batches, so that sharding on the leading axis gives every device its own
+    block-local sorted view) and ``E_s = rows_per_block * k``:
+
+    - ``ids``: int32 feature ids, non-decreasing within each block.
+    - ``rows``: int32 BLOCK-LOCAL source row of each entry.
+    - ``vals``: float32 entry values (0.0 for the row-padding entries, which
+      therefore contribute nothing, same convention as SparseBatch).
+    """
+
+    ids: Array
+    rows: Array
+    vals: Array
 
 
 class DenseBatch(NamedTuple):
@@ -56,6 +84,12 @@ class SparseBatch(NamedTuple):
 
     ``ids[i, j]`` / ``vals[i, j]`` give the j-th nonzero of example i; rows
     with fewer than ``k`` nonzeros are padded with ``(0, 0.0)``.
+
+    ``fm`` optionally carries the static feature-major entry layout
+    (:class:`FeatureMajorAux`, built by :func:`attach_feature_major`); when
+    present, objectives compute gradients via a pre-sorted segment sum
+    instead of an unsorted scatter — see
+    :meth:`photon_tpu.core.objective.GlmObjective.value_and_grad`.
     """
 
     ids: Array  # [n, k] int32
@@ -63,6 +97,7 @@ class SparseBatch(NamedTuple):
     label: Array  # [n] float
     offset: Array  # [n] float
     weight: Array  # [n] float
+    fm: Optional[FeatureMajorAux] = None
 
     @property
     def num_examples(self) -> int:
@@ -163,6 +198,37 @@ def with_offset(batch: Batch, offset: Array) -> Batch:
     return batch._replace(offset=offset)
 
 
+def attach_feature_major(batch: SparseBatch, shards: int = 1) -> SparseBatch:
+    """Attach the static feature-major layout (:class:`FeatureMajorAux`).
+
+    Host-side: one stable argsort of the flat entries per row block — run
+    once per dataset, amortized over every optimizer iteration (the runtime
+    win is deleting the per-evaluation device sort inside XLA's scatter
+    lowering; see FeatureMajorAux).  ``shards`` must match the mesh data-axis
+    size the batch will be sharded over (1 for single-device use); rows are
+    split into ``shards`` contiguous blocks, mirroring
+    :func:`photon_tpu.parallel.mesh.shard_batch` placement.
+    """
+    if not isinstance(batch, SparseBatch) or batch.ids.ndim != 2:
+        raise ValueError("feature-major layout requires a 2-D SparseBatch")
+    n, k = batch.ids.shape
+    if n % shards:
+        raise ValueError(f"rows ({n}) not divisible by shards ({shards}); pad first")
+    ns = n // shards
+    ids = np.asarray(batch.ids).reshape(shards, ns * k)
+    vals = np.asarray(batch.vals).reshape(shards, ns * k)
+    rows = np.broadcast_to(
+        np.repeat(np.arange(ns, dtype=np.int32), k), (shards, ns * k)
+    )
+    order = np.argsort(ids, axis=1, kind="stable")
+    take = np.take_along_axis
+    return batch._replace(fm=FeatureMajorAux(
+        ids=jnp.asarray(take(ids, order, axis=1)),
+        rows=jnp.asarray(take(rows, order, axis=1)),
+        vals=jnp.asarray(take(vals, order, axis=1)),
+    ))
+
+
 def pad_batch(batch: Batch, target_n: int) -> Batch:
     """Pad a batch to ``target_n`` examples with zero-weight rows (so padded
     rows contribute nothing to any weighted objective or evaluator)."""
@@ -177,4 +243,11 @@ def pad_batch(batch: Batch, target_n: int) -> Batch:
         widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
         return jnp.pad(a, widths)
 
+    # The feature-major aux is row-count- and block-structure-dependent;
+    # padding per-leaf would corrupt it.  Strip it (padded rows carry only
+    # zero-value entries, so an aux rebuilt after padding is equivalent) and
+    # let the caller re-attach at the final row count.
+    fm = getattr(batch, "fm", None)
+    if fm is not None:
+        batch = batch._replace(fm=None)
     return jax.tree.map(_pad, batch)

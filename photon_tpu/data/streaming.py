@@ -518,4 +518,20 @@ def make_global_batch(local_batch: SparseBatch, mesh, axis: str = "data"):
             sharding, np.asarray(leaf)
         )
 
-    return SparseBatch(*(build(leaf) for leaf in local_batch))
+    core = SparseBatch(*(build(leaf) for leaf in local_batch[:5]))
+    if local_batch.fm is not None:
+        # The aux's leading block axis must match this process's slice of the
+        # data axis (one block per local device) for the per-shard sorted
+        # views to line up with the row sharding — rebuild it at the right
+        # granularity rather than trusting the caller's shard count.
+        from photon_tpu.data.batch import attach_feature_major
+
+        local_shards = int(mesh.local_mesh.shape[axis])
+        if int(local_batch.fm.ids.shape[0]) != local_shards:
+            local_batch = attach_feature_major(
+                local_batch._replace(fm=None), shards=local_shards
+            )
+        core = core._replace(
+            fm=type(local_batch.fm)(*(build(leaf) for leaf in local_batch.fm))
+        )
+    return core

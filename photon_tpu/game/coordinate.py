@@ -139,6 +139,7 @@ class FixedEffectDeviceData:
         data: GameDataset,
         config: FixedEffectCoordinateConfig,
         mesh=None,
+        build_fm: bool = True,
     ):
         self.mesh = mesh
         shard = data.shard(config.shard_name)
@@ -161,7 +162,11 @@ class FixedEffectDeviceData:
         self.batch = shard_to_batch(shard, label, offset, weight)
         self.unpadded_n = self.batch.num_examples
         if mesh is not None:
-            self.batch = shard_batch(self.batch, mesh)
+            self.batch = shard_batch(self.batch, mesh, build_fm=build_fm)
+        elif build_fm and isinstance(self.batch, SparseBatch):
+            from photon_tpu.data.batch import attach_feature_major
+
+            self.batch = attach_feature_major(self.batch)
 
     def offsets_to_device(self, offsets: np.ndarray) -> Array:
         if self.train_rows is not None:
@@ -283,7 +288,9 @@ class FixedEffectCoordinate:
         self.config = config
         self.task_type = task_type
         self.mesh = mesh
-        self.device_data = device_data or FixedEffectDeviceData(data, config, mesh)
+        self.device_data = device_data or FixedEffectDeviceData(
+            data, config, mesh, build_fm=normalization is None
+        )
         self.dim = self.device_data.dim
         if normalization is not None and len(
             np.asarray(normalization.factors_or_ones(self.dim))

@@ -98,14 +98,17 @@ class GameEstimator:
 
         key = coord_config.data_key
         if key not in self._device_data_cache:
-            cls = (
-                FixedEffectDeviceData
-                if isinstance(coord_config, FixedEffectCoordinateConfig)
-                else RandomEffectDeviceData
-            )
-            self._device_data_cache[key] = cls(
-                self.training_data, coord_config, self.mesh
-            )
+            if isinstance(coord_config, FixedEffectCoordinateConfig):
+                # The feature-major aux only pays off when the objective can
+                # use it — normalized objectives fall back to autodiff.
+                self._device_data_cache[key] = FixedEffectDeviceData(
+                    self.training_data, coord_config, self.mesh,
+                    build_fm=self.normalization.get(coord_config.shard_name) is None,
+                )
+            else:
+                self._device_data_cache[key] = RandomEffectDeviceData(
+                    self.training_data, coord_config, self.mesh
+                )
         return self._device_data_cache[key]
 
     def _build_coordinates(self, config: GameOptimizationConfiguration):

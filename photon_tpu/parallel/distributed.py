@@ -73,12 +73,54 @@ class DistributedGlmObjective:
 
     # -- derivatives: differentiate through the psum --------------------------
     def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
+        if self.obj._fm_ready(batch):
+            # Static-sparsity fast path: per-shard explicit value+gradient
+            # over the shard's block-local feature-major layout, psum-ed —
+            # the direct analog of treeAggregate(ValueAndGradientAggregator)
+            # with the per-evaluation sort deleted (see FeatureMajorAux).
+            ax = self.axis_name
+
+            @partial(
+                shard_map,
+                mesh=self.mesh,
+                in_specs=(P(), self._batch_specs(batch)),
+                out_specs=(P(), P()),
+            )
+            def _vg(w, local):
+                v, g = self.obj._fast_data_value_and_grad(w, local)
+                return lax.psum(v, ax), lax.psum(g, ax)
+
+            v, g = _vg(w, batch)
+            l2 = self.obj.l2_weight
+            if l2:
+                v = v + 0.5 * l2 * jnp.dot(w, w)
+                g = g + l2 * w
+            return v, g
         return jax.value_and_grad(self.value)(w, batch)
 
     def grad(self, w: Array, batch: Batch) -> Array:
+        if self.obj._fm_ready(batch):
+            return self.value_and_grad(w, batch)[1]
         return jax.grad(self.value)(w, batch)
 
     def hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
+        if self.obj._fm_ready(batch):
+            ax = self.axis_name
+
+            @partial(
+                shard_map,
+                mesh=self.mesh,
+                in_specs=(P(), P(), self._batch_specs(batch)),
+                out_specs=P(),
+            )
+            def _hv(w, v, local):
+                return lax.psum(self.obj._fast_data_hessian_vector(w, v, local), ax)
+
+            hv = _hv(w, v, batch)
+            l2 = self.obj.l2_weight
+            if l2:
+                hv = hv + l2 * v
+            return hv
         return jax.jvp(lambda u: self.grad(u, batch), (w,), (v,))[1]
 
     def hessian_diagonal(self, w: Array, batch: Batch) -> Array:

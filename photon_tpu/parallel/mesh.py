@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from photon_tpu.data.batch import Batch, pad_batch
+from photon_tpu.data.batch import Batch, SparseBatch, attach_feature_major, pad_batch
 
 DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
@@ -54,17 +54,29 @@ def batch_sharding(mesh: Mesh, batch: Batch, axis_name: str = DATA_AXIS):
     )
 
 
-def shard_batch(batch: Batch, mesh: Mesh, axis_name: str = DATA_AXIS) -> Batch:
+def shard_batch(
+    batch: Batch,
+    mesh: Mesh,
+    axis_name: str = DATA_AXIS,
+    build_fm: bool = True,
+) -> Batch:
     """Pad the batch to a multiple of the mesh axis size (zero-weight rows)
     and place it sharded across the axis.
 
     The padding convention means padded rows are invisible to objectives and
     evaluators — the analog of the reference's uneven final RDD partition.
+
+    For 2-D sparse batches this also attaches the per-shard feature-major
+    layout (``build_fm``), so sharded objectives take the pre-sorted
+    segment-sum gradient path; the aux's leading block axis is sharded like
+    the rows, giving each device its block-local sorted view.
     """
     n_shards = mesh.shape[axis_name]
     n = batch.num_examples
     target = ((n + n_shards - 1) // n_shards) * n_shards
     padded = pad_batch(batch, target)
+    if build_fm and isinstance(padded, SparseBatch) and padded.ids.ndim == 2:
+        padded = attach_feature_major(padded._replace(fm=None), shards=n_shards)
     return jax.device_put(padded, batch_sharding(mesh, padded, axis_name))
 
 

@@ -1,0 +1,130 @@
+"""The static-sparsity fast gradient path (FeatureMajorAux) must match the
+autodiff reference exactly (up to float32 reduction order).
+
+The fast path replaces XLA's unsorted scatter-add (sort + segmented reduce
+per evaluation) with a host-pre-sorted ``segment_sum(indices_are_sorted=
+True)`` — VERDICT r2 item 1; the reference's ValueAndGradientAggregator /
+HessianVectorAggregator hot loop (SURVEY.md §3.4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.data.batch import SparseBatch, attach_feature_major
+
+
+def _random_batch(n, k, d, seed=0, zipf=False, with_pads=True):
+    rng = np.random.default_rng(seed)
+    if zipf:
+        # Power-law feature frequencies — the realistic sparse-GLM regime.
+        ids = (rng.zipf(1.3, size=(n, k)) - 1) % d
+        ids = ids.astype(np.int32)
+    else:
+        ids = rng.integers(0, d, size=(n, k), dtype=np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    if with_pads:
+        # Zero out a random suffix of some rows (the padding convention).
+        cut = rng.integers(1, k + 1, size=n)
+        mask = np.arange(k)[None, :] < cut[:, None]
+        vals = np.where(mask, vals, 0.0).astype(np.float32)
+        ids = np.where(mask, ids, 0).astype(np.int32)
+    label = (rng.random(n) < 0.5).astype(np.float32)
+    offset = rng.standard_normal(n).astype(np.float32) * 0.1
+    weight = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    return SparseBatch(
+        ids=jnp.asarray(ids), vals=jnp.asarray(vals), label=jnp.asarray(label),
+        offset=jnp.asarray(offset), weight=jnp.asarray(weight),
+    )
+
+
+@pytest.mark.parametrize("loss", ["logistic", "squared", "poisson"])
+@pytest.mark.parametrize("zipf", [False, True])
+def test_fast_value_and_grad_matches_autodiff(loss, zipf):
+    n, k, d = 512, 8, 64
+    batch = _random_batch(n, k, d, seed=1, zipf=zipf)
+    fast = attach_feature_major(batch)
+    obj = GlmObjective.create(loss, RegularizationContext("l2", 0.7))
+    w = jnp.asarray(np.random.default_rng(2).standard_normal(d), jnp.float32) * 0.1
+
+    v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+    v_fast, g_fast = obj.value_and_grad(w, fast)
+    np.testing.assert_allclose(v_fast, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g_fast, g_ref, rtol=2e-4, atol=1e-5)
+    # And under jit (the optimizer always calls it jitted).
+    v_j, g_j = jax.jit(obj.value_and_grad)(w, fast)
+    np.testing.assert_allclose(g_j, g_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_fast_hessian_vector_matches_jvp():
+    n, k, d = 256, 6, 48
+    batch = _random_batch(n, k, d, seed=3)
+    fast = attach_feature_major(batch)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.3))
+    rng = np.random.default_rng(4)
+    w = jnp.asarray(rng.standard_normal(d), jnp.float32) * 0.1
+    v = jnp.asarray(rng.standard_normal(d), jnp.float32)
+
+    hv_ref = jax.jvp(lambda u: jax.grad(obj.value)(u, batch), (w,), (v,))[1]
+    hv_fast = obj.hessian_vector(w, v, fast)
+    np.testing.assert_allclose(hv_fast, hv_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_multi_block_single_device():
+    """S > 1 on one device: block-local rows offset to global rows."""
+    n, k, d = 256, 4, 32
+    batch = _random_batch(n, k, d, seed=5)
+    obj = GlmObjective.create("logistic")
+    w = jnp.asarray(np.random.default_rng(6).standard_normal(d), jnp.float32) * 0.1
+    _, g_ref = jax.value_and_grad(obj.value)(w, batch)
+    for shards in (1, 4):
+        fast = attach_feature_major(batch, shards=shards)
+        assert fast.fm.ids.shape[0] == shards
+        _, g = obj.value_and_grad(w, fast)
+        np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_fm_ids_sorted_and_pads_harmless():
+    batch = _random_batch(64, 4, 16, seed=7)
+    fast = attach_feature_major(batch, shards=2)
+    ids = np.asarray(fast.fm.ids)
+    assert (np.diff(ids, axis=1) >= 0).all(), "ids must be sorted within blocks"
+    # Pad entries carry val 0 -> removing them changes nothing.
+    obj = GlmObjective.create("squared")
+    w = jnp.ones(16, jnp.float32)
+    _, g = obj.value_and_grad(w, fast)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_attach_feature_major_validation():
+    batch = _random_batch(10, 3, 8, seed=8)
+    with pytest.raises(ValueError, match="divisible"):
+        attach_feature_major(batch, shards=3)
+
+
+def test_distributed_fast_path_matches_single_device():
+    from jax.sharding import Mesh
+    from photon_tpu.parallel.distributed import DistributedGlmObjective
+    from photon_tpu.parallel.mesh import create_mesh, shard_batch
+
+    n, k, d = 512, 8, 64
+    batch = _random_batch(n, k, d, seed=9)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.5))
+    w = jnp.asarray(np.random.default_rng(10).standard_normal(d), jnp.float32) * 0.1
+    v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+
+    mesh = create_mesh(8)
+    sharded = shard_batch(batch, mesh)  # attaches per-shard fm
+    assert sharded.fm is not None and sharded.fm.ids.shape[0] == 8
+    dist = DistributedGlmObjective(obj, mesh)
+    v, g = dist.value_and_grad(w, sharded)
+    np.testing.assert_allclose(v, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=1e-5)
+
+    rng = np.random.default_rng(11)
+    vec = jnp.asarray(rng.standard_normal(d), jnp.float32)
+    hv_ref = jax.jvp(lambda u: jax.grad(obj.value)(u, batch), (w,), (vec,))[1]
+    hv = dist.hessian_vector(w, vec, sharded)
+    np.testing.assert_allclose(hv, hv_ref, rtol=2e-4, atol=1e-5)
