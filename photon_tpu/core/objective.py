@@ -210,7 +210,7 @@ class GlmObjective:
             # optimizer's while_loop) compiles.  On v5e Mosaic lacks vector
             # scatter-add, so this routes back to XLA there.
             if pallas_enabled() and kernel_supported(
-                self.loss, int(batch.ids.shape[1])
+                self.loss, int(batch.ids.shape[1]), int(w.shape[0])
             ):
                 v, g = fused_value_and_grad(
                     self.loss, w, batch.ids, batch.vals,

@@ -99,15 +99,27 @@ class GlmOptimizationProblem:
 
     def compute_variances(self, w: Array, batch: Batch) -> Optional[Array]:
         """Per-coefficient posterior variances at the optimum (SURVEY.md
-        §2.2 'L2 + variance'): SIMPLE = 1/diag(H); FULL = diag(H⁻¹) via a
-        Cholesky solve of the full Hessian (reference's
-        VarianceComputationType)."""
+        §2.2 'L2 + variance'): SIMPLE = 1/diag(H); FULL = diag(H⁻¹) — a
+        Cholesky solve of the dense Hessian up to FULL_DENSE_MAX_DIM, a
+        matrix-free CG/Hutchinson estimate above it (the dense ``[d, d]``
+        materialization is a 256 GB allocation at the bench dimension —
+        see core/variance.py)."""
         kind = self.config.variance_computation
         if kind == "none":
             return None
         if kind == "full":
+            from photon_tpu.core.variance import (
+                FULL_DENSE_MAX_DIM,
+                hutchinson_diag_inverse,
+            )
+
+            d = int(w.shape[0])
+            if d > FULL_DENSE_MAX_DIM:
+                return hutchinson_diag_inverse(
+                    lambda v: self.objective.hessian_vector(w, v, batch),
+                    dim=d,
+                )
             h = self.objective.hessian_matrix(w, batch)
-            d = h.shape[0]
             # Tiny jitter keeps the factorization defined for flat
             # directions (e.g. unreached features with zero curvature).
             chol = jax.scipy.linalg.cho_factor(h + 1e-9 * jnp.eye(d, dtype=h.dtype))

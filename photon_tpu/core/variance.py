@@ -1,0 +1,99 @@
+"""Matrix-free FULL variance computation: diag(H⁻¹) without materializing H.
+
+The reference's ``VarianceComputationType.FULL`` inverts the full Hessian
+(photon-api .../optimization — SURVEY.md §2.2 'L2 + variance'), which is
+feasible only for modest dimensions: at the bench dimension d=262144 the
+dense ``[d, d]`` Hessian is a 256 GB allocation (VERDICT r2 weak #5).  For
+large d this module estimates ``diag(H⁻¹)`` matrix-free:
+
+- conjugate-gradient solves against the Hessian-vector product (exact for
+  GLM objectives: ``Hv = Xᵀ diag(weight·d2) X v + l2·v``), and
+- a Hutchinson-style probe estimator
+  ``diag(H⁻¹) ≈ E_z[z ⊙ H⁻¹ z]`` with Rademacher probes ``z``.
+
+For diagonal Hessians (orthogonal features) the estimator is exact for any
+probe; in general its per-coordinate error decays as 1/sqrt(num_probes) —
+it is a posterior-width ESTIMATE, which is what GLMix uses the variances
+for (documented departure from the reference's exact-but-small-scale
+semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+# Above this dimension the dense [d, d] Cholesky path is refused: the
+# Hessian materialization grows quadratically (8192² f32 = 256 MB; the
+# bench dim 262144² would be 256 GB).
+FULL_DENSE_MAX_DIM = 8192
+
+
+def cg_solve(
+    hvp: Callable[[Array], Array],
+    b: Array,
+    tol: float = 1e-6,
+    max_iterations: int = 250,
+) -> Array:
+    """Conjugate gradient for ``H x = b`` with H SPD, as a lax.while_loop.
+
+    The inner-loop analog of TRON's trust-region CG (LIBLINEAR-style), reused
+    for variance probes.  Runs until ``||r|| <= tol * ||b||`` or the
+    iteration cap.
+    """
+    b_norm = jnp.linalg.norm(b)
+
+    def cond(state):
+        _, r, _, rs, it = state
+        return (jnp.sqrt(rs) > tol * jnp.maximum(b_norm, 1e-30)) & (
+            it < max_iterations
+        )
+
+    def body(state):
+        x, r, p, rs, it = state
+        hp = hvp(p)
+        alpha = rs / jnp.maximum(jnp.dot(p, hp), 1e-30)
+        x = x + alpha * p
+        r = r - alpha * hp
+        rs_new = jnp.dot(r, r)
+        p = r + (rs_new / jnp.maximum(rs, 1e-30)) * p
+        return x, r, p, rs_new, it + 1
+
+    x0 = jnp.zeros_like(b)
+    state = (x0, b, b, jnp.dot(b, b), jnp.int32(0))
+    x, *_ = lax.while_loop(cond, body, state)
+    return x
+
+
+def hutchinson_diag_inverse(
+    hvp: Callable[[Array], Array],
+    dim: int,
+    seed: int = 0,
+    num_probes: int = 32,
+    cg_tol: float = 1e-5,
+    cg_max_iterations: int = 250,
+) -> Array:
+    """Estimate ``diag(H⁻¹)`` via Rademacher probes and CG solves.
+
+    Probes run under ``lax.scan`` (sequential — each probe is itself a fully
+    parallel CG over the device mesh when ``hvp`` psums).  Deliberately NOT
+    wrapped in an outer ``jax.jit``: callers pass fresh ``hvp`` closures per
+    fit, and a jit keyed on closure identity would recompile every call
+    while retaining each executable (with the batch baked in as constants)
+    in the global cache forever.
+    """
+    keys = jax.random.split(jax.random.PRNGKey(seed), num_probes)
+
+    def one_probe(acc, key):
+        z = jax.random.rademacher(key, (dim,), dtype=jnp.float32)
+        x = cg_solve(hvp, z, tol=cg_tol, max_iterations=cg_max_iterations)
+        return acc + z * x, None
+
+    total, _ = lax.scan(one_probe, jnp.zeros(dim, jnp.float32), keys)
+    # H is SPD, so true diag(H⁻¹) > 0; clamp estimator noise.
+    return jnp.maximum(total / num_probes, 0.0)

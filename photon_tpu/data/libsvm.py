@@ -65,7 +65,15 @@ def _parse_libsvm_py(path: str, zero_based: bool) -> LibsvmData:
             vals = np.empty(len(parts) - 1, np.float32)
             for j, tok in enumerate(parts[1:]):
                 k, v = tok.split(b":")
-                ids[j] = int(k) - off
+                fid = int(k) - off
+                if fid < 0 or fid > np.iinfo(np.int32).max:
+                    # Same contract as the native parser: out-of-range ids
+                    # are a parse error, never a silent int32 wraparound.
+                    raise ValueError(
+                        f"{path}: feature id {int(k)} out of int32 range "
+                        f"(or below the {'0' if zero_based else '1'}-based minimum)"
+                    )
+                ids[j] = fid
                 vals[j] = float(v)
             if len(ids):
                 max_id = max(max_id, int(ids.max()))

@@ -116,6 +116,39 @@ def _run_streaming(args: argparse.Namespace) -> dict:
         len(source.files), len(files), source.num_examples, source.dim,
         source.capacity,
     )
+    if args.data_validation != "off":
+        # Streamed data must get the same validation as resident data
+        # (ADVICE r1: the streaming path skipped it entirely): one extra
+        # host pass over this process's chunks before training starts.
+        from photon_tpu.data.validation import (
+            DataValidationError,
+            apply_validation,
+            validate_batch,
+        )
+
+        with logger.timed("validate-data"):
+            issues = []
+            for chunk in source.chunk_iter_factory():
+                issues.extend(validate_batch(chunk, args.task))
+            if jax.process_count() > 1:
+                # Agreement step: every process must reach the same
+                # pass/fail decision, else a bad shard on one host would
+                # leave the clean hosts hanging in the first collective.
+                from jax.experimental import multihost_utils
+
+                import numpy as _np
+
+                totals = multihost_utils.process_allgather(
+                    _np.asarray([len(issues)], _np.int32)
+                )
+                remote = int(_np.sum(totals)) - len(issues)
+                if remote > 0 and args.data_validation == "error":
+                    raise DataValidationError(
+                        f"data validation failed on another process "
+                        f"({remote} issues elsewhere; local: {len(issues)})"
+                    )
+            apply_validation(issues, args.data_validation, logger)
+
     val_batch = common.load_validation(
         args.validation_input, source.dim, args.intercept, args.task
     )

@@ -338,10 +338,36 @@ def run(args: argparse.Namespace) -> dict:
         # Per-descent-iteration intermediate model (SURVEY.md §5): each
         # completed coordinate pass overwrites checkpoint/latest, so a
         # killed run resumes via --initial-model <out>/checkpoint/latest.
-        ckpt_dir = os.path.join(args.output_dir, "checkpoint", "latest")
+        ckpt_base = os.path.join(args.output_dir, "checkpoint")
+        ckpt_dir = os.path.join(ckpt_base, "latest")
 
         def checkpoint_fn(iteration, model):
-            save_game_model(ckpt_dir, model, index_maps, fmt=args.model_format)
+            # Atomic publish: write each checkpoint into an alternating slot
+            # dir, then atomically repoint the `latest` symlink (os.replace
+            # on a symlink is atomic; directories cannot be swapped
+            # atomically on POSIX) — a crash at ANY instant leaves `latest`
+            # resolving to a complete checkpoint (ADVICE r1).
+            import shutil
+
+            # Write into whichever slot `latest` does NOT currently resolve
+            # to, so the live checkpoint is never touched mid-write.
+            live = (
+                os.path.basename(os.path.realpath(ckpt_dir))
+                if os.path.islink(ckpt_dir) else None
+            )
+            slot = os.path.join(
+                ckpt_base, "slot-1" if live == "slot-0" else "slot-0"
+            )
+            shutil.rmtree(slot, ignore_errors=True)
+            save_game_model(slot, model, index_maps, fmt=args.model_format)
+            tmp_link = os.path.join(ckpt_base, ".latest.tmp")
+            if os.path.lexists(tmp_link):
+                os.remove(tmp_link)
+            if os.path.isdir(ckpt_dir) and not os.path.islink(ckpt_dir):
+                # Migrate a pre-symlink layout left by an older run.
+                shutil.rmtree(ckpt_dir)
+            os.symlink(os.path.basename(slot), tmp_link)
+            os.replace(tmp_link, ckpt_dir)
             logger.info("checkpoint: iteration %d -> %s", iteration, ckpt_dir)
 
     def fit_config(config) -> "object":

@@ -183,8 +183,18 @@ int64_t svm_parse(void* h, const int64_t* row_ptr, float* labels,
         while (p < e) {
           while (p < e && (*p == ' ' || *p == '\t')) p++;
           if (p >= e) break;
-          long id = strtol(p, &endp, 10);
+          // int64 parse: on 32-bit-long platforms strtol would saturate an
+          // overflowing id to exactly INT32_MAX and slip past the range
+          // check below.
+          long long id = strtoll(p, &endp, 10);
           if (endp == p || *endp != ':') {
+            errs[t] = 1;
+            return;
+          }
+          // Feature ids land in int32 storage after the zero/one-based
+          // adjustment; out-of-range ids (overflowing files, negative ids)
+          // must be a parse error, not a silent int32 wraparound.
+          if (id < off || id - off > INT32_MAX) {
             errs[t] = 1;
             return;
           }

@@ -55,19 +55,21 @@ def pallas_enabled() -> bool:
 _KERNEL_SUPPORTED: dict = {}
 
 
-def kernel_supported(loss: PointwiseLoss, nnz_capacity: int) -> bool:
-    """Eager capability probe, cached per (loss, nnz capacity): can Mosaic
-    lower the fused kernel for THIS loss and row layout?  A try/except
-    around the traced call cannot catch lowering failures (they surface
-    when the ENCLOSING jit compiles, e.g. inside the optimizer's
-    while_loop), and support is shape-dependent across TPU generations —
-    so probe the configuration actually about to run, eagerly, once."""
-    key = (loss.name, nnz_capacity)
+def kernel_supported(loss: PointwiseLoss, nnz_capacity: int, dim: int) -> bool:
+    """Eager capability probe, cached per (loss, nnz capacity, coefficient
+    dim): can Mosaic lower the fused kernel for THIS loss and layout?  A
+    try/except around the traced call cannot catch lowering failures (they
+    surface when the ENCLOSING jit compiles, e.g. inside the optimizer's
+    while_loop), and support is shape-dependent — the kernel's scatter
+    block shapes depend on the coefficient dimension, so probing a stand-in
+    dim would cache the wrong answer (ADVICE r1) — so probe the
+    configuration actually about to run, eagerly, once."""
+    key = (loss.name, nnz_capacity, dim)
     if key not in _KERNEL_SUPPORTED:
         try:
             args = (
                 loss,
-                jnp.zeros(8, jnp.float32),
+                jnp.zeros(dim, jnp.float32),
                 jnp.zeros((8, nnz_capacity), jnp.int32),
                 jnp.zeros((8, nnz_capacity), jnp.float32),
                 jnp.zeros(8, jnp.float32),

@@ -21,3 +21,15 @@ import jax  # noqa: E402
 # overridden by site customization, so set the config directly post-import.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+# Persistent compilation cache: the suite is dominated by XLA compiles of
+# optimizer while_loops and GAME programs that are identical run-to-run.
+# The cache dir is repo-local (gitignored) so repeated suite runs in one
+# workspace — including the driver's — hit warm.
+_cache_dir = os.environ.get(
+    "JAX_TEST_COMPILATION_CACHE",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_test_cache"),
+)
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -120,12 +120,12 @@ def test_train_and_score_game_drivers_synthetic(tmp_path):
     from photon_tpu.drivers import score_game, train_game
 
     out = str(tmp_path / "out")
-    spec = "synthetic-game:40:4:8:4:1:7"
+    spec = "synthetic-game:32:4:8:4:1:7"
     summary = train_game.run(train_game.build_parser().parse_args([
         "--backend", "cpu",
         "--input", spec,
-        "--coordinate", "fixed:type=fixed,shard=global,reg_weights=0.1+1,max_iters=15",
-        "--coordinate", "per_user:type=random,shard=re0,entity=re0,reg_weights=1,max_iters=10",
+        "--coordinate", "fixed:type=fixed,shard=global,reg_weights=0.1+1,max_iters=10",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,reg_weights=1,max_iters=8",
         "--descent-iterations", "2",
         "--validation-split", "0.25",
         "--output-dir", out,
@@ -195,12 +195,14 @@ def test_train_game_checkpoint_and_resume(tmp_path):
     from photon_tpu.drivers import train_game
 
     out = str(tmp_path / "out")
-    spec = "synthetic-game:30:4:8:4:1:11"
+    # Same shapes/iteration counts as the synthetic train+score test above so
+    # the persistent compilation cache shares the compiled GAME programs.
+    spec = "synthetic-game:32:4:8:4:1:11"
     base = [
         "--backend", "cpu",
         "--input", spec,
-        "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
-        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=6",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=8",
         "--descent-iterations", "2",
         "--validation-split", "0.25",
     ]

@@ -114,14 +114,14 @@ def test_train_game_driver_bayesian_tuning(tmp_path):
     out = str(tmp_path / "out")
     summary = train_game.run(train_game.build_parser().parse_args([
         "--backend", "cpu",
-        "--input", "synthetic-game:30:4:8:4:1:9",
-        "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
-        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=6",
+        "--input", "synthetic-game:32:4:8:4:1:9",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=5",
         "--validation-split", "0.25",
         "--tuning", "bayesian",
-        "--tuning-iterations", "5",
+        "--tuning-iterations", "3",
         "--tuning-range", "0.01:100",
         "--output-dir", out,
     ]))
-    assert len(summary["sweep"]) == 5
+    assert len(summary["sweep"]) == 3
     assert summary["best_metrics"]["AUC"] > 0.55

@@ -245,3 +245,25 @@ def test_train_driver_uses_native_parser(tmp_path):
     assert out["sweep"][0]["convergence_reason"] in (
         "GRADIENT_CONVERGED", "FUNCTION_VALUES_TOLERANCE", "MAX_ITERATIONS"
     )
+
+
+def test_native_parser_rejects_out_of_range_ids(tmp_path):
+    # int32-overflowing and sub-minimum feature ids must be parse errors in
+    # BOTH parsers, never a silent wraparound (ADVICE r1).
+    import pytest
+
+    from photon_tpu.data.libsvm import _parse_libsvm_py
+    from photon_tpu.native import libsvm_native
+
+    good = tmp_path / "good.libsvm"
+    good.write_text("1 1:1.0\n")
+    native_ok = libsvm_native.parse_file(str(good)) is not None
+
+    for bad in ["1 3000000000:1.0\n", "1 0:1.0\n"]:
+        p = tmp_path / "bad.libsvm"
+        p.write_text(bad)
+        with pytest.raises(ValueError):
+            _parse_libsvm_py(str(p), zero_based=False)
+        if native_ok:
+            with pytest.raises(ValueError):
+                libsvm_native.parse_file(str(p), zero_based=False)

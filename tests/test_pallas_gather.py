@@ -1,0 +1,117 @@
+"""Slab-aligned Pallas gather: layout correctness, skew-robust padding, and
+kernel (interpret-mode) equivalence — VERDICT r2 item 3.
+
+The kernel itself only lowers on real TPU hardware; here it runs in Pallas
+interpret mode, which exercises the same index math.  The layout builder is
+pure NumPy and is tested directly.
+"""
+
+import numpy as np
+import pytest
+
+from photon_tpu.ops.pallas_gather import (
+    LANES,
+    SLAB_POSITIONS,
+    AlignedLayout,
+    build_aligned_layout,
+    gather_products,
+    gather_products_reference,
+)
+
+
+def _coo(n, k, d, seed=0, dist="uniform"):
+    rng = np.random.default_rng(seed)
+    if dist == "zipf":
+        ids = ((rng.zipf(1.3, size=(n, k)) - 1) % d).astype(np.int32)
+    else:
+        ids = rng.integers(0, d, size=(n, k), dtype=np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    # Pad a random suffix of each row (the SparseBatch convention).
+    cut = rng.integers(1, k + 1, size=n)
+    mask = np.arange(k)[None, :] < cut[:, None]
+    return np.where(mask, ids, 0), np.where(mask, vals, 0.0).astype(np.float32)
+
+
+def _feature_sums(products: np.ndarray, layout: AlignedLayout, d: int) -> np.ndarray:
+    """Aggregate per-slot products back to features via dup_map (test-side)."""
+    n_sub = layout.lo.shape[0]
+    tile = np.arange(n_sub) // (layout.lo.shape[0] // layout.n_tiles)
+    s = layout.slab_of_tile[tile]
+    f = layout.dup_map[
+        s[:, None] * SLAB_POSITIONS + layout.lo * LANES + np.arange(LANES)[None, :]
+    ]
+    out = np.zeros(d, np.float64)
+    np.add.at(out, f.reshape(-1), products.reshape(-1).astype(np.float64))
+    return out
+
+
+@pytest.mark.parametrize("dist", ["uniform", "zipf"])
+def test_layout_preserves_entries(dist):
+    n, k, d = 2048, 12, 4096
+    ids, vals = _coo(n, k, d, seed=1, dist=dist)
+    lay = build_aligned_layout(ids, vals, d)
+    assert lay.n_entries == int((vals != 0).sum())
+    # Reference products through the layout == direct per-feature sums.
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal(d).astype(np.float32)
+    ref = gather_products_reference(w, lay)
+    got = _feature_sums(ref, lay, d)
+    want = np.zeros(d, np.float64)
+    np.add.at(want, ids.reshape(-1), (w[ids] * vals).reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("dist,limit", [("uniform", 1.35), ("zipf", 1.5)])
+def test_padding_factor_bounded(dist, limit):
+    # The round-2 layout measured 34.7x padding on zipf(1.3) (VERDICT r2
+    # weak #3); the bin-packed slab layout must stay near 1.
+    n, k, d = 65536, 32, 262144 // 16  # scaled-down bench shape, same regime
+    ids, vals = _coo(n, k, d, seed=3, dist=dist)
+    lay = build_aligned_layout(ids, vals, d)
+    assert lay.padding_factor <= limit, (
+        f"{dist}: padding {lay.padding_factor:.2f}x > {limit}"
+    )
+
+
+def test_pad_slots_are_zero():
+    ids, vals = _coo(512, 8, 1024, seed=4)
+    lay = build_aligned_layout(ids, vals, 1024)
+    w = np.random.default_rng(5).standard_normal(1024).astype(np.float32)
+    ref = gather_products_reference(w, lay)
+    # All slots with val==0 must produce exactly 0 (no pad contamination).
+    assert (ref[lay.vals == 0.0] == 0.0).all()
+
+
+def test_kernel_interpret_matches_reference():
+    ids, vals = _coo(1024, 8, 2048, seed=6, dist="zipf")
+    lay = build_aligned_layout(ids, vals, 2048)
+    w = np.random.default_rng(7).standard_normal(2048).astype(np.float32)
+    out = np.asarray(gather_products(w, lay, interpret=True))
+    ref = gather_products_reference(w, lay)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_odd_dim_supported():
+    # The slab dictionary decouples the layout from the feature space: no
+    # dim % 1024 restriction (round-2 layout required it).
+    ids, vals = _coo(256, 4, 1000, seed=8)
+    lay = build_aligned_layout(ids, vals, 1000)
+    w = np.random.default_rng(9).standard_normal(1000).astype(np.float32)
+    got = _feature_sums(gather_products_reference(w, lay), lay, 1000)
+    want = np.zeros(1000, np.float64)
+    np.add.at(want, ids.reshape(-1), (w[ids] * vals).reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_out_of_range_ids_rejected():
+    ids = np.array([[0, 5]], np.int32)
+    vals = np.ones((1, 2), np.float32)
+    with pytest.raises(ValueError, match="out of range"):
+        build_aligned_layout(ids, vals, 4)
+
+
+def test_empty_batch():
+    lay = build_aligned_layout(
+        np.zeros((4, 3), np.int32), np.zeros((4, 3), np.float32), 64
+    )
+    assert lay.n_entries == 0 and lay.padding_factor >= 1.0

@@ -199,3 +199,26 @@ def test_make_global_batch_single_process():
     v1, _ = obj.value_and_grad(w, batch)
     v2, _ = obj.value_and_grad(w, global_batch)
     np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+
+
+def test_streaming_path_validates_data(tmp_path):
+    # ADVICE r1: --stream used to skip data validation entirely.
+    import pytest
+
+    from photon_tpu.data.validation import DataValidationError
+    from photon_tpu.drivers import train
+
+    bad = tmp_path / "bad.libsvm"
+    bad.write_text("nan 1:1.0\n1 2:1.0\n-1 1:0.5\n")
+    args = [
+        "--input", str(bad), "--task", "logistic_regression",
+        "--stream", "--max-iterations", "3",
+        "--output-dir", str(tmp_path / "out"),
+    ]
+    with pytest.raises(DataValidationError):
+        train.run(train.build_parser().parse_args(
+            args + ["--data-validation", "error"]))
+    # off -> trains (NaN label flows into the data; run must still finish)
+    summary = train.run(train.build_parser().parse_args(
+        args + ["--data-validation", "off"]))
+    assert summary is not None

@@ -135,3 +135,70 @@ def test_game_random_effect_full_variance():
     assert np.all(np.isfinite(v)) and np.all(v >= 0)
     # Entities with data have strictly positive variances (l2 bounds them).
     assert v.max() > 0
+
+
+# ---------------------------------------------------------------------------
+# Matrix-free FULL variance (large-d guard: core/variance.py, VERDICT r2 #7)
+# ---------------------------------------------------------------------------
+
+
+def test_cg_solve_matches_direct():
+    from photon_tpu.core.variance import cg_solve
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((24, 24)).astype(np.float32)
+    h = a @ a.T + 24 * np.eye(24, dtype=np.float32)
+    b = rng.standard_normal(24).astype(np.float32)
+    x = np.asarray(cg_solve(lambda v: jnp.asarray(h) @ v, jnp.asarray(b)))
+    np.testing.assert_allclose(h @ x, b, rtol=1e-3, atol=1e-4)
+
+
+def test_hutchinson_exact_for_orthogonal_features():
+    # Each example touches exactly one feature -> H is diagonal, and the
+    # Rademacher estimator is exact for ANY probe (z_j^2 = 1).
+    from photon_tpu.core.variance import hutchinson_diag_inverse
+
+    d, per = 16, 8
+    rng = np.random.default_rng(1)
+    ids = np.repeat(np.arange(d, dtype=np.int32), per)[:, None]
+    vals = rng.uniform(0.5, 2.0, (d * per, 1)).astype(np.float32)
+    batch = SparseBatch(
+        jnp.asarray(ids), jnp.asarray(vals),
+        jnp.asarray((rng.random(d * per) < 0.5).astype(np.float32)),
+        jnp.zeros(d * per, jnp.float32), jnp.ones(d * per, jnp.float32),
+    )
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.5))
+    w = jnp.asarray(rng.standard_normal(d), jnp.float32) * 0.1
+    est = np.asarray(hutchinson_diag_inverse(
+        lambda v: obj.hessian_vector(w, v, batch), dim=d, num_probes=2
+    ))
+    h = np.asarray(obj.hessian_matrix(w, batch))
+    assert np.abs(h - np.diag(np.diag(h))).max() < 1e-5  # H really is diagonal
+    np.testing.assert_allclose(est, 1.0 / np.diag(h), rtol=1e-3)
+
+
+def test_full_variance_routes_matrix_free_above_threshold(monkeypatch):
+    import photon_tpu.core.variance as variance_mod
+
+    # Force the CG path at a tiny dim and compare against the dense answer
+    # on a diagonal-Hessian problem (where the estimator is exact).
+    monkeypatch.setattr(variance_mod, "FULL_DENSE_MAX_DIM", 4)
+    d, per = 12, 6
+    rng = np.random.default_rng(2)
+    ids = np.repeat(np.arange(d, dtype=np.int32), per)[:, None]
+    vals = rng.uniform(0.5, 2.0, (d * per, 1)).astype(np.float32)
+    batch = SparseBatch(
+        jnp.asarray(ids), jnp.asarray(vals),
+        jnp.asarray((rng.random(d * per) < 0.5).astype(np.float32)),
+        jnp.zeros(d * per, jnp.float32), jnp.ones(d * per, jnp.float32),
+    )
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    prob = GlmOptimizationProblem(
+        obj, ProblemConfig(variance_computation="full")
+    )
+    coeffs, _ = prob.run(batch, dim=d)
+    assert coeffs.variances is not None
+    h = np.asarray(obj.hessian_matrix(jnp.asarray(coeffs.means), batch))
+    np.testing.assert_allclose(
+        np.asarray(coeffs.variances), 1.0 / np.diag(h), rtol=1e-3
+    )

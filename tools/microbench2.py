@@ -1,8 +1,13 @@
-"""Re-run primitive benchmarks with scalar-reduced outputs.
+"""Decision-grade micro-benchmarks of the sparse-GLM primitive ops.
 
 The axon-tunneled TPU platform makes device->host copies of large outputs
 dominate wall time (a 134MB fetch costs ~700ms), so every timed program here
 reduces its result to a scalar INSIDE jit; only 4 bytes cross the tunnel.
+Each row reports throughput against ITS OWN element count (a pallas row
+processes padded slots, not raw entries).
+
+Run on the real chip; record the table in photon_tpu/ops/KERNEL_NOTES.md —
+it decides whether the crossing-stage kernels are worth building.
 """
 
 from __future__ import annotations
@@ -40,40 +45,60 @@ def main():
 
     flat = ids.reshape(-1)
     order = np.argsort(flat, kind="stable").astype(np.int32)
+    sorted_ids = jnp.asarray(flat[order])
+    rows_sorted = jnp.asarray((order // k).astype(np.int32))
     perm = jnp.asarray(order)
     qe = jnp.asarray(rng.standard_normal(e).astype(np.float32))
     u = jnp.asarray(rng.standard_normal(n).astype(np.float32))
 
-    res = {}
-    res["fused margins rowsum (fwd today)"] = timeit = tm(
+    res = {}  # name -> (seconds, element_count)
+    res["fwd: gather w[ids] + rowsum margins"] = (tm(
         lambda w, i, v: jnp.sum((jnp.take(w, i, axis=0) * v).sum(axis=-1)),
-        w, ids_j, vals_j)
-    res["gather w[ids] + sum"] = tm(
-        lambda w, i: jnp.sum(jnp.take(w, i.reshape(-1), axis=0)), w, ids_j)
-    res["permute 33.5M + sum"] = tm(
-        lambda q, p: jnp.sum(jnp.take(q, p, axis=0)), qe, perm)
-    res["cumsum 33.5M + last"] = tm(lambda q: jnp.cumsum(q)[-1], qe)
-    res["scatter-add 33.5M->d + sum"] = tm(
+        w, ids_j, vals_j), e)
+    res["gather dz[rows] 33.5M from 4MB"] = (tm(
+        lambda u, r: jnp.sum(jnp.take(u, r, axis=0)), u, rows_sorted), e)
+    res["permute 33.5M from 134MB"] = (tm(
+        lambda q, p: jnp.sum(jnp.take(q, p, axis=0)), qe, perm), e)
+    res["cumsum 33.5M"] = (tm(lambda q: jnp.cumsum(q)[-1], qe), e)
+    res["bwd today: scatter-add unsorted"] = (tm(
         lambda q, i: jnp.sum(jnp.zeros(d, jnp.float32).at[i.reshape(-1)].add(q)),
-        qe, ids_j)
-    res["u bcast [n,k] flat + sum"] = tm(
-        lambda v, u: jnp.sum((v * u[:, None]).reshape(-1)), vals_j, u)
+        qe, ids_j), e)
+    res["bwd fast: segment_sum sorted"] = (tm(
+        lambda q, i: jnp.sum(jax.ops.segment_sum(
+            q, i, num_segments=d, indices_are_sorted=True)), qe, sorted_ids), e)
 
     try:
         from photon_tpu.ops.pallas_gather import (
             aligned_gather_products, build_aligned_layout)
         lay = build_aligned_layout(ids, vals, d)
-        gmap = jnp.asarray(lay.group_of_tile)
+        smap = jnp.asarray(lay.slab_of_tile)
         lo = jnp.asarray(lay.lo)
         lvals = jnp.asarray(lay.vals)
-        t = tm(lambda w, g, l, v: jnp.sum(aligned_gather_products(w, g, l, v)),
-               w, gmap, lo, lvals)
-        res[f"pallas aligned gather+sum ({lay.padded_entries/1e6:.0f}M slots)"] = t
+        dup = jnp.asarray(lay.dup_map)
+        t = tm(lambda w, s, l, v: jnp.sum(aligned_gather_products(w, s, l, v)),
+               jnp.take(w, dup, axis=0).reshape(-1, 128), smap, lo, lvals)
+        res[f"pallas aligned gather (pad {lay.padding_factor:.2f}x)"] = (
+            t, lay.padded_entries)
+        res["dup-gather w[dup_map]"] = (tm(
+            lambda w, m: jnp.sum(jnp.take(w, m, axis=0)), w, dup), dup.size)
     except Exception as ex:  # noqa: BLE001
         print("pallas aligned gather FAILED:", str(ex)[:200])
 
-    for name, t in res.items():
-        print(f"{name:45s} {t*1e3:8.2f} ms   {e/t/1e9:7.2f} Gelem/s")
+    # End-to-end: the two production value_and_grad paths.
+    from photon_tpu.core.objective import GlmObjective, RegularizationContext
+    from photon_tpu.data.batch import SparseBatch, attach_feature_major
+
+    batch = SparseBatch(ids_j, vals_j, jnp.asarray((rng.random(n) < 0.5).astype(np.float32)),
+                        jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32))
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    res["value_and_grad autodiff (r1 path)"] = (tm(
+        lambda w: obj.value_and_grad(w, batch)[1].sum(), w), e)
+    fast = attach_feature_major(batch)
+    res["value_and_grad fast (fm path)"] = (tm(
+        lambda w: obj.value_and_grad(w, fast)[1].sum(), w), e)
+
+    for name, (t, cnt) in res.items():
+        print(f"{name:45s} {t*1e3:8.2f} ms   {cnt/t/1e9:7.2f} Gelem/s")
 
 
 if __name__ == "__main__":
