@@ -77,6 +77,7 @@ def hutchinson_diag_inverse(
     num_probes: int = 32,
     cg_tol: float = 1e-5,
     cg_max_iterations: int = 250,
+    jitter: float = 1e-9,
 ) -> Array:
     """Estimate ``diag(H⁻¹)`` via Rademacher probes and CG solves.
 
@@ -89,9 +90,15 @@ def hutchinson_diag_inverse(
     """
     keys = jax.random.split(jax.random.PRNGKey(seed), num_probes)
 
+    # Same flat-direction guard as the dense path's 1e-9*I jitter
+    # (problem.py): with no regularization and unreached features H is
+    # singular and raw CG would diverge, contaminating every coordinate.
+    def hvp_reg(v):
+        return hvp(v) + jitter * v
+
     def one_probe(acc, key):
         z = jax.random.rademacher(key, (dim,), dtype=jnp.float32)
-        x = cg_solve(hvp, z, tol=cg_tol, max_iterations=cg_max_iterations)
+        x = cg_solve(hvp_reg, z, tol=cg_tol, max_iterations=cg_max_iterations)
         return acc + z * x, None
 
     total, _ = lax.scan(one_probe, jnp.zeros(dim, jnp.float32), keys)

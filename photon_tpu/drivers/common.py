@@ -40,6 +40,53 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
                         help="write a jax.profiler trace of the train phase")
 
 
+def add_distributed_args(parser: argparse.ArgumentParser) -> None:
+    """Multi-process (multi-host) runtime flags (SURVEY.md §2.6, §7 step 7).
+
+    The reference scales out through Spark's cluster manager; the TPU
+    rebuild uses JAX's distributed runtime: every process calls
+    ``jax.distributed.initialize`` against process 0's coordinator, after
+    which one global mesh spans all processes' devices and `pjit`/shard_map
+    emit ICI/DCN collectives across them.
+    """
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port of process 0; presence of this flag "
+                        "enables the multi-process runtime "
+                        "(jax.distributed.initialize)")
+    parser.add_argument("--process-id", type=int, default=None,
+                        help="this process's rank in [0, --num-processes)")
+    parser.add_argument("--num-processes", type=int, default=None,
+                        help="total number of processes in the job")
+
+
+def maybe_init_distributed(args: argparse.Namespace) -> bool:
+    """Initialize the JAX distributed runtime when --coordinator is given.
+
+    Must run before any backend/device use (the runtime wires the
+    coordination service into backend creation).  Returns True when the
+    process joined a multi-process job.
+    """
+    coordinator = getattr(args, "coordinator", None)
+    if coordinator is None:
+        return False
+    if getattr(args, "process_id", None) is None or getattr(
+        args, "num_processes", None
+    ) is None:
+        raise ValueError(
+            "--coordinator requires --process-id and --num-processes"
+        )
+    import jax
+
+    # Backend choice must be pinned before initialize() touches devices.
+    select_backend(getattr(args, "backend", "tpu"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    return True
+
+
 def add_data_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--input", required=True,
                         help="training data: a LIBSVM file path, or "

@@ -32,6 +32,7 @@ def build_parser() -> argparse.ArgumentParser:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     common.add_common_args(p)
+    common.add_distributed_args(p)
     common.add_data_args(p)
     p.add_argument("--task", default="logistic_regression",
                    choices=("logistic_regression", "linear_regression",
@@ -120,16 +121,31 @@ def _run_streaming(args: argparse.Namespace) -> dict:
         # Streamed data must get the same validation as resident data
         # (ADVICE r1: the streaming path skipped it entirely): one extra
         # host pass over this process's chunks before training starts.
+        from photon_tpu.data.libsvm import normalize_binary_labels, parse_libsvm
         from photon_tpu.data.validation import (
             DataValidationError,
+            _feature_issues,
             apply_validation,
-            validate_batch,
+            validate_columns,
         )
 
         with logger.timed("validate-data"):
+            # Host-side pass over the raw parses: no device round-trip for
+            # data that is streamed precisely because it is large.
             issues = []
-            for chunk in source.chunk_iter_factory():
-                issues.extend(validate_batch(chunk, args.task))
+            for fpath in source.files:
+                data = parse_libsvm(fpath)
+                labels = data.labels
+                if args.task in BINARY_TASKS:
+                    labels = normalize_binary_labels(labels)
+                issues.extend(validate_columns(labels, None, None, args.task))
+                if data.rows:
+                    allv = np.concatenate([v for _, v in data.rows])
+                    issues.extend(
+                        _feature_issues(
+                            allv.reshape(-1, 1), os.path.basename(fpath)
+                        )
+                    )
             if jax.process_count() > 1:
                 # Agreement step: every process must reach the same
                 # pass/fail decision, else a bad shard on one host would
@@ -214,7 +230,7 @@ def _run_streaming(args: argparse.Namespace) -> dict:
 
 
 def run(args: argparse.Namespace) -> dict:
-    common.select_backend(args.backend)
+    common.maybe_init_distributed(args) or common.select_backend(args.backend)
     if getattr(args, "stream", False):
         return _run_streaming(args)
     # Imports after backend pinning (device init happens on first jax use).

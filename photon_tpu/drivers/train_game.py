@@ -39,6 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     common.add_common_args(p)
+    common.add_distributed_args(p)
     p.add_argument("--input", required=True,
                    help="training data: Avro file/dir/glob, or "
                    "synthetic-game:<entities>:<rows_mean>:<fixed_dim>:"
@@ -253,7 +254,7 @@ def _load_game_data(spec: str, args, index_maps=None):
 
 
 def run(args: argparse.Namespace) -> dict:
-    common.select_backend(args.backend)
+    common.maybe_init_distributed(args) or common.select_backend(args.backend)
     from photon_tpu.evaluation.evaluators import (
         MultiEvaluator,
         default_evaluators_for_task,
@@ -332,9 +333,16 @@ def run(args: argparse.Namespace) -> dict:
         logger=logger,
     )
 
+    import jax as _jax
+
+    # Multi-process runs: only process 0 writes checkpoints, models, and
+    # summaries (the reference's driver-writes semantics; every rank still
+    # participates in the collectives inside fit).
+    is_primary = _jax.process_index() == 0
+
     results = []
     checkpoint_fn = None
-    if args.checkpoint:
+    if args.checkpoint and is_primary:
         # Per-descent-iteration intermediate model (SURVEY.md §5): each
         # completed coordinate pass overwrites checkpoint/latest, so a
         # killed run resumes via --initial-model <out>/checkpoint/latest.
@@ -364,10 +372,21 @@ def run(args: argparse.Namespace) -> dict:
             if os.path.lexists(tmp_link):
                 os.remove(tmp_link)
             if os.path.isdir(ckpt_dir) and not os.path.islink(ckpt_dir):
-                # Migrate a pre-symlink layout left by an older run.
-                shutil.rmtree(ckpt_dir)
+                # Migrate a pre-symlink layout: park the old dir aside first
+                # (never deleted until the new link is live).  A dir cannot
+                # be atomically replaced by a symlink on POSIX, so migration
+                # has a one-time window where `latest` is missing — both
+                # `latest.pre-symlink` and the new slot hold complete
+                # checkpoints throughout it.
+                aside = ckpt_dir + ".pre-symlink"
+                shutil.rmtree(aside, ignore_errors=True)
+                os.rename(ckpt_dir, aside)
+            else:
+                aside = None
             os.symlink(os.path.basename(slot), tmp_link)
             os.replace(tmp_link, ckpt_dir)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
             logger.info("checkpoint: iteration %d -> %s", iteration, ckpt_dir)
 
     def fit_config(config) -> "object":
@@ -376,7 +395,7 @@ def run(args: argparse.Namespace) -> dict:
             checkpoint_fn=checkpoint_fn,
         )[0]
         results.append(result)
-        if args.checkpoint or args.save_all_models:
+        if (args.checkpoint or args.save_all_models) and is_primary:
             save_game_model(
                 os.path.join(args.output_dir, f"model_{config.name}"),
                 result.model, index_maps, fmt=args.model_format,
@@ -442,6 +461,8 @@ def run(args: argparse.Namespace) -> dict:
                     name=label,
                 ))
     best = estimator.select_best(results)
+    if not is_primary:
+        return {"rank": _jax.process_index(), "best": best.configuration.name}
 
     with logger.timed("save-model"):
         save_game_model(
