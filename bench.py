@@ -173,8 +173,9 @@ def _bench_config(num: int) -> None:
     tmp = tempfile.mkdtemp(prefix="photon_bench_")
 
     if num in (1, 2, 3):
-        # (1) a1a-shaped logistic + L-BFGS; (2) linear elastic-net OWL-QN;
-        # (3) Poisson TRON.  All through the legacy-driver path.
+        # (1) a1a-statistics logistic + L-BFGS (committed fixture, real AUC
+        # anchor); (2) linear elastic-net OWL-QN; (3) Poisson TRON.  All
+        # through the legacy-driver path.
         from photon_tpu.drivers import train
 
         task, opt, reg = {
@@ -182,39 +183,80 @@ def _bench_config(num: int) -> None:
             2: ("linear_regression", "owlqn", "elastic_net"),
             3: ("poisson_regression", "tron", "l2"),
         }[num]
-        n, d = (1605, 123) if num == 1 else ((200_000, 1024) if big else (5000, 128))
-        batch, _ = make_glm_data(n, d, task=task, seed=0)
-        path = os.path.join(tmp, "train.libsvm")
-        write_libsvm(path, np.asarray(batch.x)[:, :-1], np.asarray(batch.label))
+        extra = []
+        if num == 1:
+            from photon_tpu.data.fixtures import a1a_fixture_paths
+
+            path, test_path = a1a_fixture_paths()
+            n, d = 1605, 123
+            extra = ["--validation-input", test_path]
+        else:
+            n, d = (200_000, 1024) if big else (5000, 128)
+            batch, _ = make_glm_data(n, d, task=task, seed=0)
+            path = os.path.join(tmp, "train.libsvm")
+            write_libsvm(path, np.asarray(batch.x)[:, :-1], np.asarray(batch.label))
         t0 = time.perf_counter()
         summary = train.run(train.build_parser().parse_args([
             "--input", path, "--task", task, "--optimizer", opt,
             "--reg-type", reg, "--reg-weights", "1.0",
             "--max-iterations", "100",
             "--output-dir", os.path.join(tmp, "out"),
-        ]))
+        ] + extra))
         wall = time.perf_counter() - t0
         entry = summary["sweep"][0]
         _emit(f"config{num}_fit_seconds", wall, "s", {
             "task": task, "optimizer": opt, "rows": n, "dim": d,
             "iterations": entry["iterations"],
             "reason": entry["convergence_reason"],
+            "rows_per_sec": round(n * entry["iterations"] / max(wall, 1e-9), 1),
+            "metrics": entry.get("metrics"),
             "platform": platform,
         })
         return
 
-    # (4) GAME fixed + user random effect (MovieLens-1M shape);
+    # (4) GAME fixed + user random effect on the MovieLens-shaped fixture
+    #     (real Avro path, zipf item popularity, per-user skew);
     # (5) GAME fixed + user + item random effects (LinkedIn-scale, scaled
     #     to the chip: rows/sec is the comparable number).
     from photon_tpu.drivers import train_game
 
     if num == 4:
-        spec = "synthetic-game:6040:166:64:16:1:0" if big else \
-            "synthetic-game:600:16:32:8:1:0"
+        from photon_tpu.data.fixtures import make_movielens_like
+        from photon_tpu.data.game_io import write_game_avro
+
+        # MovieLens-1M user/item counts; ratings-per-user scaled so the
+        # host-side Avro fixture write stays bounded (~300K rows).
+        ml_kw = dict(n_users=6040, n_items=3700, mean_ratings=50) if big \
+            else {}
+        data, ml_maps = make_movielens_like(**ml_kw)
+        avro_path = os.path.join(tmp, "movielens.avro")
+        write_game_avro(avro_path, data, ml_maps)
         coords = [
             "--coordinate", "fixed:type=fixed,shard=global,max_iters=30",
-            "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=20",
+            "--coordinate",
+            "per_user:type=random,shard=per_user,entity=userId,max_iters=20",
         ]
+        t0 = time.perf_counter()
+        summary = train_game.run(train_game.build_parser().parse_args([
+            "--input", avro_path,
+            "--feature-bags", "global=global,per_user=per_user",
+            "--id-columns", "userId,itemId",
+            *coords,
+            "--descent-iterations", "2",
+            "--validation-split", "0.2",
+            "--output-dir", os.path.join(tmp, "out"),
+        ]))
+        wall = time.perf_counter() - t0
+        n_rows = data.num_examples
+        _emit("config4_game_epoch_seconds", wall / 2.0, "s/epoch", {
+            "fixture": "movielens-like",
+            "metrics": summary["best_metrics"],
+            "rows": n_rows,
+            "users": len(set(np.asarray(data.id_columns["userId"]).tolist())),
+            "rows_per_sec": round(2.0 * n_rows / wall, 1),
+            "platform": platform,
+        })
+        return
     else:
         spec = "synthetic-game:20000:100:128:16:2:0" if big else \
             "synthetic-game:400:12:32:8:2:0"
@@ -246,6 +288,18 @@ def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--config":
         _bench_config(int(sys.argv[2]))
         return
+    if len(sys.argv) <= 1 or sys.argv[1] != "--headline-only":
+        # Default run: all five SURVEY.md §6 configs first (one JSON line
+        # each; a failing config emits its own error line and never blocks
+        # the others), then the headline metric LAST — drivers that parse a
+        # single line take the final one.
+        for num in (1, 2, 3, 4, 5):
+            try:
+                _bench_config(num)
+            except Exception as ex:  # noqa: BLE001 — config isolation
+                _emit(f"config{num}_error", 0.0, "error", {
+                    "error": f"{type(ex).__name__}: {ex}"[:500],
+                })
     import jax
     import jax.numpy as jnp
 

@@ -230,9 +230,20 @@ def _run_streaming(args: argparse.Namespace) -> dict:
 
 
 def run(args: argparse.Namespace) -> dict:
-    common.maybe_init_distributed(args) or common.select_backend(args.backend)
+    distributed = common.maybe_init_distributed(args)
+    if not distributed:
+        common.select_backend(args.backend)
     if getattr(args, "stream", False):
         return _run_streaming(args)
+    if distributed:
+        # The resident-data path has no work to split across processes —
+        # every rank would redundantly load the full dataset and race on
+        # the output files.  Multi-process GLM training is the streaming
+        # path's job (per-process file shards + cross-process gradient sum).
+        raise ValueError(
+            "--coordinator requires --stream for this driver (the resident-"
+            "data path is single-process; use --stream for multi-process)"
+        )
     # Imports after backend pinning (device init happens on first jax use).
     import jax
 

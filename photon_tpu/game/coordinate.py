@@ -56,7 +56,7 @@ from photon_tpu.game.model import (
     shard_to_batch,
 )
 from photon_tpu.models.glm import Coefficients, model_for_task
-from photon_tpu.parallel.mesh import DATA_AXIS, shard_batch
+from photon_tpu.parallel.mesh import DATA_AXIS, shard_batch, to_host
 
 Array = jax.Array
 
@@ -388,7 +388,7 @@ class RandomEffectCoordinate:
             raise ValueError(
                 f"warm-start model dim {initial_model.dim} != coordinate dim {self.dim}"
             )
-        aligned[:-1][found] = np.asarray(initial_model.table)[src_idx[found]]
+        aligned[:-1][found] = to_host(initial_model.table)[src_idx[found]]
         return jnp.asarray(aligned)
 
     def train(
@@ -426,7 +426,7 @@ class RandomEffectCoordinate:
                 else:
                     # Projection restriction is host-side numpy (built once
                     # per descent iteration per bucket; warm-start only).
-                    w0_global = np.asarray(init_table)[np.asarray(entity_idx)]
+                    w0_global = to_host(init_table)[np.asarray(entity_idx)]
                     w0 = self.device_data._place(
                         jnp.asarray(proj.restrict_table(w0_global))
                     )
@@ -458,11 +458,11 @@ class RandomEffectCoordinate:
                     )
             real = bucket.entity_index < num_entities
             stats["entities"] += int(real.sum())
-            stats["converged"] += int(np.asarray(result.converged)[real].sum())
+            stats["converged"] += int(to_host(result.converged)[real].sum())
             if real.any():
                 stats["iterations_max"] = max(
                     stats["iterations_max"],
-                    int(np.asarray(result.iterations)[real].max()),
+                    int(to_host(result.iterations)[real].max()),
                 )
         model = RandomEffectModel(
             table=table[:num_entities],

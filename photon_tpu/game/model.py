@@ -27,6 +27,7 @@ import numpy as np
 from photon_tpu.core.losses import get_loss
 from photon_tpu.data.batch import DenseBatch, SparseBatch
 from photon_tpu.game.data import DenseShard, GameDataset, Shard, SparseShard
+from photon_tpu.parallel.mesh import to_host
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel, model_for_task
 
 Array = jax.Array
@@ -95,7 +96,7 @@ class FixedEffectModel:
     def score(self, data: GameDataset) -> np.ndarray:
         """Raw margins ``w . x_i`` over the dataset's shard (no offset)."""
         feats, dense = _shard_feats(data.shard(self.shard_name))
-        return np.asarray(_fixed_margins(self.coefficients.means, feats, dense))
+        return to_host(_fixed_margins(self.coefficients.means, feats, dense))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,7 +136,7 @@ class RandomEffectModel:
 
         entity_idx = entity_index_for(data.id_columns[self.entity_column], self.keys)
         feats, dense = _shard_feats(data.shard(self.shard_name))
-        return np.asarray(
+        return to_host(
             _random_margins(self.table, jnp.asarray(entity_idx), feats, dense)
         )
 

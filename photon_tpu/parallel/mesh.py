@@ -82,3 +82,19 @@ def shard_batch(
 
 def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
+
+
+def to_host(x) -> np.ndarray:
+    """``np.asarray`` that also works for multi-process sharded arrays.
+
+    In a multi-process job a globally-sharded ``jax.Array`` spans devices
+    this process cannot address; fetching it raises.  Gather the shards
+    across processes first (every host gets the full array — host fetches
+    in this framework are small: solver stats, model tables, score
+    vectors).  Single-process arrays pass straight through.
+    """
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)

@@ -142,3 +142,23 @@ def test_score_rejects_sharded_evaluators_before_scoring(tmp_path):
         ]))
     # The guard must fire before any scoring output is written.
     assert not os.path.exists(os.path.join(score_out, "scores.txt"))
+
+
+def test_a1a_fixture_anchor(tmp_path):
+    """The committed a1a-statistics fixture is a determinism anchor: a
+    regression in loss/optimizer/data plumbing moves its held-out AUC
+    (BASELINE.md round-3 table)."""
+    from photon_tpu.data.fixtures import a1a_fixture_paths
+    from photon_tpu.drivers import train
+
+    train_path, test_path = a1a_fixture_paths()
+    summary = train.run(train.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", train_path, "--validation-input", test_path,
+        "--task", "logistic_regression", "--optimizer", "lbfgs",
+        "--reg-type", "l2", "--reg-weights", "1.0",
+        "--max-iterations", "100",
+        "--output-dir", str(tmp_path / "out"),
+    ]))
+    auc = summary["sweep"][0]["metrics"]["AUC"]
+    assert 0.80 < auc < 0.87, f"a1a fixture AUC anchor moved: {auc}"

@@ -233,3 +233,76 @@ def test_two_process_streaming_driver_matches_single(tmp_path):
         final_value(single_out), rel=1e-4
     )
     assert not os.path.exists(os.path.join(outs[1], "training_summary.json"))
+
+
+GAME_WORKER = r"""
+import json, os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+sys.path.insert(0, sys.argv[1])
+coordinator, pid, out_dir = sys.argv[2], int(sys.argv[3]), sys.argv[4]
+from photon_tpu.drivers import train_game
+
+summary = train_game.run(train_game.build_parser().parse_args([
+    "--backend", "cpu",
+    "--coordinator", coordinator, "--process-id", str(pid),
+    "--num-processes", "2",
+    "--input", "synthetic-game:32:4:8:4:1:7",
+    "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
+    "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=8",
+    "--descent-iterations", "1",
+    "--validation-split", "0.25",
+    "--output-dir", out_dir,
+]))
+if pid == 0:
+    with open(os.path.join(out_dir, "mp_metrics.json"), "w") as f:
+        json.dump(summary["best_metrics"], f)
+"""
+
+
+def test_two_process_game_driver_matches_single(tmp_path):
+    """Full GAME training over a 2-process global mesh: fixed effect
+    data-sharded with psum, random effects entity-sharded, rank-0-only
+    writes — must reproduce the single-process metrics."""
+    from photon_tpu.drivers import train_game
+
+    argv = [
+        "--backend", "cpu",
+        "--input", "synthetic-game:32:4:8:4:1:7",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=8",
+        "--descent-iterations", "1",
+        "--validation-split", "0.25",
+    ]
+    single = train_game.run(train_game.build_parser().parse_args(
+        argv + ["--output-dir", str(tmp_path / "single")]))
+
+    worker = tmp_path / "game_worker.py"
+    worker.write_text(GAME_WORKER)
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("XLA_", "JAX_"))
+    }
+    outs = [str(tmp_path / f"mp{i}") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), REPO, coordinator, str(i), outs[i]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("GAME worker timed out (distributed hang)")
+        assert p.returncode == 0, f"GAME worker failed:\n{err[-2000:]}"
+
+    mp_metrics = json.load(open(os.path.join(outs[0], "mp_metrics.json")))
+    assert os.path.isdir(os.path.join(outs[0], "best_model"))
+    for name, value in single["best_metrics"].items():
+        assert mp_metrics[name] == pytest.approx(value, rel=2e-3), (
+            name, mp_metrics[name], value
+        )
