@@ -101,21 +101,59 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
                         choices=("error", "warn", "off"),
                         help="row sanity checks before training (the "
                         "reference's DataValidators strictness)")
+    parser.add_argument("--avro-feature-field", default="features",
+                        help="record field holding the feature array when "
+                        "--input is Avro (the reference's featureBagsPath "
+                        "default bag)")
 
 
 from photon_tpu.core.losses import BINARY_TASKS  # noqa: E402  (single source)
 
 
-def load_dataset(spec: str, intercept: bool, task: str = "logistic_regression"):
+def _is_avro_input(spec: str) -> bool:
+    if spec.endswith(".avro"):
+        return True
+    if os.path.isdir(spec):
+        return any(f.endswith(".avro") for f in os.listdir(spec))
+    return False
+
+
+def load_dataset(
+    spec: str,
+    intercept: bool,
+    task: str = "logistic_regression",
+    avro_field: str = "features",
+    index_map=None,
+):
     """Load (batch, dim, index_map) from an --input spec.
 
     LIBSVM {-1,+1} labels are normalized to {0,1} only for binary tasks;
-    regression labels pass through untouched.
+    regression labels pass through untouched.  Avro input (file/dir of
+    TrainingExampleAvro records, the reference's AvroDataReader feeding the
+    legacy driver — SURVEY.md §2.3) reads name/term features from
+    ``avro_field``; pass ``index_map`` to reproduce a training run's feature
+    indexing (features absent from the map are dropped).
     """
     from photon_tpu.data.index_map import IndexMap, feature_key
     from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
 
     binary = task in BINARY_TASKS
+    if _is_avro_input(spec):
+        from photon_tpu.data.game_io import read_game_avro
+        from photon_tpu.game.model import shard_to_batch
+
+        maps = None if index_map is None else {"global": index_map}
+        if os.path.isdir(spec):
+            # The directory qualified as Avro because it holds .avro files;
+            # read only those (a stray README must not reach the decoder).
+            spec = os.path.join(spec, "*.avro")
+        data, out_maps = read_game_avro(
+            spec, {"global": avro_field}, [], index_maps=maps,
+            intercept=intercept,
+        )
+        shard = data.shards["global"]
+        batch = shard_to_batch(shard, data.label, data.offset, data.weight)
+        return batch, shard.dim, out_maps["global"]
     if spec.startswith("synthetic:"):
         from photon_tpu.data.synthetic import make_glm_data
 
@@ -141,11 +179,25 @@ def load_dataset(spec: str, intercept: bool, task: str = "logistic_regression"):
 def load_validation(
     spec: Optional[str], train_dim: int, intercept: bool,
     task: str = "logistic_regression",
+    avro_field: str = "features",
+    index_map=None,
 ):
     """Load validation/scoring data padded to the training dimension
     (files whose max feature id is below the training dim are valid)."""
     if spec is None:
         return None
+    if _is_avro_input(spec):
+        if index_map is None:
+            raise ValueError(
+                "Avro validation input needs the training index map "
+                "(features must share the training run's indexing)"
+            )
+        batch, dim, _ = load_dataset(
+            spec, intercept, task, avro_field=avro_field, index_map=index_map
+        )
+        if dim != train_dim:
+            raise ValueError(f"validation dim {dim} != train dim {train_dim}")
+        return batch
     if spec.startswith("synthetic:"):
         batch, dim, _ = load_dataset(spec, intercept, task)
         if dim != train_dim:

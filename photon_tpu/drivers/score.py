@@ -67,6 +67,8 @@ def run(args: argparse.Namespace) -> dict:
         batch = common.load_validation(
             args.input, model.coefficients.dim, intercept,
             task=model.task_type,
+            avro_field=getattr(args, "avro_feature_field", "features"),
+            index_map=index_map,
         )
 
     with logger.timed("score"):

@@ -266,10 +266,12 @@ def run(args: argparse.Namespace) -> dict:
 
     with logger.timed("load-data"):
         batch, dim, index_map = common.load_dataset(
-            args.input, args.intercept, args.task
+            args.input, args.intercept, args.task,
+            avro_field=args.avro_feature_field,
         )
         val_batch = common.load_validation(
-            args.validation_input, dim, args.intercept, args.task
+            args.validation_input, dim, args.intercept, args.task,
+            avro_field=args.avro_feature_field, index_map=index_map,
         )
         logger.info("train: %d examples, %d features", batch.num_examples, dim)
 

@@ -15,7 +15,8 @@ Coordinate configs are ``name:key=value,...`` specs (or ``@file.json``):
         --coordinate per_user:type=random,shard=per_user,entity=userId,reg_weights=1 \\
         --descent-iterations 2 --validation-split 0.2 --output-dir out
 
-Spec keys: ``type`` (fixed|random), ``shard``, ``entity`` (random only),
+Spec keys: ``type`` (fixed|random|factored_random), ``shard``, ``entity``
+(random variants only), ``latent_dim``/``latent_iterations`` (factored),
 ``optimizer`` (lbfgs|owlqn|tron), ``reg_type``, ``reg_weights`` (``+``-joined
 sweep list), ``alpha`` (elastic net), ``max_iters``, ``tolerance``,
 ``variance`` (none|simple), ``active_row_cap`` (random), ``downsample``
@@ -100,6 +101,7 @@ _KNOWN_COORDINATE_KEYS = {
     "type", "shard", "entity", "optimizer", "reg_type", "reg_weights",
     "alpha", "max_iters", "tolerance", "variance", "active_row_cap",
     "downsample", "downsampler", "projection", "projected_dim", "seed",
+    "latent_dim", "latent_iterations",
 }
 
 
@@ -107,11 +109,13 @@ def _validate_coordinate(name: str, kv: dict, origin: str) -> tuple[str, dict]:
     unknown = set(kv) - _KNOWN_COORDINATE_KEYS
     if unknown:
         raise ValueError(f"unknown coordinate key(s) {sorted(unknown)} in {origin}")
-    if kv.get("type", "fixed") not in ("fixed", "random"):
-        raise ValueError(f"coordinate type must be fixed|random in {origin}")
+    if kv.get("type", "fixed") not in ("fixed", "random", "factored_random"):
+        raise ValueError(
+            f"coordinate type must be fixed|random|factored_random in {origin}"
+        )
     if "shard" not in kv:
         raise ValueError(f"coordinate {name!r} needs shard=<feature shard>")
-    if kv.get("type") == "random" and "entity" not in kv:
+    if kv.get("type") in ("random", "factored_random") and "entity" not in kv:
         raise ValueError(f"random coordinate {name!r} needs entity=<id column>")
     return name, kv
 
@@ -150,6 +154,7 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
     from photon_tpu.core.optimizers import OptimizerConfig
     from photon_tpu.core.problem import ProblemConfig
     from photon_tpu.game.coordinate import (
+        FactoredRandomEffectCoordinateConfig,
         FixedEffectCoordinateConfig,
         RandomEffectCoordinateConfig,
     )
@@ -183,6 +188,23 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
             seed=int(kv.get("seed", 0)),
         )
     cap = kv.get("active_row_cap")
+    if kv.get("type") == "factored_random":
+        if kv.get("projection") or kv.get("projected_dim") or kv.get("variance"):
+            raise ValueError(
+                "projection/projected_dim/variance are not supported for "
+                "factored_random coordinates (the latent projection IS the "
+                "dimensionality reduction; z-space variances do not "
+                "transport to w = L z)"
+            )
+        return FactoredRandomEffectCoordinateConfig(
+            shard_name=kv["shard"],
+            entity_column=kv["entity"],
+            latent_dim=int(kv.get("latent_dim", 4)),
+            latent_iterations=int(kv.get("latent_iterations", 2)),
+            problem=problem,
+            active_row_cap=None if cap in (None, "") else int(cap),
+            seed=int(kv.get("seed", 0)),
+        )
     pdim = kv.get("projected_dim")
     return RandomEffectCoordinateConfig(
         shard_name=kv["shard"],

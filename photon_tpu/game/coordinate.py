@@ -115,7 +115,61 @@ class RandomEffectCoordinateConfig:
         )
 
 
-CoordinateConfig = Union[FixedEffectCoordinateConfig, RandomEffectCoordinateConfig]
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectCoordinateConfig:
+    """Latent-factor random effect (reference: FactoredRandomEffectCoordinate,
+    SURVEY.md §2.2 [K?]): per-entity coefficients are constrained to a shared
+    ``latent_dim``-rank subspace, ``w_e = L z_e`` with ``L: [d, r]`` learned
+    on pooled data and ``z_e`` per entity — regularizing entities with few
+    rows far harder than a free per-entity fit."""
+
+    shard_name: str
+    entity_column: str
+    latent_dim: int = 4
+    problem: ProblemConfig = ProblemConfig()
+    # Alternations between the per-entity z solves and the pooled L solve
+    # (the reference's latent-space iteration count).
+    latent_iterations: int = 2
+    active_row_cap: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.latent_dim < 1:
+            raise ValueError("latent_dim must be >= 1")
+        if self.latent_iterations < 2:
+            # li=1 would fit z against the random-init projection and never
+            # solve L; li=0 would return an all-zero model.
+            raise ValueError("latent_iterations must be >= 2 (z,L,...,z)")
+        if self.problem.variance_computation != "none":
+            raise ValueError(
+                "variance computation is not supported for factored random "
+                "effects (z-space variances do not transport to w = L z)"
+            )
+
+    @property
+    def data_key(self):
+        # Same device data as an unprojected random coordinate: the latent
+        # projection is learned, so buckets hold raw features.
+        return (
+            "random", self.shard_name, self.entity_column,
+            self.active_row_cap, "none", None, self.seed,
+        )
+
+    def as_random_config(self) -> "RandomEffectCoordinateConfig":
+        return RandomEffectCoordinateConfig(
+            shard_name=self.shard_name,
+            entity_column=self.entity_column,
+            problem=self.problem,
+            active_row_cap=self.active_row_cap,
+            seed=self.seed,
+        )
+
+
+CoordinateConfig = Union[
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+    FactoredRandomEffectCoordinateConfig,
+]
 
 
 class Coordinate(Protocol):
@@ -478,6 +532,174 @@ class RandomEffectCoordinate:
         return model.score(self.data)
 
 
+class FactoredRandomEffectCoordinate:
+    """Latent-factor random effect: alternate vmapped per-entity latent
+    solves (``z_e``, dim r, on features ``x @ L``) with one pooled L-BFGS
+    solve of the shared projection ``L`` (margin linear in ``vec(L)``:
+    ``x_i @ L @ z_{e(i)}``).  Exports a plain :class:`RandomEffectModel`
+    with materialized ``w_e = L z_e`` so scoring, model IO, and warm start
+    reuse the unfactored machinery (the reference's factored coordinate
+    likewise yields per-entity GLMs)."""
+
+    def __init__(
+        self,
+        data: GameDataset,
+        config: FactoredRandomEffectCoordinateConfig,
+        task_type: str,
+        mesh=None,
+        device_data: Optional[RandomEffectDeviceData] = None,
+    ):
+        self.data = data
+        self.config = config
+        self.task_type = task_type
+        self.mesh = mesh
+        self.device_data = device_data or RandomEffectDeviceData(
+            data, config.as_random_config(), mesh
+        )
+        self.dataset = self.device_data.dataset
+        self.dim = self.dataset.dim
+        self.r = config.latent_dim
+        obj = GlmObjective.create(task_type, config.problem.regularization)
+        self.problem = GlmOptimizationProblem(obj, config.problem)
+        self._z_solver = jax.jit(jax.vmap(lambda b, w0: self.problem.run(b, w0)))
+        self._objective = obj
+        # Device-resident pooled-solve arrays + ONE jitted objective, built
+        # once: _solve_latent is called per latent iteration per sweep point,
+        # and rebuilding arrays/closures there would re-upload the dataset
+        # and recompile every call.
+        shard = self.data.shard(config.shard_name)
+        label = jnp.asarray(self.data.label, jnp.float32)
+        weight = jnp.asarray(self.data.weight, jnp.float32)
+        loss = obj.loss
+        l2 = obj.l2_weight
+        d, r = self.dim, self.r
+        if isinstance(shard, DenseShard):
+            x = jnp.asarray(shard.x)
+
+            def _latent_value(flat, z_rows, offsets):
+                latent = flat.reshape(d, r)
+                z = jnp.einsum("nd,dk,nk->n", x, latent, z_rows) + offsets
+                return (
+                    jnp.sum(weight * loss.value(z, label))
+                    + 0.5 * l2 * jnp.dot(flat, flat)
+                )
+        else:
+            ids = jnp.asarray(shard.ids)
+            vals = jnp.asarray(shard.vals)
+
+            def _latent_value(flat, z_rows, offsets):
+                latent = flat.reshape(d, r)
+                xl = jnp.einsum("njk,nj->nk", jnp.take(latent, ids, axis=0), vals)
+                z = jnp.sum(xl * z_rows, axis=-1) + offsets
+                return (
+                    jnp.sum(weight * loss.value(z, label))
+                    + 0.5 * l2 * jnp.dot(flat, flat)
+                )
+
+        self._latent_value_and_grad = jax.jit(jax.value_and_grad(_latent_value))
+
+    # -- bucket features projected by the current L ---------------------------
+    def _project_bucket(self, dev: dict, latent: Array) -> Array:
+        if dev["dense"]:
+            return jnp.einsum("erd,dk->erk", dev["feats"][0], latent)
+        ids, vals = dev["feats"]
+        # sum_k vals * L[ids]: [E, R, nnz, r] contracted over nnz.
+        return jnp.einsum(
+            "ernk,ern->erk", jnp.take(latent, ids, axis=0), vals
+        )
+
+    # -- pooled L solve -------------------------------------------------------
+    def _solve_latent(self, z_rows: Array, offsets: Array, latent0: Array) -> Array:
+        """Optimize ``L`` with all entities' ``z`` fixed: a GLM over
+        ``vec(L)`` whose margins are ``(x_i @ L) . z_i``."""
+        from photon_tpu.core.optimizers import lbfgs
+
+        result = lbfgs(
+            lambda w: self._latent_value_and_grad(w, z_rows, offsets),
+            latent0.reshape(-1),
+            self.config.problem.optimizer_config,
+        )
+        return result.w.reshape(self.dim, self.r)
+
+    def _warm_start(self, initial_model: RandomEffectModel):
+        """Recover (L, z) from a previous model's full-dim table via rank-r
+        SVD (coordinate descent passes the previous iteration's model; a
+        fresh random restart would discard all alternation progress)."""
+        aligned = np.zeros((self.dataset.num_entities + 1, self.dim), np.float32)
+        src_idx = entity_index_for(self.dataset.keys, np.asarray(initial_model.keys))
+        found = src_idx >= 0
+        aligned[:-1][found] = to_host(initial_model.table)[src_idx[found]]
+        u, s, vt = np.linalg.svd(aligned, full_matrices=False)
+        r = self.r
+        sq = np.sqrt(s[:r])
+        latent = (vt[:r].T * sq[None, :]).astype(np.float32)  # [d, r]
+        z = (u[:, :r] * sq[None, :]).astype(np.float32)  # [E+1, r]
+        return jnp.asarray(latent), jnp.asarray(z)
+
+    def train(
+        self, offsets: np.ndarray, initial_model: Optional[RandomEffectModel] = None
+    ) -> tuple[RandomEffectModel, dict]:
+        num_entities = self.dataset.num_entities
+        rng = np.random.default_rng(self.config.seed)
+        latent = jnp.asarray(
+            rng.standard_normal((self.dim, self.r)) / np.sqrt(self.dim),
+            jnp.float32,
+        )
+        offsets_j = jnp.asarray(offsets, jnp.float32)
+        entity_of_row = jnp.asarray(self.dataset.entity_idx_per_row, jnp.int32)
+        z_table = jnp.zeros((num_entities + 1, self.r), jnp.float32)
+        if initial_model is not None:
+            latent, z_table = self._warm_start(initial_model)
+            # Warm-started L is already informed: refresh it from the new
+            # offsets before the first z solve.
+            latent = self._solve_latent(
+                z_table[entity_of_row], offsets_j, latent
+            )
+        stats = {"entities": 0, "converged": 0, "iterations_max": 0,
+                 "latent_iterations": self.config.latent_iterations}
+
+        for it in range(self.config.latent_iterations):
+            last = it == self.config.latent_iterations - 1
+            stats.update({"entities": 0, "converged": 0, "iterations_max": 0})
+            for i, bucket in enumerate(self.device_data.buckets):
+                dev = self.device_data.device_buckets[i]
+                offsets_b = self.device_data._place(jnp.asarray(
+                    offsets[bucket.row_index] * (bucket.row_weight > 0),
+                    jnp.float32,
+                ))
+                feats = self._project_bucket(dev, latent)
+                batch = DenseBatch(feats, dev["label"], offsets_b, dev["weight"])
+                entity_idx = dev["entity_index"]
+                w0 = self.device_data._place(z_table[entity_idx])
+                coefficients, result = self._z_solver(batch, w0)
+                z_table = z_table.at[entity_idx].set(coefficients.means)
+                real = bucket.entity_index < num_entities
+                stats["entities"] += int(real.sum())
+                stats["converged"] += int(to_host(result.converged)[real].sum())
+                if real.any():
+                    stats["iterations_max"] = max(
+                        stats["iterations_max"],
+                        int(to_host(result.iterations)[real].max()),
+                    )
+            if not last:
+                z_rows = z_table[entity_of_row]
+                latent = self._solve_latent(z_rows, offsets_j, latent)
+
+        # Materialize per-entity coefficients w_e = L z_e (padded slot drops).
+        table = z_table[:num_entities] @ latent.T
+        model = RandomEffectModel(
+            table=table,
+            keys=self.dataset.keys,
+            entity_column=self.config.entity_column,
+            shard_name=self.config.shard_name,
+            task_type=self.task_type,
+        )
+        return model, stats
+
+    def score(self, model: RandomEffectModel) -> np.ndarray:
+        return model.score(self.data)
+
+
 def build_coordinate(
     data: GameDataset,
     config: CoordinateConfig,
@@ -490,11 +712,16 @@ def build_coordinate(
         return FixedEffectCoordinate(
             data, config, task_type, mesh, normalization, device_data
         )
-    if isinstance(config, RandomEffectCoordinateConfig):
+    if isinstance(config, (RandomEffectCoordinateConfig,
+                           FactoredRandomEffectCoordinateConfig)):
         if normalization is not None:
             raise ValueError(
                 "normalization is not supported for random-effect coordinates "
                 f"(coordinate on shard {config.shard_name!r})"
+            )
+        if isinstance(config, FactoredRandomEffectCoordinateConfig):
+            return FactoredRandomEffectCoordinate(
+                data, config, task_type, mesh, device_data
             )
         return RandomEffectCoordinate(data, config, task_type, mesh, device_data)
     raise TypeError(f"unknown coordinate config type {type(config)!r}")

@@ -106,8 +106,17 @@ class GameEstimator:
                     build_fm=self.normalization.get(coord_config.shard_name) is None,
                 )
             else:
+                from photon_tpu.game.coordinate import (
+                    FactoredRandomEffectCoordinateConfig,
+                )
+
+                rc = (
+                    coord_config.as_random_config()
+                    if isinstance(coord_config, FactoredRandomEffectCoordinateConfig)
+                    else coord_config
+                )
                 self._device_data_cache[key] = RandomEffectDeviceData(
-                    self.training_data, coord_config, self.mesh
+                    self.training_data, rc, self.mesh
                 )
         return self._device_data_cache[key]
 

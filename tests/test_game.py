@@ -339,3 +339,98 @@ def test_game_training_on_mesh_matches_single_device():
         np.asarray(sharded.model.coordinate("per-user").table),
         rtol=1e-3, atol=1e-3,
     )
+
+
+def test_factored_random_effect_coordinate():
+    """FactoredRandomEffectCoordinate (SURVEY.md §2.2 [K?]): when the true
+    per-entity effects share a low-rank subspace and rows are scarce, the
+    rank-constrained fit w_e = L z_e must generalize BETTER than the free
+    per-entity fit (that sharing is the component's entire point)."""
+    import numpy as np
+
+    from photon_tpu.core.objective import RegularizationContext
+    from photon_tpu.core.optimizers import OptimizerConfig
+    from photon_tpu.core.problem import ProblemConfig
+    from photon_tpu.data.index_map import IndexMap, feature_key
+    from photon_tpu.evaluation.evaluators import get_evaluator
+    from photon_tpu.game.coordinate import (
+        FactoredRandomEffectCoordinate,
+        FactoredRandomEffectCoordinateConfig,
+        RandomEffectCoordinate,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.game.data import DenseShard, GameDataset
+
+    rng = np.random.default_rng(17)
+    n_entities, rows_tr, rows_va, d, true_rank = 60, 6, 8, 10, 2
+    u_true = rng.standard_normal((d, true_rank)) * 1.6
+    z_true = rng.standard_normal((n_entities, true_rank))
+    w_true = z_true @ u_true.T  # [entities, d] — rank-2 effects
+
+    def make(rows_per):
+        n = n_entities * rows_per
+        ent = np.repeat(np.arange(n_entities), rows_per)
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        margin = np.einsum("nd,nd->n", x, w_true[ent])
+        label = (rng.random(n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+        return GameDataset(
+            shards={"re0": DenseShard(x)},
+            label=label,
+            offset=np.zeros(n, np.float32),
+            weight=np.ones(n, np.float32),
+            id_columns={"re0": ent},
+        )
+
+    train_ds, val_ds = make(rows_tr), make(rows_va)
+    prob = ProblemConfig(
+        regularization=RegularizationContext("l2", 1.0),
+        optimizer_config=OptimizerConfig(max_iterations=10),
+    )
+    offsets = np.zeros(train_ds.num_examples, np.float32)
+    auc = get_evaluator("AUC")
+
+    fc = FactoredRandomEffectCoordinate(
+        train_ds,
+        FactoredRandomEffectCoordinateConfig(
+            "re0", "re0", latent_dim=2, latent_iterations=4, problem=prob
+        ),
+        "logistic_regression",
+    )
+    m_fact, stats = fc.train(offsets)
+    assert stats["entities"] == n_entities
+    val_fact = auc.evaluate(
+        np.asarray(m_fact.score(val_ds)), val_ds.label, val_ds.weight
+    )
+
+    rc = RandomEffectCoordinate(
+        train_ds, RandomEffectCoordinateConfig("re0", "re0", problem=prob),
+        "logistic_regression",
+    )
+    m_free, _ = rc.train(offsets)
+    val_free = auc.evaluate(
+        np.asarray(m_free.score(val_ds)), val_ds.label, val_ds.weight
+    )
+    assert val_fact > 0.78, f"factored val AUC too low: {val_fact}"
+    assert val_fact > val_free + 0.03, (val_fact, val_free)
+
+
+def test_factored_random_effect_driver_spec(tmp_path):
+    """type=factored_random parses and trains end-to-end in train_game."""
+    from photon_tpu.drivers import train_game
+
+    summary = train_game.run(train_game.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", "synthetic-game:32:4:8:4:1:7",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
+        "--coordinate",
+        "per_user:type=factored_random,shard=re0,entity=re0,"
+        "latent_dim=2,latent_iterations=2,max_iters=8",
+        "--descent-iterations", "2",  # iteration 2 exercises the SVD warm start
+        "--validation-split", "0.25",
+        "--output-dir", str(tmp_path / "out"),
+    ]))
+    assert summary["best_metrics"]["AUC"] > 0.5
+    import os
+    assert os.path.isdir(
+        os.path.join(tmp_path, "out", "best_model", "random-effect", "per_user")
+    )
