@@ -48,9 +48,23 @@ class PointwiseLoss:
         return self.value(margin, label), self.d1(margin, label)
 
 
+@jax.custom_jvp
 def _logistic_value(z: Array, y: Array) -> Array:
     # log(1 + e^z) - y*z, computed stably as max(z,0) + log1p(e^-|z|) - y*z.
     return jnp.maximum(z, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(z))) - y * z
+
+
+@_logistic_value.defjvp
+def _logistic_value_jvp(primals, tangents):
+    # The stable formulation is made of max/abs kinks that all sit at
+    # EXACTLY z=0 — the value every margin takes on the first evaluation
+    # from w0=0.  Autodiff's subgradient choice there yields d/dz = -y
+    # instead of sigmoid(0)-y, which can stall L-BFGS at the start point
+    # (wrong first direction -> every Armijo trial rejected -> ftol fires
+    # while still at w0).  Pin the exact derivative.
+    z, y = primals
+    tz, ty = tangents
+    return _logistic_value(z, y), (jax.nn.sigmoid(z) - y) * tz + (-z) * ty
 
 
 def _logistic_d1(z: Array, y: Array) -> Array:

@@ -156,16 +156,26 @@ class GlmObjective:
         return v
 
     # -- static-sparsity fast path --------------------------------------------
-    def _fm_ready(self, batch: Batch) -> bool:
+    def _fm_ready(self, batch: Batch, dim: Optional[int] = None) -> bool:
         """The pre-sorted segment-sum path applies: a 2-D sparse batch with
-        the feature-major aux attached and no in-objective normalization
-        (normalized batches fall back to the autodiff path)."""
-        return (
+        the feature-major aux attached, no in-objective normalization
+        (normalized batches fall back to the autodiff path), and — when the
+        coefficient dim is known — the measured-on-this-backend kernel
+        selection picks it (the unsorted scatter the autodiff transpose
+        lowers to is faster on some platforms; ops/sparse_grad_select.py)."""
+        if not (
             isinstance(batch, SparseBatch)
             and batch.fm is not None
             and batch.ids.ndim == 2
             and self.normalization is None
-        )
+        ):
+            return False
+        if dim is None:
+            return True
+        from photon_tpu.ops.sparse_grad_select import fm_path_wins
+
+        n, k = batch.ids.shape
+        return fm_path_wins(n * k, dim, n)
 
     def _fast_data_value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
         """Data term (no regularization) of value+gradient via the
@@ -185,7 +195,7 @@ class GlmObjective:
         return _fm_segment_grad(d2w * xv, batch.fm, w.shape[0])
 
     def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
-        if self._fm_ready(batch):
+        if self._fm_ready(batch, int(w.shape[0])):
             val, g = self._fast_data_value_and_grad(w, batch)
             if self.l2_weight:
                 val = val + 0.5 * self.l2_weight * jnp.dot(w, w)
@@ -223,7 +233,7 @@ class GlmObjective:
         return jax.value_and_grad(self.value)(w, batch)
 
     def grad(self, w: Array, batch: Batch) -> Array:
-        if self._fm_ready(batch):
+        if self._fm_ready(batch, int(w.shape[0])):
             return self.value_and_grad(w, batch)[1]
         return jax.grad(self.value)(w, batch)
 
@@ -232,7 +242,7 @@ class GlmObjective:
         """Exact Hessian-vector product via jvp of the gradient — the TPU
         equivalent of the reference's HessianVectorAggregator treeAggregate
         (SURVEY.md §3.4, 'TRON's Hv = jax.jvp')."""
-        if self._fm_ready(batch):
+        if self._fm_ready(batch, int(w.shape[0])):
             hv = self._fast_data_hessian_vector(w, v, batch)
             if self.l2_weight:
                 hv = hv + self.l2_weight * v

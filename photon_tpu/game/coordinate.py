@@ -145,15 +145,21 @@ class FactoredRandomEffectCoordinateConfig:
                 "variance computation is not supported for factored random "
                 "effects (z-space variances do not transport to w = L z)"
             )
+        if self.problem.regularization.l1_weight > 0 or (
+            self.problem.optimizer.lower() not in ("lbfgs", "l-bfgs")
+        ):
+            raise ValueError(
+                "factored random effects support lbfgs with none/l2 "
+                "regularization only (the pooled projection solve is a "
+                "smooth L-BFGS problem)"
+            )
 
     @property
     def data_key(self):
-        # Same device data as an unprojected random coordinate: the latent
-        # projection is learned, so buckets hold raw features.
-        return (
-            "random", self.shard_name, self.entity_column,
-            self.active_row_cap, "none", None, self.seed,
-        )
+        # Same device data as an unprojected random coordinate (the latent
+        # projection is learned, so buckets hold raw features) — delegate so
+        # the estimator's device-data cache shares entries by construction.
+        return self.as_random_config().data_key
 
     def as_random_config(self) -> "RandomEffectCoordinateConfig":
         return RandomEffectCoordinateConfig(
@@ -566,15 +572,37 @@ class FactoredRandomEffectCoordinate:
         # Device-resident pooled-solve arrays + ONE jitted objective, built
         # once: _solve_latent is called per latent iteration per sweep point,
         # and rebuilding arrays/closures there would re-upload the dataset
-        # and recompile every call.
+        # and recompile every call.  Under a mesh the per-row arrays are
+        # padded (weight-0 rows) and sharded over the data axis; the jitted
+        # objective then partitions via GSPMD (XLA inserts the all-reduce
+        # for the scalar value and the replicated gradient automatically).
+        if mesh is not None:
+            n_shards = int(np.prod(list(mesh.shape.values())))
+            n = self.data.num_examples
+            self._pool_pad = (-n) % n_shards
+        else:
+            self._pool_pad = 0
+
+        def place_rows(a):
+            a = jnp.asarray(a)
+            if self._pool_pad:
+                a = jnp.pad(a, [(0, self._pool_pad)] + [(0, 0)] * (a.ndim - 1))
+            if mesh is None:
+                return a
+            ax = next(iter(mesh.shape))
+            return jax.device_put(
+                a, NamedSharding(mesh, P(ax, *([None] * (a.ndim - 1))))
+            )
+
+        self._place_rows = place_rows
         shard = self.data.shard(config.shard_name)
-        label = jnp.asarray(self.data.label, jnp.float32)
-        weight = jnp.asarray(self.data.weight, jnp.float32)
+        label = place_rows(jnp.asarray(self.data.label, jnp.float32))
+        weight = place_rows(jnp.asarray(self.data.weight, jnp.float32))
         loss = obj.loss
         l2 = obj.l2_weight
         d, r = self.dim, self.r
         if isinstance(shard, DenseShard):
-            x = jnp.asarray(shard.x)
+            x = place_rows(jnp.asarray(shard.x))
 
             def _latent_value(flat, z_rows, offsets):
                 latent = flat.reshape(d, r)
@@ -584,8 +612,8 @@ class FactoredRandomEffectCoordinate:
                     + 0.5 * l2 * jnp.dot(flat, flat)
                 )
         else:
-            ids = jnp.asarray(shard.ids)
-            vals = jnp.asarray(shard.vals)
+            ids = place_rows(jnp.asarray(shard.ids))
+            vals = place_rows(jnp.asarray(shard.vals))
 
             def _latent_value(flat, z_rows, offsets):
                 latent = flat.reshape(d, r)
@@ -614,6 +642,8 @@ class FactoredRandomEffectCoordinate:
         ``vec(L)`` whose margins are ``(x_i @ L) . z_i``."""
         from photon_tpu.core.optimizers import lbfgs
 
+        z_rows = self._place_rows(z_rows)
+        offsets = self._place_rows(offsets)
         result = lbfgs(
             lambda w: self._latent_value_and_grad(w, z_rows, offsets),
             latent0.reshape(-1),

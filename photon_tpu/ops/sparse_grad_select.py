@@ -1,0 +1,108 @@
+"""Runtime selection of the sparse-gradient reduction kernel.
+
+The production gradient has two available lowerings (see
+ops/KERNEL_NOTES.md):
+
+- **fm** — the pre-sorted segment-sum over the static FeatureMajorAux
+  layout (no per-evaluation device sort, but pays an extra
+  ``dz[rows]`` gather);
+- **autodiff** — differentiate through the row-major margins, whose
+  transpose is an unsorted scatter-add (XLA lowers it as sort +
+  segmented reduce on TPU, but as a fast native scatter on CPU).
+
+Which wins is a hardware property (measured: fm ~wins on TPU where the
+scatter's device sort dominates; autodiff wins ~2x on CPU where scatter
+is native) — so, like the reference's BLAS dispatch, the choice is made
+by a one-time EAGER measurement on the live backend, cached per
+(platform, size bucket).  The probe runs at trace time with concrete
+inputs (the same eager-probe pattern as ops/pallas_sparse.kernel_supported)
+and costs a few hundred ms once per process per shape regime.
+
+Override with ``PHOTON_SPARSE_GRAD=fm|autodiff|auto`` (default auto).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+_CACHE: dict = {}
+
+# Probe arrays are capped so the one-time measurement stays cheap even for
+# billion-entry datasets; relative kernel cost is stable above this size.
+_PROBE_MAX_ENTRIES = 1 << 21
+
+
+def _bucket(n: int) -> int:
+    return max(int(n).bit_length(), 1)
+
+
+def _measure(e: int, d: int, n: int) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    flat_ids = rng.integers(0, d, size=e, dtype=np.int32)
+    order = np.argsort(flat_ids, kind="stable")
+    sorted_ids = jnp.asarray(flat_ids[order])
+    rows = jnp.asarray((order % max(n, 1)).astype(np.int32))
+    vals = jnp.asarray(rng.standard_normal(e).astype(np.float32))
+    dz = jnp.asarray(rng.standard_normal(max(n, 1)).astype(np.float32))
+    ids_j = jnp.asarray(flat_ids)
+
+    def t(fn, *args, reps=3):
+        fj = jax.jit(fn)
+        np.asarray(fj(*args))  # compile + sync through a host copy
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fj(*args)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / reps
+
+    t_fm = t(
+        lambda dz, r, v, i: jnp.sum(jax.ops.segment_sum(
+            jnp.take(dz, r, axis=0) * v, i,
+            num_segments=d, indices_are_sorted=True,
+        )),
+        dz, rows, vals, sorted_ids,
+    )
+    t_scatter = t(
+        lambda v, i: jnp.sum(jnp.zeros(d, jnp.float32).at[i].add(v)),
+        vals, ids_j,
+    )
+    return t_fm < t_scatter
+
+
+def fm_path_wins(e_total: int, dim: int, n_rows: int) -> bool:
+    """True when the pre-sorted segment-sum path should carry the gradient
+    for this problem size on the current backend."""
+    mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
+    if mode == "fm":
+        return True
+    if mode == "autodiff":
+        return False
+    import jax
+
+    key = (jax.default_backend(), _bucket(e_total), _bucket(dim))
+    if key not in _CACHE:
+        try:
+            scale = max(1, -(-e_total // _PROBE_MAX_ENTRIES))  # ceil: cap probe size
+            e = max(e_total // scale, 1 << 10)
+            n = max(n_rows // scale, 64)
+            _CACHE[key] = _measure(e, dim, n)
+        except Exception:  # noqa: BLE001 — a failed probe must not kill training
+            _CACHE[key] = True  # fm is the TPU-safe default
+        import logging
+
+        # Logged because auto-selection is a wall-clock measurement: on a
+        # machine near the kernel crossover two runs can pick different
+        # kernels, whose different reduction orders give slightly different
+        # float results.  Pin PHOTON_SPARSE_GRAD=fm|autodiff for bitwise
+        # same-seed reproducibility (SURVEY.md §5 determinism note).
+        logging.getLogger("photon_tpu.sparse_grad").info(
+            "sparse-grad kernel for backend=%s e~2^%d d~2^%d: %s",
+            key[0], key[1], key[2], "fm" if _CACHE[key] else "autodiff",
+        )
+    return _CACHE[key]

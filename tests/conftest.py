@@ -26,10 +26,21 @@ jax.config.update("jax_enable_x64", False)
 # optimizer while_loops and GAME programs that are identical run-to-run.
 # The cache dir is repo-local (gitignored) so repeated suite runs in one
 # workspace — including the driver's — hit warm.
-_cache_dir = os.environ.get(
+_cache_dir = os.path.abspath(os.environ.get(
     "JAX_TEST_COMPILATION_CACHE",
     os.path.join(os.path.dirname(__file__), os.pardir, ".jax_test_cache"),
-)
-jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+))
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# Also export as env vars so worker SUBPROCESSES spawned by tests (the
+# multi-process suite) share the cache.
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.2"
+os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+
+# Pin the feature-major gradient kernel: correctness tests must exercise the
+# production fm path even on platforms where the runtime autotuner
+# (ops/sparse_grad_select) would prefer the autodiff scatter; the selection
+# logic itself is tested explicitly with env overrides.
+os.environ.setdefault("PHOTON_SPARSE_GRAD", "fm")

@@ -128,3 +128,40 @@ def test_distributed_fast_path_matches_single_device():
     hv_ref = jax.jvp(lambda u: jax.grad(obj.value)(u, batch), (w,), (vec,))[1]
     hv = dist.hessian_vector(w, vec, sharded)
     np.testing.assert_allclose(hv, hv_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_sparse_grad_kernel_selection(monkeypatch):
+    """ops/sparse_grad_select: env overrides force the path; auto measures
+    once per (backend, size bucket) and caches."""
+    import photon_tpu.core.objective as obj_mod
+    import photon_tpu.ops.sparse_grad_select as sel
+
+    n, k, d = 256, 4, 64
+    batch = attach_feature_major(_random_batch(n, k, d, seed=20))
+    obj = GlmObjective.create("logistic")
+    w = jnp.zeros(d, jnp.float32)
+
+    calls = []
+    real = obj_mod._fm_segment_grad
+
+    def spy(per_row, fm, dim):
+        calls.append(dim)
+        return real(per_row, fm, dim)
+
+    monkeypatch.setattr(obj_mod, "_fm_segment_grad", spy)
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "autodiff")
+    obj.value_and_grad(w, batch)
+    assert not calls, "autodiff override must bypass the fm kernel"
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "fm")
+    obj.value_and_grad(w, batch)
+    assert calls, "fm override must route through the fm kernel"
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "auto")
+    sel._CACHE.clear()
+    decision = sel.fm_path_wins(n * k, d, n)
+    assert isinstance(decision, bool)
+    assert sel._CACHE, "auto mode must cache the measurement"
+    # Same bucket -> no re-measure (cache key count stable).
+    before = dict(sel._CACHE)
+    sel.fm_path_wins(n * k, d, n)
+    assert sel._CACHE == before

@@ -420,11 +420,11 @@ def test_factored_random_effect_driver_spec(tmp_path):
 
     summary = train_game.run(train_game.build_parser().parse_args([
         "--backend", "cpu",
-        "--input", "synthetic-game:32:4:8:4:1:7",
-        "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
+        "--input", "synthetic-game:24:4:8:4:1:7",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
         "--coordinate",
         "per_user:type=factored_random,shard=re0,entity=re0,"
-        "latent_dim=2,latent_iterations=2,max_iters=8",
+        "latent_dim=2,latent_iterations=2,max_iters=6",
         "--descent-iterations", "2",  # iteration 2 exercises the SVD warm start
         "--validation-split", "0.25",
         "--output-dir", str(tmp_path / "out"),
@@ -433,4 +433,50 @@ def test_factored_random_effect_driver_spec(tmp_path):
     import os
     assert os.path.isdir(
         os.path.join(tmp_path, "out", "best_model", "random-effect", "per_user")
+    )
+
+
+def test_factored_random_effect_on_mesh_matches_single():
+    """The pooled projection solve partitions over the mesh via GSPMD; an
+    8-virtual-device run must match single-device results."""
+    import numpy as np
+
+    from photon_tpu.core.objective import RegularizationContext
+    from photon_tpu.core.optimizers import OptimizerConfig
+    from photon_tpu.core.problem import ProblemConfig
+    from photon_tpu.game.coordinate import (
+        FactoredRandomEffectCoordinate,
+        FactoredRandomEffectCoordinateConfig,
+    )
+    from photon_tpu.game.data import DenseShard, GameDataset
+    from photon_tpu.parallel.mesh import create_mesh
+
+    rng = np.random.default_rng(23)
+    n_entities, rows, d = 24, 5, 8
+    n = n_entities * rows
+    ent = np.repeat(np.arange(n_entities), rows)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    label = (rng.random(n) < 0.5).astype(np.float32)
+    data = GameDataset(
+        shards={"re0": DenseShard(x)}, label=label,
+        offset=np.zeros(n, np.float32), weight=np.ones(n, np.float32),
+        id_columns={"re0": ent},
+    )
+    cfg = FactoredRandomEffectCoordinateConfig(
+        "re0", "re0", latent_dim=2, latent_iterations=2,
+        problem=ProblemConfig(
+            regularization=RegularizationContext("l2", 1.0),
+            optimizer_config=OptimizerConfig(max_iterations=6),
+        ),
+    )
+    offsets = np.zeros(n, np.float32)
+    m_single, _ = FactoredRandomEffectCoordinate(
+        data, cfg, "logistic_regression"
+    ).train(offsets)
+    m_mesh, _ = FactoredRandomEffectCoordinate(
+        data, cfg, "logistic_regression", mesh=create_mesh(8)
+    ).train(offsets)
+    np.testing.assert_allclose(
+        np.asarray(m_mesh.table), np.asarray(m_single.table),
+        rtol=5e-3, atol=5e-4,
     )

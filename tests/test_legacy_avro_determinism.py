@@ -80,10 +80,10 @@ def test_same_seed_same_result_full_driver_run(tmp_path):
     identical summaries (modulo wall-clock fields)."""
     argvs = [
         "--backend", "cpu",
-        "--input", "synthetic:logistic_regression:400:24:3",
-        "--validation-input", "synthetic:logistic_regression:200:24:4:3",
+        "--input", "synthetic:logistic_regression:256:16:3",
+        "--validation-input", "synthetic:logistic_regression:128:16:4:3",
         "--task", "logistic_regression",
-        "--reg-weights", "0.5,2.0", "--max-iterations", "40",
+        "--reg-weights", "0.5,2.0", "--max-iterations", "15",
         "--variance-computation", "simple",
     ]
     outs = []
@@ -106,9 +106,9 @@ def test_same_seed_same_result_game(tmp_path):
 
     argv = [
         "--backend", "cpu",
-        "--input", "synthetic-game:24:4:8:4:1:5",
-        "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
-        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=6",
+        "--input", "synthetic-game:20:4:8:4:1:5",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=6",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=4",
         "--descent-iterations", "1",
         "--validation-split", "0.25",
     ]

@@ -70,3 +70,25 @@ def test_task_type_aliases():
     assert get_loss("logistic_regression").name == "logistic"
     assert get_loss("linear_regression").name == "squared"
     assert get_loss("poisson_regression").name == "poisson"
+
+
+def test_autodiff_matches_d1_at_exact_zero_margin():
+    """Regression: the stable logistic value's kinks all sit at EXACTLY z=0
+    (the first evaluation from w0=0 with zero offsets); autodiff's
+    subgradient choice there used to yield -y instead of sigmoid(0)-y,
+    which could stall L-BFGS at the start point.  Every loss's autodiff
+    derivative must equal its analytic d1 at z=0."""
+    import jax
+
+    from photon_tpu.core.losses import LOSSES
+
+    for name, loss in LOSSES.items():
+        for y in (0.0, 1.0):
+            g_auto = jax.grad(lambda z: loss.value(z, jnp.asarray(y)))(
+                jnp.asarray(0.0)
+            )
+            g_true = loss.d1(jnp.asarray(0.0), jnp.asarray(y))
+            np.testing.assert_allclose(
+                g_auto, g_true, rtol=1e-6,
+                err_msg=f"{name} autodiff != d1 at z=0, y={y}",
+            )

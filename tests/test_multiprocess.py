@@ -91,6 +91,8 @@ def test_two_process_objective_matches_single(tmp_path):
     env = {
         k: v for k, v in os.environ.items()
         if not k.startswith(("XLA_", "JAX_"))
+        or k.startswith("JAX_PERSISTENT_CACHE")
+        or k == "JAX_COMPILATION_CACHE_DIR"
     }
     procs = [
         subprocess.Popen(
@@ -203,6 +205,8 @@ def test_two_process_streaming_driver_matches_single(tmp_path):
     env = {
         k: v for k, v in os.environ.items()
         if not k.startswith(("XLA_", "JAX_"))
+        or k.startswith("JAX_PERSISTENT_CACHE")
+        or k == "JAX_COMPILATION_CACHE_DIR"
     }
     outs = [str(tmp_path / f"mp{i}") for i in range(2)]
     procs = [
@@ -282,6 +286,8 @@ def test_two_process_game_driver_matches_single(tmp_path):
     env = {
         k: v for k, v in os.environ.items()
         if not k.startswith(("XLA_", "JAX_"))
+        or k.startswith("JAX_PERSISTENT_CACHE")
+        or k == "JAX_COMPILATION_CACHE_DIR"
     }
     outs = [str(tmp_path / f"mp{i}") for i in range(2)]
     procs = [

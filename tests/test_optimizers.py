@@ -208,7 +208,7 @@ def test_vmapped_lbfgs_matches_sequential():
                      OptimizerConfig(max_iterations=100)).w
 
     batched = jax.jit(jax.vmap(solve_traced))(jnp.asarray(xs), jnp.asarray(ys))
-    np.testing.assert_allclose(batched, seq, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(batched, seq, rtol=1e-3, atol=5e-4)
 
 
 @pytest.mark.parametrize("opt_name", ["tron"])
@@ -231,4 +231,4 @@ def test_vmapped_tron_matches_sequential(opt_name):
     seq = np.stack([np.asarray(jax.jit(solve)(jnp.asarray(xs[i]), jnp.asarray(ys[i])))
                     for i in range(B)])
     batched = jax.jit(jax.vmap(solve))(jnp.asarray(xs), jnp.asarray(ys))
-    np.testing.assert_allclose(batched, seq, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(batched, seq, rtol=1e-3, atol=5e-4)
