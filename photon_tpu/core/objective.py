@@ -158,16 +158,14 @@ class GlmObjective:
     # -- static-sparsity fast path --------------------------------------------
     def _fm_ready(self, batch: Batch, dim: Optional[int] = None) -> bool:
         """The pre-sorted segment-sum path applies: a 2-D sparse batch with
-        the feature-major aux attached, no in-objective normalization
-        (normalized batches fall back to the autodiff path), and — when the
-        coefficient dim is known — the measured-on-this-backend kernel
-        selection picks it (the unsorted scatter the autodiff transpose
-        lowers to is faster on some platforms; ops/sparse_grad_select.py)."""
+        the feature-major aux attached, and — when the coefficient dim is
+        known — the measured-on-this-backend kernel selection picks it (the
+        unsorted scatter the autodiff transpose lowers to is faster on some
+        platforms; ops/sparse_grad_select.py)."""
         if not (
             isinstance(batch, SparseBatch)
             and batch.fm is not None
             and batch.ids.ndim == 2
-            and self.normalization is None
         ):
             return False
         if dim is None:
@@ -180,11 +178,22 @@ class GlmObjective:
     def _fast_data_value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
         """Data term (no regularization) of value+gradient via the
         feature-major layout; the TPU replacement for the reference's
-        ValueAndGradientAggregator fold (SURVEY.md §3.4)."""
-        z = margins(w, batch)
+        ValueAndGradientAggregator fold (SURVEY.md §3.4).
+
+        Under normalization the margin is ``F(x - s) · w`` per example, so
+        ``g = F (Xᵀ dz - s Σ dz)`` — one extra scalar sum and two
+        elementwise ops over the same sorted segment sum (the sparse batch
+        never densifies, mirroring hessian_diagonal's algebra)."""
+        z = self._margins(w, batch)
         v = jnp.sum(batch.weight * self.loss.value(z, batch.label))
         dz = batch.weight * self.loss.d1(z, batch.label)
-        return v, _fm_segment_grad(dz, batch.fm, w.shape[0])
+        g = _fm_segment_grad(dz, batch.fm, w.shape[0])
+        norm = self.normalization
+        if norm is not None:
+            if norm.shifts is not None:
+                g = g - norm.shifts * jnp.sum(dz)
+            g = g * norm.factors_or_ones(w.shape[0])
+        return v, g
 
     def _fast_data_hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
         """Data term of ``H v = Xᵀ diag(weight·d2) X v`` — exact for GLMs
@@ -242,7 +251,9 @@ class GlmObjective:
         """Exact Hessian-vector product via jvp of the gradient — the TPU
         equivalent of the reference's HessianVectorAggregator treeAggregate
         (SURVEY.md §3.4, 'TRON's Hv = jax.jvp')."""
-        if self._fm_ready(batch, int(w.shape[0])):
+        if self.normalization is None and self._fm_ready(batch, int(w.shape[0])):
+            # (normalized Hv falls back to jvp-of-grad, which differentiates
+            # through the normalized fast gradient and stays exact)
             hv = self._fast_data_hessian_vector(w, v, batch)
             if self.l2_weight:
                 hv = hv + self.l2_weight * v

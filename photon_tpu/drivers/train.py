@@ -293,15 +293,13 @@ def run(args: argparse.Namespace) -> dict:
     mesh = common.maybe_mesh()
     if mesh is not None:
         logger.info("mesh: %d devices on axis 'data'", mesh.devices.size)
-        # build_fm only when the objective can use it: normalized objectives
-        # fall back to autodiff, so the aux would be dead device memory.
-        batch = shard_batch(batch, mesh, build_fm=norm is None)
-    elif norm is None:
+        batch = shard_batch(batch, mesh)  # attaches the feature-major layout
+    else:
         from photon_tpu.data.batch import SparseBatch, attach_feature_major
 
         if isinstance(batch, SparseBatch) and batch.ids.ndim == 2:
             # Single-device: attach the pre-sorted layout so objectives take
-            # the segment-sum gradient path.
+            # the segment-sum gradient path (exact under normalization too).
             batch = attach_feature_major(batch)
 
     if args.evaluators:

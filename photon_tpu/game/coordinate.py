@@ -348,9 +348,7 @@ class FixedEffectCoordinate:
         self.config = config
         self.task_type = task_type
         self.mesh = mesh
-        self.device_data = device_data or FixedEffectDeviceData(
-            data, config, mesh, build_fm=normalization is None
-        )
+        self.device_data = device_data or FixedEffectDeviceData(data, config, mesh)
         self.dim = self.device_data.dim
         if normalization is not None and len(
             np.asarray(normalization.factors_or_ones(self.dim))

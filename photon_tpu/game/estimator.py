@@ -99,11 +99,8 @@ class GameEstimator:
         key = coord_config.data_key
         if key not in self._device_data_cache:
             if isinstance(coord_config, FixedEffectCoordinateConfig):
-                # The feature-major aux only pays off when the objective can
-                # use it — normalized objectives fall back to autodiff.
                 self._device_data_cache[key] = FixedEffectDeviceData(
                     self.training_data, coord_config, self.mesh,
-                    build_fm=self.normalization.get(coord_config.shard_name) is None,
                 )
             else:
                 from photon_tpu.game.coordinate import (

@@ -104,7 +104,7 @@ class DistributedGlmObjective:
         return jax.grad(self.value)(w, batch)
 
     def hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
-        if self.obj._fm_ready(batch, int(w.shape[0])):
+        if self.obj.normalization is None and self.obj._fm_ready(batch, int(w.shape[0])):
             ax = self.axis_name
 
             @partial(

@@ -165,3 +165,60 @@ def test_sparse_grad_kernel_selection(monkeypatch):
     before = dict(sel._CACHE)
     sel.fm_path_wins(n * k, d, n)
     assert sel._CACHE == before
+
+
+def test_fast_path_under_normalization_matches_autodiff():
+    """g = F (X^T dz - s * sum(dz)): the fm path must stay exact under
+    in-objective normalization (it used to fall back to autodiff)."""
+    from photon_tpu.core.normalization import NormalizationContext
+    from photon_tpu.core.stats import BasicStatisticalSummary
+
+    n, k, d = 384, 6, 40
+    batch = _random_batch(n, k, d, seed=31)
+    fast = attach_feature_major(batch)
+    summary = BasicStatisticalSummary.from_batch(batch, d)
+    for kind in ("scale_with_standard_deviation", "standardization"):
+        norm = NormalizationContext.build(kind, summary, intercept_id=0)
+        obj = GlmObjective.create(
+            "logistic", RegularizationContext("l2", 0.4), normalization=norm
+        )
+        w = jnp.asarray(
+            np.random.default_rng(32).standard_normal(d), jnp.float32) * 0.1
+        v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+        v_fast, g_fast = obj.value_and_grad(w, fast)
+        np.testing.assert_allclose(v_fast, v_ref, rtol=1e-5)
+        np.testing.assert_allclose(g_fast, g_ref, rtol=2e-4, atol=1e-5)
+        hv_ref = jax.jvp(
+            lambda u: jax.grad(obj.value)(u, batch), (w,),
+            (jnp.asarray(np.random.default_rng(33).standard_normal(d),
+                         jnp.float32),),
+        )[1]
+        hv = obj.hessian_vector(
+            w, jnp.asarray(np.random.default_rng(33).standard_normal(d),
+                           jnp.float32), fast)
+        np.testing.assert_allclose(hv, hv_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_distributed_fast_path_under_normalization():
+    """Per-shard normalization correction (shifts * local sum(dz)) must psum
+    to the global correction — 8-device mesh vs single-device, normalized."""
+    from photon_tpu.core.normalization import NormalizationContext
+    from photon_tpu.core.stats import BasicStatisticalSummary
+    from photon_tpu.parallel.distributed import DistributedGlmObjective
+    from photon_tpu.parallel.mesh import create_mesh, shard_batch
+
+    n, k, d = 512, 8, 64
+    batch = _random_batch(n, k, d, seed=41)
+    summary = BasicStatisticalSummary.from_batch(batch, d)
+    norm = NormalizationContext.build("standardization", summary, intercept_id=0)
+    obj = GlmObjective.create(
+        "logistic", RegularizationContext("l2", 0.5), normalization=norm
+    )
+    w = jnp.asarray(np.random.default_rng(42).standard_normal(d), jnp.float32) * 0.1
+    v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+
+    mesh = create_mesh(8)
+    dist = DistributedGlmObjective(obj, mesh)
+    v, g = dist.value_and_grad(w, shard_batch(batch, mesh))
+    np.testing.assert_allclose(v, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=1e-5)
