@@ -138,5 +138,15 @@ def _safe_inverse(x: Array) -> Array:
     return jnp.where(x > 0.0, 1.0 / jnp.where(x > 0.0, x, 1.0), 1.0)
 
 
+# A pytree so objectives carrying a normalization context can be passed as
+# jit arguments (core/problem.py cached solvers): the factor/shift arrays are
+# dynamic leaves, the intercept position is static structure.
+jax.tree_util.register_dataclass(
+    NormalizationContext,
+    data_fields=("factors", "shifts"),
+    meta_fields=("intercept_id",),
+)
+
+
 # Imported late to avoid a cycle; stats only needs jnp.
 from photon_tpu.core.stats import BasicStatisticalSummary  # noqa: E402

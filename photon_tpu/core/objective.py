@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import tree_util
 
 from photon_tpu.core.losses import PointwiseLoss, get_loss
 from photon_tpu.core.normalization import NormalizationContext
@@ -102,6 +103,15 @@ class RegularizationContext:
 NO_REG = RegularizationContext()
 
 
+def _static_zero(x) -> bool:
+    """True only for a concrete (Python-scalar) zero weight.
+
+    Objectives are jit pytrees whose reg weights may be tracers (so one
+    compiled sweep program serves every lambda); a tracer is never
+    "statically zero" and takes the unconditional-arithmetic path."""
+    return isinstance(x, (int, float)) and x == 0.0
+
+
 @dataclasses.dataclass(frozen=True)
 class GlmObjective:
     """Smooth part of a GLM objective: sum_i weight_i * loss(margin_i, y_i)
@@ -151,7 +161,7 @@ class GlmObjective:
 
     def value(self, w: Array, batch: Batch) -> Array:
         v = self.data_value(w, batch)
-        if self.l2_weight:
+        if not _static_zero(self.l2_weight):
             v = v + 0.5 * self.l2_weight * jnp.dot(w, w)
         return v
 
@@ -206,7 +216,7 @@ class GlmObjective:
     def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
         if self._fm_ready(batch, int(w.shape[0])):
             val, g = self._fast_data_value_and_grad(w, batch)
-            if self.l2_weight:
+            if not _static_zero(self.l2_weight):
                 val = val + 0.5 * self.l2_weight * jnp.dot(w, w)
                 g = g + self.l2_weight * w
             return val, g
@@ -235,7 +245,7 @@ class GlmObjective:
                     self.loss, w, batch.ids, batch.vals,
                     batch.label, batch.offset, batch.weight,
                 )
-                if self.l2_weight:
+                if not _static_zero(self.l2_weight):
                     v = v + 0.5 * self.l2_weight * jnp.dot(w, w)
                     g = g + self.l2_weight * w
                 return v, g
@@ -255,7 +265,7 @@ class GlmObjective:
             # (normalized Hv falls back to jvp-of-grad, which differentiates
             # through the normalized fast gradient and stays exact)
             hv = self._fast_data_hessian_vector(w, v, batch)
-            if self.l2_weight:
+            if not _static_zero(self.l2_weight):
                 hv = hv + self.l2_weight * v
             return hv
         return jax.jvp(lambda u: self.grad(u, batch), (w,), (v,))[1]
@@ -329,3 +339,14 @@ class GlmObjective:
     # -- prediction ------------------------------------------------------------
     def predict_mean(self, w: Array, batch: Batch) -> Array:
         return self.loss.mean(self._margins(w, batch))
+
+
+# Objectives are jit/vmap pytrees: reg weights (and normalization arrays) are
+# DYNAMIC leaves, so one compiled solver program serves a whole lambda sweep /
+# hyperparameter search — only shapes and the loss retrace (see
+# core/problem.py's cached solvers).
+tree_util.register_dataclass(
+    GlmObjective,
+    data_fields=("l2_weight", "l1_weight", "normalization"),
+    meta_fields=("loss",),
+)

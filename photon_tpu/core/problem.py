@@ -15,6 +15,7 @@ gradients psum over a mesh (DistributedGlmObjective) or stay local
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Union
 
 import jax
@@ -59,6 +60,51 @@ class ProblemConfig:
         return dataclasses.replace(self, **kw)
 
 
+def _run_fit(objective, batch: Batch, w0: Array, *, optimizer: str,
+             cfg: OptimizerConfig, variance: str):
+    """One GLM fit, pure in (objective, batch, w0) — the body every cached
+    solver compiles.  The objective is a PYTREE ARGUMENT (reg weights and
+    normalization arrays are dynamic leaves), so one compiled program serves
+    an entire lambda sweep / hyperparameter search; only shapes, the loss,
+    the optimizer, and its static config retrace."""
+    fun = lambda w: objective.value_and_grad(w, batch)  # noqa: E731
+    if optimizer in ("owlqn", "owl-qn"):
+        result = owlqn(fun, w0, cfg, l1_weight=objective.l1_weight)
+    elif optimizer == "tron":
+        result = tron(
+            fun, w0, cfg, hvp=lambda w, v: objective.hessian_vector(w, v, batch)
+        )
+    else:
+        result = lbfgs(fun, w0, cfg)
+    coefficients = Coefficients(
+        means=result.w,
+        variances=_compute_variances(objective, variance, result.w, batch),
+    )
+    return coefficients, result
+
+
+@functools.lru_cache(maxsize=32)
+def cached_solver(optimizer: str, cfg: OptimizerConfig, variance: str,
+                  vmapped: bool = False):
+    """The jit-compiled solver for one static problem configuration.
+
+    Signature of the returned callable: ``(objective, batch, w0)`` —
+    ``vmapped=True`` maps (batch, w0) over a leading entity axis with the
+    objective held constant (the GAME random-effect bucket solve).  Cached at
+    module level so every coordinate, sweep config, and tuning trial with the
+    same static configuration shares one traced program (jit's own cache then
+    keys on shapes + objective pytree structure).  The cache is BOUNDED: each
+    entry pins its compiled executables for the process lifetime (the hazard
+    core/variance.py documents), so a search varying static keys (tolerances,
+    max_iterations) evicts old solvers instead of growing without limit —
+    eviction only costs a retrace on reuse."""
+    run = functools.partial(_run_fit, optimizer=optimizer, cfg=cfg,
+                            variance=variance)
+    if vmapped:
+        run = jax.vmap(run, in_axes=(None, 0, 0))
+    return jax.jit(run)
+
+
 class GlmOptimizationProblem:
     """Runs one GLM fit: ``run(batch, w0) -> (Coefficients, OptimizerResult)``.
 
@@ -71,8 +117,14 @@ class GlmOptimizationProblem:
         self.objective = objective
         self.config = config
 
-    def _l1_weight(self) -> float:
-        return self.config.regularization.l1_weight
+    def solver(self, vmapped: bool = False):
+        """This problem's shared jitted solver (see :func:`cached_solver`)."""
+        return cached_solver(
+            self.config.optimizer.lower(),
+            self.config.optimizer_config,
+            self.config.variance_computation,
+            vmapped,
+        )
 
     def run(
         self, batch: Batch, w0: Optional[Array] = None, dim: Optional[int] = None
@@ -81,49 +133,40 @@ class GlmOptimizationProblem:
             if dim is None:
                 raise ValueError("need w0 or dim")
             w0 = jnp.zeros(dim, jnp.float32)
-        fun = lambda w: self.objective.value_and_grad(w, batch)  # noqa: E731
-        name = self.config.optimizer.lower()
-        cfg = self.config.optimizer_config
-        if name in ("owlqn", "owl-qn"):
-            result = owlqn(fun, w0, cfg, l1_weight=self._l1_weight())
-        elif name == "tron":
-            result = tron(
-                fun, w0, cfg, hvp=lambda w, v: self.objective.hessian_vector(w, v, batch)
-            )
-        else:
-            result = lbfgs(fun, w0, cfg)
-        coefficients = Coefficients(
-            means=result.w, variances=self.compute_variances(result.w, batch)
-        )
-        return coefficients, result
+        return self.solver()(self.objective, batch, w0)
 
     def compute_variances(self, w: Array, batch: Batch) -> Optional[Array]:
-        """Per-coefficient posterior variances at the optimum (SURVEY.md
-        §2.2 'L2 + variance'): SIMPLE = 1/diag(H); FULL = diag(H⁻¹) — a
-        Cholesky solve of the dense Hessian up to FULL_DENSE_MAX_DIM, a
-        matrix-free CG/Hutchinson estimate above it (the dense ``[d, d]``
-        materialization is a 256 GB allocation at the bench dimension —
-        see core/variance.py)."""
-        kind = self.config.variance_computation
-        if kind == "none":
-            return None
-        if kind == "full":
-            from photon_tpu.core.variance import (
-                FULL_DENSE_MAX_DIM,
-                hutchinson_diag_inverse,
-            )
+        return _compute_variances(
+            self.objective, self.config.variance_computation, w, batch
+        )
 
-            d = int(w.shape[0])
-            if d > FULL_DENSE_MAX_DIM:
-                return hutchinson_diag_inverse(
-                    lambda v: self.objective.hessian_vector(w, v, batch),
-                    dim=d,
-                )
-            h = self.objective.hessian_matrix(w, batch)
-            # Tiny jitter keeps the factorization defined for flat
-            # directions (e.g. unreached features with zero curvature).
-            chol = jax.scipy.linalg.cho_factor(h + 1e-9 * jnp.eye(d, dtype=h.dtype))
-            inv = jax.scipy.linalg.cho_solve(chol, jnp.eye(d, dtype=h.dtype))
-            return jnp.maximum(jnp.diagonal(inv), 0.0)
-        diag = self.objective.hessian_diagonal(w, batch)
-        return 1.0 / jnp.maximum(diag, 1e-12)
+
+def _compute_variances(objective, kind: str, w: Array, batch: Batch) -> Optional[Array]:
+    """Per-coefficient posterior variances at the optimum (SURVEY.md
+    §2.2 'L2 + variance'): SIMPLE = 1/diag(H); FULL = diag(H⁻¹) — a
+    Cholesky solve of the dense Hessian up to FULL_DENSE_MAX_DIM, a
+    matrix-free CG/Hutchinson estimate above it (the dense ``[d, d]``
+    materialization is a 256 GB allocation at the bench dimension —
+    see core/variance.py)."""
+    if kind == "none":
+        return None
+    if kind == "full":
+        from photon_tpu.core.variance import (
+            FULL_DENSE_MAX_DIM,
+            hutchinson_diag_inverse,
+        )
+
+        d = int(w.shape[0])
+        if d > FULL_DENSE_MAX_DIM:
+            return hutchinson_diag_inverse(
+                lambda v: objective.hessian_vector(w, v, batch),
+                dim=d,
+            )
+        h = objective.hessian_matrix(w, batch)
+        # Tiny jitter keeps the factorization defined for flat
+        # directions (e.g. unreached features with zero curvature).
+        chol = jax.scipy.linalg.cho_factor(h + 1e-9 * jnp.eye(d, dtype=h.dtype))
+        inv = jax.scipy.linalg.cho_solve(chol, jnp.eye(d, dtype=h.dtype))
+        return jnp.maximum(jnp.diagonal(inv), 0.0)
+    diag = objective.hessian_diagonal(w, batch)
+    return 1.0 / jnp.maximum(diag, 1e-12)

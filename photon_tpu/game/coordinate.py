@@ -29,6 +29,7 @@ iteration).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Protocol, Union
 
 import jax
@@ -430,9 +431,12 @@ class RandomEffectCoordinate:
         self.dim = self.dataset.dim
         obj = GlmObjective.create(task_type, config.problem.regularization)
         self.problem = GlmOptimizationProblem(obj, config.problem)
-        # One jitted vmapped solver per bucket shape (bounded by the number
-        # of power-of-two buckets).
-        self._solver = jax.jit(jax.vmap(lambda b, w0: self.problem.run(b, w0)))
+        # Shared vmapped solver (one traced program per static config +
+        # bucket shape, module-cached): the objective rides along as a pytree
+        # argument, so sweep configs differing only in reg weights reuse it.
+        self._solver = functools.partial(
+            self.problem.solver(vmapped=True), self.problem.objective
+        )
 
     def _initial_table(self, initial_model: RandomEffectModel) -> Array:
         """Align a warm-start model's per-entity rows onto THIS dataset's
@@ -565,7 +569,9 @@ class FactoredRandomEffectCoordinate:
         self.r = config.latent_dim
         obj = GlmObjective.create(task_type, config.problem.regularization)
         self.problem = GlmOptimizationProblem(obj, config.problem)
-        self._z_solver = jax.jit(jax.vmap(lambda b, w0: self.problem.run(b, w0)))
+        self._z_solver = functools.partial(
+            self.problem.solver(vmapped=True), self.problem.objective
+        )
         self._objective = obj
         # Device-resident pooled-solve arrays + ONE jitted objective, built
         # once: _solve_latent is called per latent iteration per sweep point,

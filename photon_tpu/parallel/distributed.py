@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from photon_tpu.core.objective import GlmObjective
+from photon_tpu.core.objective import GlmObjective, _static_zero
 from photon_tpu.data.batch import Batch
 from photon_tpu.parallel.mesh import DATA_AXIS
 
@@ -45,6 +45,11 @@ class DistributedGlmObjective:
         self.obj = obj
         self.mesh = mesh
         self.axis_name = axis_name
+
+    @property
+    def l1_weight(self):
+        """Mirrors GlmObjective so optimization problems treat both alike."""
+        return self.obj.l1_weight
 
     # -- spec helpers ---------------------------------------------------------
     def _batch_specs(self, batch: Batch):
@@ -67,7 +72,7 @@ class DistributedGlmObjective:
             return lax.psum(self.obj.data_value(w, local), ax)
 
         v = _v(w, batch)
-        if self.obj.l2_weight:
+        if not _static_zero(self.obj.l2_weight):
             v = v + 0.5 * self.obj.l2_weight * jnp.dot(w, w)
         return v
 
@@ -92,7 +97,7 @@ class DistributedGlmObjective:
 
             v, g = _vg(w, batch)
             l2 = self.obj.l2_weight
-            if l2:
+            if not _static_zero(l2):
                 v = v + 0.5 * l2 * jnp.dot(w, w)
                 g = g + l2 * w
             return v, g
@@ -118,7 +123,7 @@ class DistributedGlmObjective:
 
             hv = _hv(w, v, batch)
             l2 = self.obj.l2_weight
-            if l2:
+            if not _static_zero(l2):
                 hv = hv + l2 * v
             return hv
         return jax.jvp(lambda u: self.grad(u, batch), (w,), (v,))[1]
@@ -166,3 +171,13 @@ class DistributedGlmObjective:
 
     def bind_hvp(self, batch: Batch) -> Callable[[Array, Array], Array]:
         return lambda w, v: self.hessian_vector(w, v, batch)
+
+
+# A pytree like GlmObjective (the wrapped objective's reg weights stay
+# dynamic); the mesh and axis name are static structure, so solvers cached by
+# core/problem.py retrace only when the mesh itself changes.
+jax.tree_util.register_pytree_node(
+    DistributedGlmObjective,
+    lambda o: ((o.obj,), (o.mesh, o.axis_name)),
+    lambda aux, children: DistributedGlmObjective(children[0], aux[0], aux[1]),
+)
