@@ -45,7 +45,10 @@ def _acquire_backend(timeout_s: float | None = None) -> None:
     if _PLATFORM_INFO["platform"] is not None:
         return
     if timeout_s is None:
-        timeout_s = float(os.environ.get("PHOTON_BENCH_PROBE_TIMEOUT", "120"))
+        # The tunneled backend has been observed to take >120s to come up
+        # when healthy-but-slow; 240s balances that against the wait a
+        # genuinely-down tunnel costs (paid once per hour via the cache).
+        timeout_s = float(os.environ.get("PHOTON_BENCH_PROBE_TIMEOUT", "240"))
     # A round runs bench.py once plus five --config invocations; cache the
     # CPU-FALLBACK outcome (with a TTL) so they don't each wait out the
     # probe timeout.  A successful TPU probe is deliberately NOT cached:
@@ -134,6 +137,28 @@ def _emit(metric: str, value: float, unit: str, detail: dict) -> None:
                 prior = json.load(f)
             if prior.get("metric") == metric and prior.get("value"):
                 vs_baseline = value / float(prior["value"])
+                # A CPU-fallback run uses smaller shapes than the TPU
+                # baseline; raw steps/s ratios would be apples-to-oranges
+                # there, so compare on sparse-entry throughput (nnz/sec —
+                # rows alone would still bias by the differing nnz_per_row)
+                # and say so in the detail.
+                here = (detail.get("rows"), detail.get("nnz_per_row"))
+                prior_shape = (prior.get("rows"), prior.get("nnz_per_row"))
+                if (
+                    None not in here
+                    and None not in prior_shape
+                    and here != prior_shape
+                    and detail.get("rows_per_sec")
+                    and prior.get("rows_per_sec")
+                ):
+                    vs_baseline = (
+                        float(detail["rows_per_sec"]) * here[1]
+                    ) / (float(prior["rows_per_sec"]) * prior_shape[1])
+                    detail["vs_baseline_basis"] = (
+                        f"nnz_per_sec (shapes differ: {here[0]}x{here[1]} "
+                        f"here vs {prior_shape[0]}x{prior_shape[1]} in "
+                        f"baseline)"
+                    )
         except Exception:  # noqa: BLE001 — a corrupt baseline must not kill the bench
             pass
     if _PLATFORM_INFO["platform"] is not None:

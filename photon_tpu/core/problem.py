@@ -98,6 +98,9 @@ def cached_solver(optimizer: str, cfg: OptimizerConfig, variance: str,
     core/variance.py documents), so a search varying static keys (tolerances,
     max_iterations) evicts old solvers instead of growing without limit —
     eviction only costs a retrace on reuse."""
+    get_optimizer(optimizer)  # reject typos: _run_fit's else-branch is lbfgs
+    if variance not in VARIANCE_TYPES:
+        raise ValueError(f"unknown variance computation {variance!r}")
     run = functools.partial(_run_fit, optimizer=optimizer, cfg=cfg,
                             variance=variance)
     if vmapped:
