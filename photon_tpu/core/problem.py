@@ -83,7 +83,6 @@ def _run_fit(objective, batch: Batch, w0: Array, *, optimizer: str,
     return coefficients, result
 
 
-@functools.lru_cache(maxsize=32)
 def cached_solver(optimizer: str, cfg: OptimizerConfig, variance: str,
                   vmapped: bool = False):
     """The jit-compiled solver for one static problem configuration.
@@ -98,9 +97,20 @@ def cached_solver(optimizer: str, cfg: OptimizerConfig, variance: str,
     core/variance.py documents), so a search varying static keys (tolerances,
     max_iterations) evicts old solvers instead of growing without limit —
     eviction only costs a retrace on reuse."""
-    get_optimizer(optimizer)  # reject typos: _run_fit's else-branch is lbfgs
+    # Normalize + reject typos BEFORE the lru_cache key is formed: _run_fit
+    # dispatches on exact lowercase names and its else-branch is lbfgs, and
+    # lowercasing outside the cache keeps 'TRON'/'tron' from occupying two
+    # cache slots.
+    optimizer = optimizer.lower()
+    get_optimizer(optimizer)
     if variance not in VARIANCE_TYPES:
         raise ValueError(f"unknown variance computation {variance!r}")
+    return _cached_solver(optimizer, cfg, variance, vmapped)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_solver(optimizer: str, cfg: OptimizerConfig, variance: str,
+                   vmapped: bool):
     run = functools.partial(_run_fit, optimizer=optimizer, cfg=cfg,
                             variance=variance)
     if vmapped:
