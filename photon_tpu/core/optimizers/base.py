@@ -108,6 +108,13 @@ class OptimizationStatesTracker:
     def __iter__(self):
         return iter(zip(range(len(self.values)), self.values, self.grad_norms))
 
+    def states(self) -> list:
+        """JSON-ready per-iteration trace ``[[value, |grad|], ...]`` — the
+        reference dumps this tracker to logs; drivers keep it in
+        training_summary.json so convergence curves survive the run
+        (SURVEY.md §5 tracing)."""
+        return [[float(v), float(g)] for _, v, g in self]
+
     def summary(self) -> str:
         lines = [
             f"iterations={self.iterations} converged={self.converged} "

@@ -214,6 +214,7 @@ def _run_streaming(args: argparse.Namespace) -> dict:
             "iterations": tracker.iterations,
             "convergence_reason": tracker.convergence_reason,
             "wall_time_s": wall, "final_value": float(result.value),
+            "states": tracker.states(),
         })
 
     index_map = IndexMap.build(
@@ -365,6 +366,7 @@ def run(args: argparse.Namespace) -> dict:
                 "convergence_reason": tracker.convergence_reason,
                 "wall_time_s": wall,
                 "final_value": float(result.value),
+                "states": tracker.states(),
             }
         )
 
