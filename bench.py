@@ -317,8 +317,22 @@ def main() -> None:
         # Default run: all five SURVEY.md §6 configs first (one JSON line
         # each; a failing config emits its own error line and never blocks
         # the others), then the headline metric LAST — drivers that parse a
-        # single line take the final one.
+        # single line take the final one.  A soft wall-clock budget guards
+        # the headline: on a cold accelerator each config pays real compile
+        # time, and an external runner's timeout must never expire before
+        # the headline (the one number tracked round-over-round) prints.
+        budget_s = float(os.environ.get("PHOTON_BENCH_BUDGET_S", "480"))
+        t_start = time.perf_counter()
         for num in (1, 2, 3, 4, 5):
+            elapsed = time.perf_counter() - t_start
+            if elapsed > budget_s:
+                _emit(f"config{num}_skipped", 0.0, "skipped", {
+                    "reason": f"bench budget exhausted after {elapsed:.0f}s "
+                              f"(PHOTON_BENCH_BUDGET_S={budget_s:.0f}); "
+                              "run `bench.py --config "
+                              f"{num}` individually",
+                })
+                continue
             try:
                 _bench_config(num)
             except Exception as ex:  # noqa: BLE001 — config isolation
@@ -340,6 +354,11 @@ def main() -> None:
         n, k, d = 1 << 20, 32, 1 << 18
 
     batch = _build_batch(n, k, d)
+    bench_dtype = os.environ.get("PHOTON_BENCH_DTYPE", "float32")
+    if bench_dtype != "float32":
+        from photon_tpu.data.batch import batch_astype
+
+        batch = batch_astype(batch, bench_dtype)
     obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
     w = jnp.zeros(d, jnp.float32)
 
@@ -369,12 +388,14 @@ def main() -> None:
     # Effective bandwidth: per step the sparse hot loop must touch ids+vals
     # once in each direction (fwd gather products, bwd segment reduction).
     nnz = n * k
-    eff_gb_s = steps_per_sec * nnz * 2 * 8 / 1e9  # 2 passes x (4B id + 4B val)
+    val_bytes = jnp.dtype(bench_dtype).itemsize
+    eff_gb_s = steps_per_sec * nnz * 2 * (4 + val_bytes) / 1e9  # 2 passes x (id + val)
     hbm_gb_s = 819.0  # v5e HBM peak; CPU numbers are sanity-only
     _emit("glm_grad_steps_per_sec", steps_per_sec, "steps/s", {
         "rows": n,
         "nnz_per_row": k,
         "dim": d,
+        "dtype": bench_dtype,
         "platform": platform,
         "rows_per_sec": round(steps_per_sec * n, 1),
         "effective_gb_per_sec": round(eff_gb_s, 2),

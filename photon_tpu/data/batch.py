@@ -53,8 +53,9 @@ class FeatureMajorAux(NamedTuple):
 
     - ``ids``: int32 feature ids, non-decreasing within each block.
     - ``rows``: int32 BLOCK-LOCAL source row of each entry.
-    - ``vals``: float32 entry values (0.0 for the row-padding entries, which
-      therefore contribute nothing, same convention as SparseBatch).
+    - ``vals``: float entry values — float32, or the storage dtype set by
+      :func:`batch_astype` (0.0 for the row-padding entries, which therefore
+      contribute nothing, same convention as SparseBatch).
     """
 
     ids: Array
@@ -227,6 +228,25 @@ def attach_feature_major(batch: SparseBatch, shards: int = 1) -> SparseBatch:
         rows=jnp.asarray(take(rows, order, axis=1)),
         vals=jnp.asarray(take(vals, order, axis=1)),
     ))
+
+
+def batch_astype(batch: Batch, dtype) -> Batch:
+    """Re-store the batch's FEATURE VALUES in ``dtype`` (e.g. bfloat16).
+
+    TPU-first storage option: feature values are the second-largest stream
+    the sparse hot loop reads (after int32 ids), and GLM margins/gradients
+    are insensitive to feature-value precision at bf16 scale — all
+    arithmetic still happens in float32 via JAX type promotion (coefficients,
+    labels, offsets, weights, and every reduction stay f32; only the stored
+    values shrink).  The reference has no analog: Breeze vectors are f64.
+    """
+    dtype = jnp.dtype(dtype)
+    if isinstance(batch, DenseBatch):
+        return batch._replace(x=batch.x.astype(dtype))
+    out = batch._replace(vals=batch.vals.astype(dtype))
+    if out.fm is not None:
+        out = out._replace(fm=out.fm._replace(vals=out.fm.vals.astype(dtype)))
+    return out
 
 
 def pad_batch(batch: Batch, target_n: int) -> Batch:

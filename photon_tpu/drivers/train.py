@@ -45,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--elastic-net-alpha", type=float, default=0.5)
     p.add_argument("--max-iterations", type=int, default=100)
     p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--dtype", default="float32",
+                   choices=("float32", "bfloat16"),
+                   help="storage dtype for FEATURE VALUES (labels, weights, "
+                   "coefficients, and all arithmetic stay float32); "
+                   "bfloat16 halves the value stream the sparse hot loop "
+                   "reads from HBM")
     p.add_argument("--normalization", default="none",
                    choices=("none", "scale_with_standard_deviation",
                             "scale_with_max_magnitude", "standardization"))
@@ -93,6 +99,8 @@ def _run_streaming(args: argparse.Namespace) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
     if args.normalization != "none":
         raise ValueError("--stream does not support --normalization")
+    if getattr(args, "dtype", "float32") != "float32":
+        raise ValueError("--stream does not support --dtype yet")
     if args.optimizer != "lbfgs" or args.reg_type in ("l1", "elastic_net"):
         raise ValueError("--stream supports the lbfgs optimizer with l2/none "
                          "regularization")
@@ -302,6 +310,14 @@ def run(args: argparse.Namespace) -> dict:
             # Single-device: attach the pre-sorted layout so objectives take
             # the segment-sum gradient path (exact under normalization too).
             batch = attach_feature_major(batch)
+
+    if args.dtype != "float32":
+        from photon_tpu.data.batch import batch_astype
+
+        # After normalization stats (summaries use full-precision values)
+        # and after the feature-major attach (astype converts its vals too).
+        batch = batch_astype(batch, args.dtype)
+        logger.info("feature values stored as %s (f32 arithmetic)", args.dtype)
 
     if args.evaluators:
         evaluators = common.build_flat_evaluators(args.evaluators, "training")

@@ -1,0 +1,83 @@
+"""bfloat16 feature-value storage (TPU-first option; reference has no analog
+— Breeze vectors are f64).  Arithmetic stays float32 via promotion; only the
+stored value stream shrinks, so results must track f32 to bf16 precision.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.data.batch import (
+    SparseBatch,
+    attach_feature_major,
+    batch_astype,
+    dense_batch,
+)
+
+
+def _batch(n=512, k=6, d=48, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, d, size=(n, k), dtype=np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    label = (rng.random(n) < 0.5).astype(np.float32)
+    return attach_feature_major(SparseBatch(
+        jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(label),
+        jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32),
+    ))
+
+
+def test_bf16_value_and_grad_tracks_f32():
+    batch = _batch()
+    b16 = batch_astype(batch, jnp.bfloat16)
+    assert b16.vals.dtype == jnp.bfloat16 and b16.fm.vals.dtype == jnp.bfloat16
+    assert b16.label.dtype == jnp.float32  # only the value stream converts
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    w = jnp.asarray(np.random.default_rng(1).standard_normal(48), jnp.float32) * 0.3
+    v32, g32 = obj.value_and_grad(w, batch)
+    v16, g16 = obj.value_and_grad(w, b16)
+    assert v16.dtype == jnp.float32 and g16.dtype == jnp.float32
+    np.testing.assert_allclose(float(v16), float(v32), rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
+                               rtol=5e-2, atol=2e-2)
+
+
+def test_bf16_dense_batch():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((128, 16)).astype(np.float32)
+    y = (rng.random(128) < 0.5).astype(np.float32)
+    b = dense_batch(x, y)
+    b16 = batch_astype(b, jnp.bfloat16)
+    assert b16.x.dtype == jnp.bfloat16
+    obj = GlmObjective.create("logistic")
+    w = jnp.asarray(rng.standard_normal(16), jnp.float32) * 0.2
+    v32, _ = obj.value_and_grad(w, b)
+    v16, _ = obj.value_and_grad(w, b16)
+    np.testing.assert_allclose(float(v16), float(v32), rtol=2e-2)
+
+
+def test_driver_dtype_flag(tmp_path):
+    """--dtype bfloat16 trains end-to-end and lands near the f32 model."""
+    from photon_tpu.drivers import train
+
+    rng = np.random.default_rng(3)
+    n, d = 600, 24
+    w_true = rng.standard_normal(d)
+    path = tmp_path / "t.libsvm"
+    with open(path, "w") as f:
+        for _ in range(n):
+            fid = np.sort(rng.choice(np.arange(1, d + 1), 5, replace=False))
+            xv = rng.standard_normal(5)
+            y = 1 if rng.random() < 1 / (1 + np.exp(-float(w_true[fid - 1] @ xv))) else -1
+            f.write(f"{y} " + " ".join(f"{j}:{v:.5f}" for j, v in zip(fid, xv)) + "\n")
+
+    outs = {}
+    for dtype in ("float32", "bfloat16"):
+        out = tmp_path / dtype
+        summary = train.run(train.build_parser().parse_args([
+            "--backend", "cpu", "--input", str(path),
+            "--task", "logistic_regression", "--reg-weights", "1.0",
+            "--max-iterations", "40", "--dtype", dtype,
+            "--output-dir", str(out),
+        ]))
+        outs[dtype] = summary["sweep"][0]["final_value"]
+    np.testing.assert_allclose(outs["bfloat16"], outs["float32"], rtol=2e-2)
