@@ -355,6 +355,12 @@ def main() -> None:
 
     batch = _build_batch(n, k, d)
     bench_dtype = os.environ.get("PHOTON_BENCH_DTYPE", "float32")
+    try:
+        jnp.dtype(bench_dtype)
+    except TypeError:
+        # An invalid dtype must not kill the run before the headline prints
+        # (the budget guard's whole purpose); fall back and say so.
+        bench_dtype = "float32"
     if bench_dtype != "float32":
         from photon_tpu.data.batch import batch_astype
 

@@ -295,34 +295,56 @@ def write_container(path: str, schema: dict, records: list, sync: bytes | None =
             f.write(sync)
 
 
+def _read_header(f, path: str) -> tuple[dict, "_Named", bytes]:
+    """Parse the container header; returns (schema, named registry, sync)."""
+    if f.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    meta = {}
+    while True:
+        n = read_long(f)
+        if n == 0:
+            break
+        if n < 0:
+            read_long(f)
+            n = -n
+        for _ in range(n):
+            k = read_string(f)
+            meta[k] = read_bytes(f)
+    schema = json.loads(meta["avro.schema"].decode())
+    named = _Named()
+    _register_named(schema, named)
+    return schema, named, f.read(16)
+
+
+def _read_blocks(f, schema: dict, named: "_Named", sync: bytes, path: str):
+    """Yield records block-at-a-time from an open container positioned just
+    past the header."""
+    while True:
+        try:
+            count = read_long(f)
+        except EOFError:
+            break
+        read_long(f)  # byte size (unused, codec is null)
+        for _ in range(count):
+            yield read_datum(f, schema, named)
+        if f.read(16) != sync:
+            raise ValueError(f"{path}: sync marker mismatch")
+
+
+def iter_container(path: str):
+    """Yield records from an Avro container file LAZILY (one at a time).
+
+    The streaming complement of :func:`read_container`: block-at-a-time
+    decode, nothing retained — callers consuming billions of rows keep host
+    memory bounded by their own accumulators, not the record dicts
+    (SURVEY.md §7 '1B-row ingestion without Spark').
+    """
+    with open(path, "rb") as f:
+        schema, named, sync = _read_header(f, path)
+        yield from _read_blocks(f, schema, named, sync, path)
+
+
 def read_container(path: str) -> tuple[dict, list]:
     with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
-            raise ValueError(f"{path}: not an Avro container file")
-        meta = {}
-        while True:
-            n = read_long(f)
-            if n == 0:
-                break
-            if n < 0:
-                read_long(f)
-                n = -n
-            for _ in range(n):
-                k = read_string(f)
-                meta[k] = read_bytes(f)
-        schema = json.loads(meta["avro.schema"].decode())
-        named = _Named()
-        _register_named(schema, named)
-        sync = f.read(16)
-        records = []
-        while True:
-            try:
-                count = read_long(f)
-            except EOFError:
-                break
-            read_long(f)  # byte size (unused, codec is null)
-            for _ in range(count):
-                records.append(read_datum(f, schema, named))
-            if f.read(16) != sync:
-                raise ValueError(f"{path}: sync marker mismatch")
-        return schema, records
+        schema, named, sync = _read_header(f, path)
+        return schema, list(_read_blocks(f, schema, named, sync, path))

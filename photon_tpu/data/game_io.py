@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import glob as _glob
 import os
+from array import array
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -161,82 +162,109 @@ def read_game_avro(
     when an intercept is present every example keeps it.
     """
     files = _input_files(path)
-    records: list[dict] = []
+    build_maps = index_maps is None
+
+    # ONE streaming pass: records are decoded lazily (avro_codec.
+    # iter_container) and never retained — host memory is bounded by the
+    # flat CSR accumulators below (~entry-sized, i.e. the size of the final
+    # arrays), not by per-record dicts.  This is the single-host leg of the
+    # reference's RDD ingestion (SURVEY.md §7 '1B-row ingestion').
+    #
+    # Feature ids are assigned on the fly in first-seen order, which is
+    # exactly IndexMap.build's layout; the intercept lands at the END of the
+    # vocabulary, so intercept entries carry a -1 sentinel during the scan
+    # and are patched once the final vocabulary size is known.
+    label = array("f")
+    offset = array("f")
+    weight = array("f")
+    ids_cols: Dict[str, list] = {c: [] for c in id_columns}
+    if build_maps:
+        vocab: Dict[str, Dict[str, int]] = {s: {} for s in feature_bags}
+    flat_ids: Dict[str, array] = {s: array("i") for s in feature_bags}
+    flat_vals: Dict[str, array] = {s: array("f") for s in feature_bags}
+    nnz: Dict[str, array] = {s: array("i") for s in feature_bags}
+
+    i = 0
     for f in files:
-        _, recs = avro_codec.read_container(f)
-        records.extend(recs)
-    if not records:
+        for rec in avro_codec.iter_container(f):
+            label.append(rec["response"])
+            offset.append(rec.get("offset") or 0.0)
+            weight.append(1.0 if rec.get("weight") is None else rec["weight"])
+            for col in id_columns:
+                field = f"{col}__id" if f"{col}__id" in rec else col
+                if field not in rec:
+                    raise KeyError(f"record {i} missing id column {col!r}")
+                ids_cols[col].append(rec[field])
+            for shard_name, field in feature_bags.items():
+                f_ids, f_vals = flat_ids[shard_name], flat_vals[shard_name]
+                m = 0
+                if build_maps:
+                    seen = vocab[shard_name]
+                    for ntv in rec.get(field, ()):
+                        key = feature_key(ntv["name"], ntv["term"])
+                        if key == INTERCEPT_KEY:
+                            continue  # implicit: appended once below
+                        fid = seen.setdefault(key, len(seen))
+                        f_ids.append(fid)
+                        f_vals.append(ntv["value"])
+                        m += 1
+                else:
+                    imap = index_maps[shard_name]
+                    for ntv in rec.get(field, ()):
+                        key = feature_key(ntv["name"], ntv["term"])
+                        if key == INTERCEPT_KEY:
+                            continue
+                        fid = imap.get_id(key)
+                        if fid >= 0:  # absent from a fixed map -> dropped
+                            f_ids.append(fid)
+                            f_vals.append(ntv["value"])
+                            m += 1
+                if build_maps:
+                    if intercept:
+                        f_ids.append(-1)  # final id patched after the scan
+                        f_vals.append(1.0)
+                        m += 1
+                elif index_maps[shard_name].intercept_id is not None:
+                    f_ids.append(index_maps[shard_name].intercept_id)
+                    f_vals.append(1.0)
+                    m += 1
+                nnz[shard_name].append(m)
+            i += 1
+    n = i
+    if n == 0:
         raise ValueError(f"no records in {path!r}")
 
-    n = len(records)
-    label = np.empty(n, np.float32)
-    offset = np.zeros(n, np.float32)
-    weight = np.ones(n, np.float32)
-    ids_cols: Dict[str, list] = {c: [] for c in id_columns}
-    build_maps = index_maps is None
     if build_maps:
-        index_maps = {}
-        key_order: Dict[str, dict] = {s: {} for s in feature_bags}
+        index_maps = {
+            s: IndexMap.build(list(vocab[s]), intercept=intercept)
+            for s in feature_bags
+        }
 
-    # Pass 1: labels/ids + (optionally) discover feature vocabularies.
-    for i, rec in enumerate(records):
-        label[i] = rec["response"]
-        if rec.get("offset") is not None:
-            offset[i] = rec["offset"]
-        if rec.get("weight") is not None:
-            weight[i] = rec["weight"]
-        for col in id_columns:
-            field = f"{col}__id" if f"{col}__id" in rec else col
-            if field not in rec:
-                raise KeyError(f"record {i} missing id column {col!r}")
-            ids_cols[col].append(rec[field])
-        if build_maps:
-            for shard_name, field in feature_bags.items():
-                seen = key_order[shard_name]
-                for ntv in rec.get(field, ()):
-                    key = feature_key(ntv["name"], ntv["term"])
-                    if key != INTERCEPT_KEY:  # the intercept is implicit
-                        seen.setdefault(key, None)
-    if build_maps:
-        for shard_name in feature_bags:
-            index_maps[shard_name] = IndexMap.build(
-                list(key_order[shard_name]), intercept=intercept
-            )
-
-    # Pass 2: index features into padded-COO shards.
+    # Vectorized CSR -> padded-COO per shard.
     shards: Dict[str, SparseShard] = {}
-    for shard_name, field in feature_bags.items():
+    for shard_name in feature_bags:
         imap = index_maps[shard_name]
-        rows_ids, rows_vals, nnz = [], [], np.zeros(n, np.int64)
-        for i, rec in enumerate(records):
-            r_ids, r_vals = [], []
-            for ntv in rec.get(field, ()):
-                key = feature_key(ntv["name"], ntv["term"])
-                if key == INTERCEPT_KEY:
-                    continue  # implicit: appended once below
-                fid = imap.get_id(key)
-                if fid >= 0:
-                    r_ids.append(fid)
-                    r_vals.append(ntv["value"])
-            if imap.intercept_id is not None:
-                r_ids.append(imap.intercept_id)
-                r_vals.append(1.0)
-            rows_ids.append(r_ids)
-            rows_vals.append(r_vals)
-            nnz[i] = len(r_ids)
-        k = pad_row_capacity(nnz)
+        counts = np.frombuffer(nnz[shard_name], dtype=np.int32).astype(np.int64)
+        ids_f = np.frombuffer(flat_ids[shard_name], dtype=np.int32).copy()
+        vals_f = np.frombuffer(flat_vals[shard_name], dtype=np.float32)
+        if build_maps and imap.intercept_id is not None:
+            ids_f[ids_f < 0] = imap.intercept_id
+        k = pad_row_capacity(counts)
         ids = np.zeros((n, k), np.int32)
         vals = np.zeros((n, k), np.float32)
-        for i in range(n):
-            m = int(nnz[i])
-            ids[i, :m] = rows_ids[i]
-            vals[i, :m] = rows_vals[i]
+        row_idx = np.repeat(np.arange(n, dtype=np.int64), counts)
+        starts = np.concatenate(([0], np.cumsum(counts)))[:-1]
+        col_idx = np.arange(int(counts.sum()), dtype=np.int64) - np.repeat(
+            starts, counts
+        )
+        ids[row_idx, col_idx] = ids_f
+        vals[row_idx, col_idx] = vals_f
         shards[shard_name] = SparseShard(ids, vals, len(imap))
 
     dataset = GameDataset(
-        label=label,
-        offset=offset,
-        weight=weight,
+        label=np.frombuffer(label, dtype=np.float32).copy(),
+        offset=np.frombuffer(offset, dtype=np.float32).copy(),
+        weight=np.frombuffer(weight, dtype=np.float32).copy(),
         shards=shards,
         id_columns={c: np.asarray(v) for c, v in ids_cols.items()},
     )

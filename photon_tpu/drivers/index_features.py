@@ -56,9 +56,10 @@ def run(args: argparse.Namespace) -> dict:
     n_records = 0
     with logger.timed("scan"):
         for path in _input_files(args.input):
-            _, records = avro_codec.read_container(path)
-            n_records += len(records)
-            for rec in records:
+            # Lazy record iteration: the indexing job scans arbitrarily large
+            # part-file inputs holding only the vocabularies in memory.
+            for rec in avro_codec.iter_container(path):
+                n_records += 1
                 for shard, field in bags.items():
                     seen = key_order[shard]
                     for ntv in rec.get(field, ()):

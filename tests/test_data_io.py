@@ -155,3 +155,63 @@ def test_avro_by_name_reference_with_empty_defining_array():
     avro_codec.write_datum(buf, rec, schema)
     buf.seek(0)
     assert avro_codec.read_datum(buf, schema) == rec
+
+
+def test_iter_container_matches_read_container(tmp_path):
+    """The lazy reader must yield exactly read_container's records — the
+    streamed GAME ingestion (game_io.read_game_avro) is built on it."""
+    schema = {
+        "type": "record", "name": "R",
+        "fields": [
+            {"name": "x", "type": "double"},
+            {"name": "s", "type": "string"},
+        ],
+    }
+    records = [{"x": float(i) / 3.0, "s": f"r{i}"} for i in range(257)]
+    path = str(tmp_path / "r.avro")
+    avro_codec.write_container(path, schema, records)
+    assert list(avro_codec.iter_container(path)) == records
+    _, eager = avro_codec.read_container(path)
+    assert eager == records
+
+
+def test_read_game_avro_multi_file_matches_single(tmp_path):
+    """Part-file input (the 1B-row layout) must produce the same dataset
+    and vocabularies as one concatenated file."""
+    from photon_tpu.data.fixtures import make_movielens_like
+    from photon_tpu.data.game_io import read_game_avro, write_game_avro
+    from photon_tpu.game.data import take_rows
+
+    data, maps = make_movielens_like(n_users=24, n_items=18, mean_ratings=6)
+    n = data.num_examples
+    single = str(tmp_path / "all.avro")
+    write_game_avro(single, data, maps)
+    parts_dir = tmp_path / "parts"
+    parts_dir.mkdir()
+    third = n // 3
+    for pi, (lo, hi) in enumerate([(0, third), (third, 2 * third), (2 * third, n)]):
+        write_game_avro(
+            str(parts_dir / f"part-{pi}.avro"),
+            take_rows(data, np.arange(lo, hi)), maps,
+        )
+
+    bags = {name: name for name in data.shards}
+    got_s, maps_s = read_game_avro(single, bags, list(data.id_columns))
+    got_m, maps_m = read_game_avro(
+        str(parts_dir / "*.avro"), bags, list(data.id_columns)
+    )
+    for name in bags:
+        assert [maps_s[name].get_key(i) for i in range(len(maps_s[name]))] == \
+            [maps_m[name].get_key(i) for i in range(len(maps_m[name]))]
+        np.testing.assert_array_equal(
+            got_s.shards[name].ids, got_m.shards[name].ids
+        )
+        np.testing.assert_array_equal(
+            got_s.shards[name].vals, got_m.shards[name].vals
+        )
+    np.testing.assert_array_equal(got_s.label, got_m.label)
+    np.testing.assert_array_equal(got_s.weight, got_m.weight)
+    for col in data.id_columns:
+        np.testing.assert_array_equal(
+            got_s.id_columns[col], got_m.id_columns[col]
+        )
