@@ -360,6 +360,11 @@ def main() -> None:
     except TypeError:
         # An invalid dtype must not kill the run before the headline prints
         # (the budget guard's whole purpose); fall back and say so.
+        print(
+            f"WARNING: invalid PHOTON_BENCH_DTYPE={bench_dtype!r}; "
+            "benchmarking float32",
+            file=sys.stderr,
+        )
         bench_dtype = "float32"
     if bench_dtype != "float32":
         from photon_tpu.data.batch import batch_astype

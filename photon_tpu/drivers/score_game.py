@@ -41,12 +41,95 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--predict-mean", action="store_true",
                    help="write mean predictions (inverse link) instead of "
                    "raw scores")
+    p.add_argument("--stream", action="store_true",
+                   help="score Avro part files one at a time: features for "
+                   "each chunk are dropped after scoring, so host memory is "
+                   "bounded by the scores/labels, not the feature arrays "
+                   "(for scoring sets far beyond host memory)")
     return p
+
+
+def _evaluate_and_dump(args, logger, scores, label, weight, id_columns) -> dict:
+    """Shared evaluator + metrics.json tail of both scoring paths."""
+    from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
+
+    evaluators = MultiEvaluator(
+        [get_evaluator(s) for s in args.evaluators.split(",")]
+    )
+    metrics = evaluators.evaluate(scores, label, weight, id_columns)
+    logger.info("metrics %s", metrics)
+    with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
+        json.dump(metrics, f, indent=1)
+    return metrics
+
+
+def _run_streaming(args, model, index_maps, logger) -> dict:
+    """File-at-a-time scoring: each part file becomes a chunk dataset indexed
+    through the model's maps, is scored, and its features are dropped before
+    the next file loads — the scoring analog of the training driver's
+    ``--stream`` (SURVEY.md §7 '1B-row ingestion').  Without --evaluators
+    nothing but the incrementally-written scores.txt is retained; with them,
+    the per-row (score, label, weight, entity ids) survive for the final
+    metrics pass."""
+    import jax.numpy as jnp
+
+    from photon_tpu.core.losses import get_loss
+    from photon_tpu.data.game_io import _input_files, read_game_avro
+    from photon_tpu.drivers.train_game import parse_bags_and_id_columns
+
+    if args.input.startswith("synthetic-game:"):
+        raise ValueError("--stream needs Avro part-file input")
+    bags, id_cols = parse_bags_and_id_columns(args)
+
+    scores_chunks, label_chunks, weight_chunks = [], [], []
+    ids_chunks = {c: [] for c in id_cols}
+    n = 0
+    scores_path = os.path.join(args.output_dir, "scores.txt")
+    with open(scores_path, "w") as out_f:
+        for path in _input_files(args.input):
+            with logger.timed(f"score-{os.path.basename(path)}"):
+                try:
+                    chunk, _ = read_game_avro(
+                        path, bags, id_cols, index_maps=index_maps
+                    )
+                except ValueError as ex:
+                    # Part-file layouts routinely contain empty parts; only
+                    # a zero-record TOTAL is an error (checked below).
+                    if "no records" not in str(ex):
+                        raise
+                    logger.info("skipping empty part %s", path)
+                    continue
+                raw = model.score(chunk)
+                out = raw
+                if args.predict_mean:
+                    out = np.asarray(
+                        get_loss(model.task_type).mean(jnp.asarray(raw))
+                    )
+                np.savetxt(out_f, out, fmt="%.8g")
+                if args.evaluators:
+                    scores_chunks.append(np.asarray(raw))
+                    label_chunks.append(chunk.label)
+                    weight_chunks.append(chunk.weight)
+                    for c in id_cols:
+                        ids_chunks[c].append(chunk.id_columns[c])
+                n += chunk.num_examples
+    if n == 0:
+        raise ValueError(f"no records in {args.input!r}")
+
+    metrics = {}
+    if args.evaluators:
+        metrics = _evaluate_and_dump(
+            args, logger,
+            np.concatenate(scores_chunks),
+            np.concatenate(label_chunks),
+            np.concatenate(weight_chunks),
+            {c: np.concatenate(v) for c, v in ids_chunks.items()},
+        )
+    return {"num_scored": n, "metrics": metrics, "streamed": True}
 
 
 def run(args: argparse.Namespace) -> dict:
     common.select_backend(args.backend)
-    from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
     from photon_tpu.game.model_io import load_game_model
     from photon_tpu.utils import PhotonLogger
 
@@ -59,6 +142,9 @@ def run(args: argparse.Namespace) -> dict:
             "model: %s, coordinates %s", model.task_type,
             list(model.coordinates),
         )
+
+    if args.stream:
+        return _run_streaming(args, model, index_maps, logger)
 
     with logger.timed("load-data"):
         # Index scoring features through the model's training-time maps —
@@ -82,15 +168,10 @@ def run(args: argparse.Namespace) -> dict:
 
     metrics = {}
     if args.evaluators:
-        evaluators = MultiEvaluator(
-            [get_evaluator(n) for n in args.evaluators.split(",")]
+        metrics = _evaluate_and_dump(
+            args, logger, raw_scores, data.label, data.weight,
+            dict(data.id_columns),
         )
-        metrics = evaluators.evaluate(
-            raw_scores, data.label, data.weight, dict(data.id_columns)
-        )
-        logger.info("metrics %s", metrics)
-        with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
-            json.dump(metrics, f, indent=1)
     return {"num_scored": int(data.num_examples), "metrics": metrics}
 
 

@@ -265,6 +265,14 @@ def _load_game_data(spec: str, args, index_maps=None):
         return data, (index_maps or maps)
     from photon_tpu.data.game_io import read_game_avro
 
+    bags, id_cols = parse_bags_and_id_columns(args)
+    return read_game_avro(spec, bags, id_cols, index_maps=index_maps)
+
+
+def parse_bags_and_id_columns(args) -> tuple[dict, list]:
+    """--feature-bags 'shard=field,...' and --id-columns 'a,b' -> (dict, list);
+    shared by the training and (streamed) scoring drivers so parsing can
+    never diverge between them."""
     if not args.feature_bags or not args.id_columns:
         raise ValueError(
             "Avro input needs --feature-bags and --id-columns "
@@ -272,7 +280,7 @@ def _load_game_data(spec: str, args, index_maps=None):
         )
     bags = dict(tok.split("=", 1) for tok in args.feature_bags.split(","))
     id_cols = [c.strip() for c in args.id_columns.split(",") if c.strip()]
-    return read_game_avro(spec, bags, id_cols, index_maps=index_maps)
+    return bags, id_cols
 
 
 def run(args: argparse.Namespace) -> dict:

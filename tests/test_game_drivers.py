@@ -252,3 +252,57 @@ def test_train_game_driver_avro_end_to_end(tmp_path):
         ]
     ))
     assert summary2["best_metrics"]["AUC"] > 0.55
+
+
+def test_streamed_scoring_matches_whole(tmp_path):
+    """score_game --stream over part files must reproduce the whole-set
+    scores and metrics exactly (chunk boundaries cannot change results)."""
+    import numpy as np
+
+    from photon_tpu.drivers import score_game, train_game
+    from photon_tpu.game.data import take_rows
+
+    data, index_maps = small_game_data()
+    avro_path = str(tmp_path / "train.avro")
+    write_game_avro(avro_path, data, index_maps)
+    out = str(tmp_path / "out")
+    train_game.run(train_game.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", avro_path,
+        "--feature-bags", "global=global,re0=re0",
+        "--id-columns", "re0",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=6",
+        "--validation-split", "0.25",
+        "--output-dir", out,
+    ]))
+
+    parts = tmp_path / "parts"
+    parts.mkdir()
+    n = data.num_examples
+    for pi, (lo, hi) in enumerate([(0, n // 2), (n // 2, n)]):
+        write_game_avro(
+            str(parts / f"part-{pi}.avro"),
+            take_rows(data, np.arange(lo, hi)), index_maps,
+        )
+
+    common_args = [
+        "--backend", "cpu",
+        "--model", os.path.join(out, "best_model"),
+        "--feature-bags", "global=global,re0=re0",
+        "--id-columns", "re0",
+        "--evaluators", "AUC,SHARDED_AUC:re0",
+    ]
+    whole = score_game.run(score_game.build_parser().parse_args(
+        common_args + ["--input", avro_path,
+                       "--output-dir", str(tmp_path / "s_whole")]))
+    streamed = score_game.run(score_game.build_parser().parse_args(
+        common_args + ["--input", str(parts / "*.avro"), "--stream",
+                       "--output-dir", str(tmp_path / "s_stream")]))
+
+    assert streamed["streamed"] and streamed["num_scored"] == n
+    s_whole = np.loadtxt(tmp_path / "s_whole" / "scores.txt")
+    s_stream = np.loadtxt(tmp_path / "s_stream" / "scores.txt")
+    np.testing.assert_array_equal(s_whole, s_stream)
+    for name, value in whole["metrics"].items():
+        assert streamed["metrics"][name] == pytest.approx(value, rel=1e-6)
