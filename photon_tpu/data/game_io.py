@@ -40,6 +40,12 @@ FEATURE_SCHEMA = {
 }
 
 
+class NoRecordsError(ValueError):
+    """Raised when an input yields zero records — a typed contract so
+    streaming callers can skip routinely-empty part files without matching
+    on error text."""
+
+
 def _id_field(col: str, bag_fields: Sequence[str]) -> str:
     """Record field holding entity-id column ``col``; suffixed when the name
     collides with a feature-bag field (synthetic data uses one name for
@@ -232,7 +238,7 @@ def read_game_avro(
             i += 1
     n = i
     if n == 0:
-        raise ValueError(f"no records in {path!r}")
+        raise NoRecordsError(f"no records in {path!r}")
 
     if build_maps:
         index_maps = {

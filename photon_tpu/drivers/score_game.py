@@ -63,18 +63,62 @@ def _evaluate_and_dump(args, logger, scores, label, weight, id_columns) -> dict:
     return metrics
 
 
+def _pad_pow2_rows(chunk):
+    """Pad a chunk dataset to the next power-of-two row count with
+    zero-weight rows, so part files of varying sizes bucket into O(log n)
+    distinct shapes — the jitted scoring kernels compile once per bucket
+    instead of once per file.  Padded rows reuse the chunk's first entity
+    key (always valid for the vocabulary dtype); their scores are sliced
+    off before anything is written.  Returns (padded, real_n)."""
+    import dataclasses
+
+    from photon_tpu.game.data import DenseShard, SparseShard
+
+    n = chunk.num_examples
+    target = 1 << max(n - 1, 0).bit_length()
+    if target == n:
+        return chunk, n
+    pad = target - n
+
+    def pad_rows(a: np.ndarray) -> np.ndarray:
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    shards = {}
+    for name, shard in chunk.shards.items():
+        if isinstance(shard, SparseShard):
+            shards[name] = SparseShard(
+                pad_rows(shard.ids), pad_rows(shard.vals), shard.dim
+            )
+        else:
+            shards[name] = DenseShard(pad_rows(shard.x))
+    return dataclasses.replace(
+        chunk,
+        label=pad_rows(chunk.label),
+        offset=pad_rows(chunk.offset),
+        weight=pad_rows(chunk.weight),
+        shards=shards,
+        id_columns={
+            c: np.concatenate([v, np.full(pad, v[0], v.dtype)])
+            for c, v in chunk.id_columns.items()
+        },
+    ), n
+
+
 def _run_streaming(args, model, index_maps, logger) -> dict:
     """File-at-a-time scoring: each part file becomes a chunk dataset indexed
     through the model's maps, is scored, and its features are dropped before
-    the next file loads — the scoring analog of the training driver's
-    ``--stream`` (SURVEY.md §7 '1B-row ingestion').  Without --evaluators
+    the next file loads — the scoring analog of the legacy GLM driver's
+    ``--stream`` (drivers/train.py; SURVEY.md §7 '1B-row ingestion').
+    GAME *training* streams at the ingestion layer instead
+    (game_io.read_game_avro's lazy CSR build).  Without --evaluators
     nothing but the incrementally-written scores.txt is retained; with them,
     the per-row (score, label, weight, entity ids) survive for the final
     metrics pass."""
     import jax.numpy as jnp
 
     from photon_tpu.core.losses import get_loss
-    from photon_tpu.data.game_io import _input_files, read_game_avro
+    from photon_tpu.data.game_io import NoRecordsError, _input_files, read_game_avro
     from photon_tpu.drivers.train_game import parse_bags_and_id_columns
 
     if args.input.startswith("synthetic-game:"):
@@ -92,14 +136,13 @@ def _run_streaming(args, model, index_maps, logger) -> dict:
                     chunk, _ = read_game_avro(
                         path, bags, id_cols, index_maps=index_maps
                     )
-                except ValueError as ex:
+                except NoRecordsError:
                     # Part-file layouts routinely contain empty parts; only
                     # a zero-record TOTAL is an error (checked below).
-                    if "no records" not in str(ex):
-                        raise
                     logger.info("skipping empty part %s", path)
                     continue
-                raw = model.score(chunk)
+                padded, real_n = _pad_pow2_rows(chunk)
+                raw = model.score(padded)[:real_n]
                 out = raw
                 if args.predict_mean:
                     out = np.asarray(
@@ -112,9 +155,12 @@ def _run_streaming(args, model, index_maps, logger) -> dict:
                     weight_chunks.append(chunk.weight)
                     for c in id_cols:
                         ids_chunks[c].append(chunk.id_columns[c])
-                n += chunk.num_examples
+                n += real_n
+                # Drop this chunk's feature arrays BEFORE the next file
+                # loads: peak host memory stays one chunk, not two.
+                del chunk, padded, raw, out
     if n == 0:
-        raise ValueError(f"no records in {args.input!r}")
+        raise NoRecordsError(f"no records in {args.input!r}")
 
     metrics = {}
     if args.evaluators:

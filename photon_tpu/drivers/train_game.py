@@ -269,16 +269,21 @@ def _load_game_data(spec: str, args, index_maps=None):
     return read_game_avro(spec, bags, id_cols, index_maps=index_maps)
 
 
+def parse_feature_bags(feature_bags: str) -> dict:
+    """--feature-bags 'shard=field,...' -> dict; the ONE parse of this flag
+    (training, index-map loading, and streamed scoring all share it)."""
+    return dict(tok.split("=", 1) for tok in feature_bags.split(","))
+
+
 def parse_bags_and_id_columns(args) -> tuple[dict, list]:
-    """--feature-bags 'shard=field,...' and --id-columns 'a,b' -> (dict, list);
-    shared by the training and (streamed) scoring drivers so parsing can
-    never diverge between them."""
+    """--feature-bags + --id-columns -> (dict, list); shared by the training
+    and (streamed) scoring drivers so parsing can never diverge."""
     if not args.feature_bags or not args.id_columns:
         raise ValueError(
             "Avro input needs --feature-bags and --id-columns "
             "(shard=field pairs and entity id fields)"
         )
-    bags = dict(tok.split("=", 1) for tok in args.feature_bags.split(","))
+    bags = parse_feature_bags(args.feature_bags)
     id_cols = [c.strip() for c in args.id_columns.split(",") if c.strip()]
     return bags, id_cols
 
@@ -306,7 +311,7 @@ def run(args: argparse.Namespace) -> dict:
             raise ValueError("--index-maps needs --feature-bags")
         from photon_tpu.data.index_map import IndexMap
 
-        bags = dict(tok.split("=", 1) for tok in args.feature_bags.split(","))
+        bags = parse_feature_bags(args.feature_bags)
         prebuilt_maps = {
             shard: IndexMap.load(
                 os.path.join(args.index_maps, f"feature_index_{shard}.json")
