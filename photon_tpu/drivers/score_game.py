@@ -74,8 +74,10 @@ def _pad_pow2_rows(chunk):
 
     from photon_tpu.game.data import DenseShard, SparseShard
 
+    from photon_tpu.utils import pow2_at_least
+
     n = chunk.num_examples
-    target = 1 << max(n - 1, 0).bit_length()
+    target = pow2_at_least(n)
     if target == n:
         return chunk, n
     pad = target - n

@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_tpu.utils import pow2_at_least
+
 from photon_tpu.core.losses import get_loss
 
 Array = jax.Array
@@ -128,7 +130,7 @@ def sharded_metric(
         # jitted metric compiles O(log max_group) times, not once per
         # distinct group size.
         n = int(sel.sum())
-        padded = 1 << (n - 1).bit_length()
+        padded = pow2_at_least(n)
         s = np.zeros(padded, scores.dtype)
         l = np.zeros(padded, labels.dtype)
         ww = np.zeros(padded, w.dtype)

@@ -33,11 +33,7 @@ import numpy as np
 from photon_tpu.game.data import DenseShard, EntityBucket, Shard, SparseShard
 
 
-def _pow2_at_least(n: int) -> int:
-    r = 1
-    while r < n:
-        r *= 2
-    return r
+from photon_tpu.utils import pow2_at_least as _pow2_at_least
 
 
 @dataclasses.dataclass(frozen=True)

@@ -181,3 +181,133 @@ jax.tree_util.register_pytree_node(
     lambda o: ((o.obj,), (o.mesh, o.axis_name)),
     lambda aux, children: DistributedGlmObjective(children[0], aux[0], aux[1]),
 )
+
+
+class RowSplitGlmObjective:
+    """Per-entity objective whose ROWS are split across a mesh axis.
+
+    The missing leg of the reference's entity-grouping shuffle: when one
+    entity's rows span hosts, the reference physically moves rows so each
+    entity is co-located.  Here nothing moves — every shard evaluates the
+    data terms on its LOCAL rows of every entity and ``lax.psum``s, so the
+    (vmapped, replicated) optimizer sees exact global per-entity values.
+    The shuffle becomes a collective (README §scale-out data strategy).
+
+    Use INSIDE ``shard_map`` over ``axis_name`` (see
+    :func:`solve_entities_row_split`).  Regularization is added once
+    globally — data terms psum, l2/l1 do not.
+    """
+
+    def __init__(self, obj: GlmObjective, axis_name: str = DATA_AXIS):
+        self.obj = obj
+        self.axis_name = axis_name
+
+    @property
+    def l1_weight(self):
+        return self.obj.l1_weight
+
+    def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
+        v, g = jax.value_and_grad(self.obj.data_value)(w, batch)
+        v = lax.psum(v, self.axis_name)
+        g = lax.psum(g, self.axis_name)
+        l2 = self.obj.l2_weight
+        if not _static_zero(l2):
+            v = v + 0.5 * l2 * jnp.dot(w, w)
+            g = g + l2 * w
+        return v, g
+
+    def value(self, w: Array, batch: Batch) -> Array:
+        v = lax.psum(self.obj.data_value(w, batch), self.axis_name)
+        if not _static_zero(self.obj.l2_weight):
+            v = v + 0.5 * self.obj.l2_weight * jnp.dot(w, w)
+        return v
+
+    def grad(self, w: Array, batch: Batch) -> Array:
+        return self.value_and_grad(w, batch)[1]
+
+    def hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
+        hv = jax.jvp(
+            lambda u: jax.grad(self.obj.data_value)(u, batch), (w,), (v,)
+        )[1]
+        hv = lax.psum(hv, self.axis_name)
+        if not _static_zero(self.obj.l2_weight):
+            hv = hv + self.obj.l2_weight * v
+        return hv
+
+    def hessian_diagonal(self, w: Array, batch: Batch) -> Array:
+        l2 = self.obj.l2_weight
+        local = self.obj.hessian_diagonal(w, batch) - l2
+        return lax.psum(local, self.axis_name) + l2
+
+    def hessian_matrix(self, w: Array, batch: Batch) -> Array:
+        d = w.shape[0]
+        l2 = self.obj.l2_weight
+        local = self.obj.hessian_matrix(w, batch) - l2 * jnp.eye(d, dtype=w.dtype)
+        return lax.psum(local, self.axis_name) + l2 * jnp.eye(d, dtype=w.dtype)
+
+
+jax.tree_util.register_pytree_node(
+    RowSplitGlmObjective,
+    lambda o: ((o.obj,), (o.axis_name,)),
+    lambda aux, children: RowSplitGlmObjective(children[0], aux[0]),
+)
+
+
+def solve_entities_row_split(
+    objective: GlmObjective,
+    config,
+    batches: Batch,
+    w0s: Array,
+    mesh: Mesh,
+    axis_name: str = DATA_AXIS,
+):
+    """Solve every entity's GLM with its rows SHARDED across ``axis_name``.
+
+    ``batches`` leaves are ``[E, R, ...]`` (entity-major, per-entity padded
+    rows — zero-weight padding as usual) with ``R`` divisible by the axis
+    size; ``w0s`` is ``[E, dim]`` replicated.  Each shard holds the
+    ``R/num_shards`` row slice of EVERY entity; the vmapped optimizer runs
+    replicated on all shards, driven by psum-exact global gradients
+    (:class:`RowSplitGlmObjective`).  Returns (Coefficients, OptimizerResult)
+    pytrees with leading entity axes, replicated across the mesh.
+
+    This is the rows-exceed-host-memory leg of the random-effect story: on a
+    multi-process mesh each process contributes only the rows IT read, and
+    no row ever crosses a host — the reference's shuffle traffic becomes one
+    psum per objective evaluation over ICI/DCN.
+    """
+    from functools import partial as _partial
+
+    from photon_tpu.core.problem import cached_solver
+
+    n_shards = mesh.shape[axis_name]
+    r = jax.tree.leaves(batches)[0].shape[1]
+    if r % n_shards:
+        raise ValueError(
+            f"per-entity row capacity ({r}) must be divisible by the mesh "
+            f"axis size ({n_shards}); pad entity rows first"
+        )
+    if getattr(batches, "fm", None) is not None:
+        batches = batches._replace(fm=None)  # row-major path under vmap
+
+    solver = cached_solver(
+        config.optimizer.lower(), config.optimizer_config,
+        config.variance_computation, vmapped=True,
+    )
+    split_obj = RowSplitGlmObjective(objective, axis_name)
+    batch_specs = jax.tree.map(
+        lambda leaf: P(None, axis_name, *([None] * (leaf.ndim - 2))), batches
+    )
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(batch_specs, P()),
+        out_specs=P(),
+        check_vma=False,  # optimizer state is replicated by construction:
+        # every shard runs the identical update from psum-ed gradients
+    )
+    def _solve(local, w0s):
+        return solver(split_obj, local, w0s)
+
+    return _solve(batches, w0s)

@@ -5,3 +5,10 @@ SURVEY.md §2.1/§5).
 """
 
 from photon_tpu.utils.logging import PhotonLogger, Timed  # noqa: F401
+
+
+def pow2_at_least(n: int) -> int:
+    """Smallest power of two >= n (>= 1) — the shape-bucketing rule shared by
+    projection capacities, sharded-metric padding, and streamed-scoring
+    chunks, so jitted programs compile O(log n) times across sizes."""
+    return 1 << max(int(n) - 1, 0).bit_length()

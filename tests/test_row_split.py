@@ -1,0 +1,112 @@
+"""Row-split random-effect solves: entities' rows sharded across the mesh.
+
+The reference co-locates each entity's rows via shuffle before solving
+(RandomEffectDatasetPartitioner); the row-split path solves each entity
+EXACTLY while its rows stay where they were read, psum-ing per-entity data
+terms across the mesh axis (parallel/distributed.RowSplitGlmObjective —
+README §scale-out).  These tests pin exactness against co-located solves.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
+from photon_tpu.data.batch import SparseBatch
+from photon_tpu.parallel.distributed import solve_entities_row_split
+from photon_tpu.parallel.mesh import DATA_AXIS
+
+
+def _entity_batches(n_entities=6, rows=32, k=4, d=16, seed=0):
+    """[E, R, ...] per-entity padded batches with ragged real row counts."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, d, size=(n_entities, rows, k), dtype=np.int32)
+    vals = rng.standard_normal((n_entities, rows, k)).astype(np.float32)
+    label = (rng.random((n_entities, rows)) < 0.5).astype(np.float32)
+    # Ragged: entity e has 8*(e%3+1) real rows; the rest are weight-0 pads
+    # scattered ACROSS the row axis so every mesh shard sees some padding.
+    weight = np.zeros((n_entities, rows), np.float32)
+    for e in range(n_entities):
+        real = 8 * (e % 3 + 1)
+        keep = rng.choice(rows, size=real, replace=False)
+        weight[e, keep] = rng.uniform(0.5, 2.0, real).astype(np.float32)
+    return SparseBatch(
+        jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(label),
+        jnp.zeros((n_entities, rows), jnp.float32), jnp.asarray(weight),
+    )
+
+
+@pytest.mark.parametrize("optimizer,reg_type", [
+    ("lbfgs", "l2"), ("tron", "l2"), ("owlqn", "l1"),
+])
+def test_row_split_matches_colocated(optimizer, reg_type):
+    batches = _entity_batches()
+    d = 16
+    reg = RegularizationContext(reg_type, 0.7)
+    cfg = ProblemConfig(optimizer=optimizer, regularization=reg,
+                        optimizer_config=OptimizerConfig(max_iterations=15))
+    obj = GlmObjective.create("logistic", reg)
+    w0s = jnp.zeros((batches.ids.shape[0], d), jnp.float32)
+
+    # Co-located reference: plain vmapped solve, all rows on one device.
+    ref_coeffs, ref_res = GlmOptimizationProblem(obj, cfg).solver(vmapped=True)(
+        obj, batches, w0s
+    )
+
+    # Row-split: the SAME entities with rows sharded over all 8 devices.
+    mesh = Mesh(np.asarray(jax.devices()), (DATA_AXIS,))
+    split_coeffs, split_res = solve_entities_row_split(
+        obj, cfg, batches, w0s, mesh
+    )
+
+    # psum reduction order differs from the co-located row-sum order;
+    # optimizer trajectories amplify the f32 noise over iterations, so the
+    # comparison is solver-trajectory-tolerance, not bitwise.
+    np.testing.assert_allclose(
+        np.asarray(split_coeffs.means), np.asarray(ref_coeffs.means),
+        rtol=2e-2, atol=2e-3,
+    )
+    # Convergence FLAGS can flip near thresholds (TRON's accept/reject is a
+    # hard comparison on psum-order-sensitive f32 values); what must agree
+    # is the achieved objective.
+    np.testing.assert_allclose(
+        np.asarray(split_res.value), np.asarray(ref_res.value),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_row_split_variance_matches():
+    """SIMPLE variance (1/diag(H)) must psum the diagonal exactly."""
+    batches = _entity_batches(seed=3)
+    d = 16
+    reg = RegularizationContext("l2", 1.0)
+    cfg = ProblemConfig(optimizer="lbfgs", regularization=reg,
+                        optimizer_config=OptimizerConfig(max_iterations=12),
+                        variance_computation="simple")
+    obj = GlmObjective.create("logistic", reg)
+    w0s = jnp.zeros((batches.ids.shape[0], d), jnp.float32)
+    ref_coeffs, _ = GlmOptimizationProblem(obj, cfg).solver(vmapped=True)(
+        obj, batches, w0s
+    )
+    mesh = Mesh(np.asarray(jax.devices()), (DATA_AXIS,))
+    split_coeffs, _ = solve_entities_row_split(obj, cfg, batches, w0s, mesh)
+    np.testing.assert_allclose(
+        np.asarray(split_coeffs.variances), np.asarray(ref_coeffs.variances),
+        rtol=5e-4, atol=1e-6,
+    )
+
+
+def test_row_split_rejects_indivisible_rows():
+    batches = _entity_batches(rows=30)  # 30 % 8 != 0
+    reg = RegularizationContext("l2", 1.0)
+    cfg = ProblemConfig(optimizer="lbfgs", regularization=reg)
+    obj = GlmObjective.create("logistic", reg)
+    mesh = Mesh(np.asarray(jax.devices()), (DATA_AXIS,))
+    with pytest.raises(ValueError, match="divisible by the mesh axis"):
+        solve_entities_row_split(
+            obj, cfg, batches, jnp.zeros((6, 16), jnp.float32), mesh
+        )
