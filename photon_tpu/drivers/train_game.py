@@ -101,6 +101,7 @@ _KNOWN_COORDINATE_KEYS = {
     "type", "shard", "entity", "optimizer", "reg_type", "reg_weights",
     "alpha", "max_iters", "tolerance", "variance", "active_row_cap",
     "downsample", "downsampler", "projection", "projected_dim", "seed",
+    "row_split",
     "latent_dim", "latent_iterations",
 }
 
@@ -175,6 +176,11 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
         variance_computation=kv.get("variance", "none"),
     )
     if kv.get("type", "fixed") == "fixed":
+        if kv.get("row_split"):
+            raise ValueError(
+                "row_split applies to random coordinates only (the fixed "
+                "effect is already data-sharded with psum)"
+            )
         downsampler = kv.get("downsampler") or "auto"
         if downsampler == "auto":
             from photon_tpu.core.losses import BINARY_TASKS
@@ -189,6 +195,11 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
         )
     cap = kv.get("active_row_cap")
     if kv.get("type") == "factored_random":
+        if kv.get("row_split"):
+            raise ValueError(
+                "row_split is not supported for factored_random coordinates "
+                "(the pooled latent solve already spans the mesh)"
+            )
         if kv.get("projection") or kv.get("projected_dim") or kv.get("variance"):
             raise ValueError(
                 "projection/projected_dim/variance are not supported for "
@@ -214,6 +225,7 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
         projection=kv.get("projection", "none"),
         projected_dim=None if pdim in (None, "") else int(pdim),
         seed=int(kv.get("seed", 0)),
+        row_split=kv.get("row_split", "false").lower() in ("true", "1", "yes"),
     )
 
 

@@ -50,6 +50,7 @@ from photon_tpu.game.data import (
     build_random_effect_dataset,
     entity_index_for,
     pad_bucket_entities,
+    pad_bucket_rows,
 )
 from photon_tpu.game.model import (
     FixedEffectModel,
@@ -96,12 +97,23 @@ class RandomEffectCoordinateConfig:
     projection: str = "none"
     projected_dim: Optional[int] = None
     seed: int = 0
+    # Row-split placement (README §scale-out): instead of sharding the ENTITY
+    # axis over the mesh, every shard holds a ROW slice of every entity and
+    # per-entity data terms psum — for entities whose rows exceed one
+    # shard/host (the reference co-locates them with a shuffle; here no row
+    # moves).  Ignored without a mesh.
+    row_split: bool = False
 
     def __post_init__(self):
         if self.projection not in ("none", "index_map", "random"):
             raise ValueError(f"unknown projection {self.projection!r}")
         if self.projection == "random" and not self.projected_dim:
             raise ValueError("random projection needs projected_dim")
+        if self.row_split and self.projection == "index_map":
+            # Per-entity index-map projection picks each entity's active
+            # features from its OWN rows; under row-split a shard sees only
+            # a row slice, so the projection would differ per shard.
+            raise ValueError("row_split does not support index_map projection")
 
     @property
     def data_key(self):
@@ -113,6 +125,7 @@ class RandomEffectCoordinateConfig:
             self.projection,
             self.projected_dim,
             self.seed,
+            self.row_split,
         )
 
 
@@ -260,10 +273,18 @@ class RandomEffectDeviceData:
         )
         self.dim = self.dataset.dim
         n_shards = 1 if mesh is None else int(np.prod(list(mesh.shape.values())))
-        self.buckets = [
-            pad_bucket_entities(b, n_shards, self.dataset.num_entities)
-            for b in self.dataset.buckets
-        ]
+        self.row_split = bool(getattr(config, "row_split", False)) and n_shards > 1
+        if self.row_split:
+            # Entities replicated, each entity's ROWS sharded over the mesh
+            # (solve_entities_row_split); pad row capacity, not entities.
+            self.buckets = [
+                pad_bucket_rows(b, n_shards) for b in self.dataset.buckets
+            ]
+        else:
+            self.buckets = [
+                pad_bucket_entities(b, n_shards, self.dataset.num_entities)
+                for b in self.dataset.buckets
+            ]
         # Optional feature projection shrinks each bucket's solve dimension
         # (reference: data/projectors — see game.projection).
         self.random_matrix = None
@@ -303,7 +324,7 @@ class RandomEffectDeviceData:
                     "entity_index": jnp.asarray(bucket.entity_index),
                     "proj": proj,
                     "solve_dim": solve_dim,
-                    "w0": self._place(
+                    "w0": self._place_w0(
                         jnp.zeros((bucket.num_entities, solve_dim), jnp.float32)
                     ),
                 }
@@ -311,11 +332,26 @@ class RandomEffectDeviceData:
 
     def _sharding(self, ndim: int):
         axis = next(iter(self.mesh.shape))  # single-axis mesh
+        if self.row_split:
+            # [E, R, ...]: entities replicated, the row axis sharded.
+            if ndim < 2:
+                return NamedSharding(self.mesh, P())
+            return NamedSharding(self.mesh, P(None, axis, *([None] * (ndim - 2))))
         return NamedSharding(self.mesh, P(axis, *([None] * (ndim - 1))))
 
     def _place(self, leaf: Array) -> Array:
         if self.mesh is None:
             return leaf
+        return jax.device_put(leaf, self._sharding(leaf.ndim))
+
+    def _place_w0(self, leaf: Array) -> Array:
+        """Per-entity coefficient tables: sharded like entities normally,
+        REPLICATED under row-split (every shard runs the same optimizer on
+        psum-ed gradients)."""
+        if self.mesh is None:
+            return leaf
+        if self.row_split:
+            return jax.device_put(leaf, NamedSharding(self.mesh, P()))
         return jax.device_put(leaf, self._sharding(leaf.ndim))
 
     def batch_for(self, i: int, offsets_b: Array):
@@ -484,17 +520,28 @@ class RandomEffectCoordinate:
             proj = dev["proj"]
             if init_table is not None:
                 if proj is None:
-                    w0 = self.device_data._place(init_table[entity_idx])
+                    w0 = self.device_data._place_w0(init_table[entity_idx])
                 else:
                     # Projection restriction is host-side numpy (built once
                     # per descent iteration per bucket; warm-start only).
                     w0_global = to_host(init_table)[np.asarray(entity_idx)]
-                    w0 = self.device_data._place(
+                    w0 = self.device_data._place_w0(
                         jnp.asarray(proj.restrict_table(w0_global))
                     )
             else:
                 w0 = dev["w0"]
-            coefficients, result = self._solver(batch, w0)
+            if self.device_data.row_split:
+                from photon_tpu.parallel.distributed import (
+                    solve_entities_row_split,
+                )
+
+                coefficients, result = solve_entities_row_split(
+                    self.problem.objective, self.config.problem,
+                    batch, w0, self.mesh,
+                    axis_name=next(iter(self.mesh.shape)),
+                )
+            else:
+                coefficients, result = self._solver(batch, w0)
             means, variances = coefficients.means, coefficients.variances
             if proj is None:
                 table = table.at[entity_idx].set(means)

@@ -334,6 +334,36 @@ def build_random_effect_dataset(
     )
 
 
+def pad_bucket_rows(bucket: EntityBucket, multiple: int) -> EntityBucket:
+    """Pad a bucket's per-entity ROW capacity to a multiple (for row-split
+    sharding: each mesh shard takes ``row_capacity / multiple`` rows of every
+    entity — parallel/distributed.solve_entities_row_split).  Padded rows
+    carry zero weight and row_index 0, the bucket's usual convention."""
+    r = bucket.row_capacity
+    target = ((r + multiple - 1) // multiple) * multiple
+    if target == r:
+        return bucket
+    pad = target - r
+
+    def pad1(a: np.ndarray) -> np.ndarray:
+        widths = [(0, 0), (0, pad)] + [(0, 0)] * (a.ndim - 2)
+        return np.pad(a, widths)
+
+    features = bucket.features
+    if isinstance(features, DenseShard):
+        features = DenseShard(pad1(features.x))
+    else:
+        features = SparseShard(pad1(features.ids), pad1(features.vals), features.dim_)
+    return EntityBucket(
+        row_capacity=target,
+        entity_index=bucket.entity_index,
+        row_index=pad1(bucket.row_index),
+        row_weight=pad1(bucket.row_weight),
+        label=pad1(bucket.label),
+        features=features,
+    )
+
+
 def pad_bucket_entities(bucket: EntityBucket, multiple: int, num_entities: int) -> EntityBucket:
     """Pad a bucket's entity axis to a multiple (for even mesh sharding).
 

@@ -19,6 +19,7 @@ split, kept).
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Callable
 
@@ -276,10 +277,6 @@ def solve_entities_row_split(
     no row ever crosses a host — the reference's shuffle traffic becomes one
     psum per objective evaluation over ICI/DCN.
     """
-    from functools import partial as _partial
-
-    from photon_tpu.core.problem import cached_solver
-
     n_shards = mesh.shape[axis_name]
     r = jax.tree.leaves(batches)[0].shape[1]
     if r % n_shards:
@@ -290,24 +287,36 @@ def solve_entities_row_split(
     if getattr(batches, "fm", None) is not None:
         batches = batches._replace(fm=None)  # row-major path under vmap
 
-    solver = cached_solver(
-        config.optimizer.lower(), config.optimizer_config,
-        config.variance_computation, vmapped=True,
+    program = _row_split_program(
+        mesh, axis_name, config.optimizer.lower(), config.optimizer_config,
+        config.variance_computation,
+        jax.tree.structure(batches),
+        tuple(leaf.ndim for leaf in jax.tree.leaves(batches)),
     )
-    split_obj = RowSplitGlmObjective(objective, axis_name)
-    batch_specs = jax.tree.map(
-        lambda leaf: P(None, axis_name, *([None] * (leaf.ndim - 2))), batches
-    )
+    return program(RowSplitGlmObjective(objective, axis_name), batches, w0s)
 
-    @_partial(
-        shard_map,
+
+@functools.lru_cache(maxsize=32)
+def _row_split_program(mesh, axis_name, optimizer, opt_cfg, variance,
+                       batch_treedef, batch_ranks):
+    """One shard_map'd solve program per (mesh, static config, batch
+    structure): the per-bucket/per-descent-iteration calls in
+    RandomEffectCoordinate.train hit jax's trace cache instead of retracing
+    the whole vmapped optimizer every call (same discipline as
+    core/problem.cached_solver; the objective rides along as a replicated
+    pytree argument)."""
+    from photon_tpu.core.problem import cached_solver
+
+    solver = cached_solver(optimizer, opt_cfg, variance, vmapped=True)
+    batch_specs = jax.tree.unflatten(
+        batch_treedef,
+        [P(None, axis_name, *([None] * (r - 2))) for r in batch_ranks],
+    )
+    return shard_map(
+        lambda split_obj, local, w0s: solver(split_obj, local, w0s),
         mesh=mesh,
-        in_specs=(batch_specs, P()),
+        in_specs=(P(), batch_specs, P()),
         out_specs=P(),
         check_vma=False,  # optimizer state is replicated by construction:
         # every shard runs the identical update from psum-ed gradients
     )
-    def _solve(local, w0s):
-        return solver(split_obj, local, w0s)
-
-    return _solve(batches, w0s)

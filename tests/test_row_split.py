@@ -110,3 +110,65 @@ def test_row_split_rejects_indivisible_rows():
         solve_entities_row_split(
             obj, cfg, batches, jnp.zeros((6, 16), jnp.float32), mesh
         )
+
+
+def test_random_effect_coordinate_row_split_matches_entity_sharded():
+    """RandomEffectCoordinate(row_split=True) must reproduce the default
+    entity-sharded coordinate's model on the same mesh."""
+    from jax.sharding import Mesh as _Mesh
+
+    from photon_tpu.data.synthetic import make_game_dataset
+    from photon_tpu.game.coordinate import (
+        RandomEffectCoordinate,
+        RandomEffectCoordinateConfig,
+    )
+
+    data, _ = make_game_dataset(12, 10, 8, 6, seed=5)
+    cfg = ProblemConfig(optimizer="lbfgs",
+                        regularization=RegularizationContext("l2", 1.0),
+                        optimizer_config=OptimizerConfig(max_iterations=12))
+    mesh = _Mesh(np.asarray(jax.devices()), (DATA_AXIS,))
+    offsets = np.zeros(data.num_examples, np.float32)
+
+    base = RandomEffectCoordinate(
+        data,
+        RandomEffectCoordinateConfig("re0", "re0", cfg),
+        "logistic_regression",
+        mesh=mesh,
+    )
+    model_base, _ = base.train(offsets)
+
+    split = RandomEffectCoordinate(
+        data,
+        RandomEffectCoordinateConfig("re0", "re0", cfg, row_split=True),
+        "logistic_regression",
+        mesh=mesh,
+    )
+    model_split, stats = split.train(offsets)
+
+    np.testing.assert_array_equal(model_base.keys, model_split.keys)
+    np.testing.assert_allclose(
+        np.asarray(model_split.table), np.asarray(model_base.table),
+        rtol=2e-2, atol=2e-3,
+    )
+    assert stats["entities"] == 12
+
+
+def test_train_game_driver_row_split_spec(tmp_path):
+    """End-to-end: the row_split=true coordinate spec trains and scores."""
+    import os
+
+    from photon_tpu.drivers import train_game
+
+    summary = train_game.run(train_game.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", "synthetic-game:16:8:8:4:1:9",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
+        "--coordinate",
+        "pu:type=random,shard=re0,entity=re0,max_iters=6,row_split=true",
+        "--descent-iterations", "1",
+        "--validation-split", "0.25",
+        "--output-dir", str(tmp_path / "out"),
+    ]))
+    assert summary["best_metrics"]["AUC"] > 0.5
+    assert os.path.isdir(str(tmp_path / "out" / "best_model"))
