@@ -145,6 +145,15 @@ def _coordinate_specs(args) -> list[tuple[str, dict]]:
     return [parse_coordinate_spec(s) for s in args.coordinates]
 
 
+def _coord_bool(value) -> bool:
+    """Coordinate-spec boolean: accepts JSON true/false (the @file path
+    passes Python bools through) and the CLI strings true/1/yes (anything
+    else, including 'false'/'no'/'0', is False)."""
+    if isinstance(value, bool):
+        return value
+    return str(value).lower() in ("true", "1", "yes")
+
+
 def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
     """Build one coordinate's config with regularization weight ``lam``.
 
@@ -176,7 +185,7 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
         variance_computation=kv.get("variance", "none"),
     )
     if kv.get("type", "fixed") == "fixed":
-        if kv.get("row_split"):
+        if _coord_bool(kv.get("row_split", False)):
             raise ValueError(
                 "row_split applies to random coordinates only (the fixed "
                 "effect is already data-sharded with psum)"
@@ -195,7 +204,7 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
         )
     cap = kv.get("active_row_cap")
     if kv.get("type") == "factored_random":
-        if kv.get("row_split"):
+        if _coord_bool(kv.get("row_split", False)):
             raise ValueError(
                 "row_split is not supported for factored_random coordinates "
                 "(the pooled latent solve already spans the mesh)"
@@ -225,7 +234,7 @@ def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):
         projection=kv.get("projection", "none"),
         projected_dim=None if pdim in (None, "") else int(pdim),
         seed=int(kv.get("seed", 0)),
-        row_split=kv.get("row_split", "false").lower() in ("true", "1", "yes"),
+        row_split=_coord_bool(kv.get("row_split", False)),
     )
 
 
