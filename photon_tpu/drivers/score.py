@@ -28,6 +28,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--predict-mean", action="store_true",
                    help="write mean predictions (sigmoid/exp link) instead of "
                    "raw scores")
+    p.add_argument("--stream", action="store_true",
+                   help="score part files (LIBSVM or Avro) one at a time, "
+                   "dropping each chunk's features after scoring — for "
+                   "scoring sets beyond host memory")
     return p
 
 
@@ -61,35 +65,72 @@ def run(args: argparse.Namespace) -> dict:
         if args.evaluators else None
     )
 
-    with logger.timed("load-data"):
+    def load_chunk(spec: str):
         # Pad to the model's dimension: scoring files whose max feature id is
         # below the training dim are valid (load_validation handles this).
-        batch = common.load_validation(
-            args.input, model.coefficients.dim, intercept,
+        return common.load_validation(
+            spec, model.coefficients.dim, intercept,
             task=model.task_type,
             avro_field=getattr(args, "avro_feature_field", "features"),
             index_map=index_map,
         )
 
-    with logger.timed("score"):
-        raw_scores = np.asarray(model.compute_score(batch))
-        scores = (
-            np.asarray(model.loss.mean(raw_scores)) if args.predict_mean
-            else raw_scores
-        )
-    np.savetxt(os.path.join(args.output_dir, "scores.txt"), scores, fmt="%.8g")
+    def score_chunk(batch):
+        raw = np.asarray(model.compute_score(batch))
+        out = np.asarray(model.loss.mean(raw)) if args.predict_mean else raw
+        return raw, out
+
+    scores_path = os.path.join(args.output_dir, "scores.txt")
+    if args.stream:
+        from photon_tpu.data.game_io import NoRecordsError, _input_files
+
+        # File-at-a-time: features dropped per chunk; only (score, label,
+        # weight) survive when evaluators need a final pass (the scoring
+        # analog of train --stream; SURVEY.md §7 '1B-row ingestion').
+        raw_chunks, label_chunks, weight_chunks = [], [], []
+        n = 0
+        with open(scores_path, "w") as out_f:
+            for path in _input_files(args.input):
+                with logger.timed(f"score-{os.path.basename(path)}"):
+                    try:
+                        batch = load_chunk(path)
+                    except NoRecordsError:
+                        # Part layouts routinely contain empty parts; only a
+                        # zero-row TOTAL errors (below), as in score_game.
+                        logger.info("skipping empty part %s", path)
+                        continue
+                    raw, out = score_chunk(batch)
+                    np.savetxt(out_f, out, fmt="%.8g")
+                    if evaluators is not None:
+                        raw_chunks.append(raw)
+                        label_chunks.append(np.asarray(batch.label))
+                        weight_chunks.append(np.asarray(batch.weight))
+                    n += len(out)
+                    del batch, raw, out
+        if n == 0:
+            raise ValueError(f"no rows in {args.input!r}")
+        raw_scores = labels = weights = None
+        if evaluators is not None:
+            raw_scores = np.concatenate(raw_chunks)
+            labels = np.concatenate(label_chunks)
+            weights = np.concatenate(weight_chunks)
+    else:
+        with logger.timed("load-data"):
+            batch = load_chunk(args.input)
+        with logger.timed("score"):
+            raw_scores, scores = score_chunk(batch)
+        np.savetxt(scores_path, scores, fmt="%.8g")
+        n = int(scores.shape[0])
+        labels = np.asarray(batch.label)
+        weights = np.asarray(batch.weight)
 
     metrics = {}
     if evaluators is not None:
-        metrics = evaluators.evaluate(
-            raw_scores,
-            np.asarray(batch.label),
-            np.asarray(batch.weight),
-        )
+        metrics = evaluators.evaluate(raw_scores, labels, weights)
         logger.info("metrics %s", metrics)
         with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
             json.dump(metrics, f, indent=1)
-    return {"num_scored": int(scores.shape[0]), "metrics": metrics}
+    return {"num_scored": n, "metrics": metrics, "streamed": bool(args.stream)}
 
 
 def main(argv=None) -> None:

@@ -162,3 +162,42 @@ def test_a1a_fixture_anchor(tmp_path):
     ]))
     auc = summary["sweep"][0]["metrics"]["AUC"]
     assert 0.80 < auc < 0.87, f"a1a fixture AUC anchor moved: {auc}"
+
+
+def test_score_stream_matches_whole(libsvm_files, tmp_path):
+    """score --stream over part files == whole-set scoring, exactly."""
+    train_p, val_p = libsvm_files
+    out = str(tmp_path / "model")
+    train_driver.run(train_driver.build_parser().parse_args([
+        "--input", train_p, "--task", "logistic_regression",
+        "--reg-weights", "1.0", "--max-iterations", "30",
+        "--output-dir", out, "--backend", "cpu",
+    ]))
+
+    # Split the validation file into 3 uneven parts.
+    lines = open(val_p).read().splitlines(keepends=True)
+    parts = tmp_path / "parts"
+    parts.mkdir()
+    cuts = [0, 13, 60, len(lines)]
+    for pi in range(3):
+        with open(parts / f"part-{pi}.libsvm", "w") as f:
+            f.writelines(lines[cuts[pi]:cuts[pi + 1]])
+
+    common_args = [
+        "--model", os.path.join(out, "best_model.avro"),
+        "--backend", "cpu",
+        "--evaluators", "AUC",
+    ]
+    whole = score_driver.run(score_driver.build_parser().parse_args(
+        common_args + ["--input", val_p,
+                       "--output-dir", str(tmp_path / "w")]))
+    streamed = score_driver.run(score_driver.build_parser().parse_args(
+        common_args + ["--input", str(parts / "*.libsvm"), "--stream",
+                       "--output-dir", str(tmp_path / "s")]))
+    assert streamed["streamed"] and streamed["num_scored"] == whole["num_scored"]
+    sw = np.loadtxt(tmp_path / "w" / "scores.txt")
+    ss = np.loadtxt(tmp_path / "s" / "scores.txt")
+    np.testing.assert_array_equal(sw, ss)
+    assert streamed["metrics"]["AUC"] == pytest.approx(
+        whole["metrics"]["AUC"], rel=1e-9
+    )
