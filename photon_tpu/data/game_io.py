@@ -167,6 +167,13 @@ def read_game_avro(
     fixed-index scoring path — features absent from a map are DROPPED, and
     when an intercept is present every example keeps it.
     """
+    if os.path.isdir(path) and any(
+        f.endswith(".avro") for f in os.listdir(path)
+    ):
+        # Narrow a directory that qualifies as Avro input to its .avro part
+        # files — a stray README or _SUCCESS marker must not reach the
+        # decoder (same rule as drivers/common.load_dataset).
+        path = os.path.join(path, "*.avro")
     files = _input_files(path)
     build_maps = index_maps is None
 

@@ -110,6 +110,49 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
 from photon_tpu.core.losses import BINARY_TASKS  # noqa: E402  (single source)
 
 
+def stream_score_parts(input_spec, load_chunk, score_chunk, scores_path,
+                       logger, on_chunk=None) -> int:
+    """Shared file-at-a-time scoring skeleton for the ``--stream`` modes of
+    both scoring drivers (legacy ``score`` and ``score_game``): list the
+    part files FIRST (no spurious empty scores.txt on a bad glob), skip
+    empty parts via the typed :class:`~photon_tpu.data.game_io.
+    NoRecordsError`, write scores incrementally, drop each chunk's features
+    before the next file loads.  ``score_chunk(chunk) -> (raw, out, n)``;
+    ``on_chunk(chunk, raw)`` accumulates whatever the caller's evaluator
+    pass needs.  Returns the total row count (> 0, else NoRecordsError).
+    """
+    import numpy as np
+
+    from photon_tpu.data.game_io import NoRecordsError, _input_files
+
+    spec = input_spec
+    if os.path.isdir(spec) and any(
+        f.endswith(".avro") for f in os.listdir(spec)
+    ):
+        spec = os.path.join(spec, "*.avro")  # strays must not reach decoders
+    files = _input_files(spec)
+    n = 0
+    with open(scores_path, "w") as out_f:
+        for path in files:
+            with logger.timed(f"score-{os.path.basename(path)}"):
+                try:
+                    chunk = load_chunk(path)
+                except NoRecordsError:
+                    # Part layouts routinely contain empty parts; only a
+                    # zero-row TOTAL is an error (below).
+                    logger.info("skipping empty part %s", path)
+                    continue
+                raw, out, real_n = score_chunk(chunk)
+                np.savetxt(out_f, out, fmt="%.8g")
+                if on_chunk is not None:
+                    on_chunk(chunk, raw)
+                n += real_n
+                del chunk, raw, out
+    if n == 0:
+        raise NoRecordsError(f"no rows in {input_spec!r}")
+    return n
+
+
 def _is_avro_input(spec: str) -> bool:
     if spec.endswith(".avro"):
         return True

@@ -82,33 +82,22 @@ def run(args: argparse.Namespace) -> dict:
 
     scores_path = os.path.join(args.output_dir, "scores.txt")
     if args.stream:
-        from photon_tpu.data.game_io import NoRecordsError, _input_files
-
         # File-at-a-time: features dropped per chunk; only (score, label,
         # weight) survive when evaluators need a final pass (the scoring
         # analog of train --stream; SURVEY.md §7 '1B-row ingestion').
         raw_chunks, label_chunks, weight_chunks = [], [], []
-        n = 0
-        with open(scores_path, "w") as out_f:
-            for path in _input_files(args.input):
-                with logger.timed(f"score-{os.path.basename(path)}"):
-                    try:
-                        batch = load_chunk(path)
-                    except NoRecordsError:
-                        # Part layouts routinely contain empty parts; only a
-                        # zero-row TOTAL errors (below), as in score_game.
-                        logger.info("skipping empty part %s", path)
-                        continue
-                    raw, out = score_chunk(batch)
-                    np.savetxt(out_f, out, fmt="%.8g")
-                    if evaluators is not None:
-                        raw_chunks.append(raw)
-                        label_chunks.append(np.asarray(batch.label))
-                        weight_chunks.append(np.asarray(batch.weight))
-                    n += len(out)
-                    del batch, raw, out
-        if n == 0:
-            raise ValueError(f"no rows in {args.input!r}")
+
+        def on_chunk(batch, raw):
+            if evaluators is not None:
+                raw_chunks.append(raw)
+                label_chunks.append(np.asarray(batch.label))
+                weight_chunks.append(np.asarray(batch.weight))
+
+        n = common.stream_score_parts(
+            args.input, load_chunk,
+            lambda b: (*score_chunk(b), b.num_examples),
+            scores_path, logger, on_chunk,
+        )
         raw_scores = labels = weights = None
         if evaluators is not None:
             raw_scores = np.concatenate(raw_chunks)

@@ -120,7 +120,7 @@ def _run_streaming(args, model, index_maps, logger) -> dict:
     import jax.numpy as jnp
 
     from photon_tpu.core.losses import get_loss
-    from photon_tpu.data.game_io import NoRecordsError, _input_files, read_game_avro
+    from photon_tpu.data.game_io import read_game_avro
     from photon_tpu.drivers.train_game import parse_bags_and_id_columns
 
     if args.input.startswith("synthetic-game:"):
@@ -129,40 +129,31 @@ def _run_streaming(args, model, index_maps, logger) -> dict:
 
     scores_chunks, label_chunks, weight_chunks = [], [], []
     ids_chunks = {c: [] for c in id_cols}
-    n = 0
-    scores_path = os.path.join(args.output_dir, "scores.txt")
-    with open(scores_path, "w") as out_f:
-        for path in _input_files(args.input):
-            with logger.timed(f"score-{os.path.basename(path)}"):
-                try:
-                    chunk, _ = read_game_avro(
-                        path, bags, id_cols, index_maps=index_maps
-                    )
-                except NoRecordsError:
-                    # Part-file layouts routinely contain empty parts; only
-                    # a zero-record TOTAL is an error (checked below).
-                    logger.info("skipping empty part %s", path)
-                    continue
-                padded, real_n = _pad_pow2_rows(chunk)
-                raw = model.score(padded)[:real_n]
-                out = raw
-                if args.predict_mean:
-                    out = np.asarray(
-                        get_loss(model.task_type).mean(jnp.asarray(raw))
-                    )
-                np.savetxt(out_f, out, fmt="%.8g")
-                if args.evaluators:
-                    scores_chunks.append(np.asarray(raw))
-                    label_chunks.append(chunk.label)
-                    weight_chunks.append(chunk.weight)
-                    for c in id_cols:
-                        ids_chunks[c].append(chunk.id_columns[c])
-                n += real_n
-                # Drop this chunk's feature arrays BEFORE the next file
-                # loads: peak host memory stays one chunk, not two.
-                del chunk, padded, raw, out
-    if n == 0:
-        raise NoRecordsError(f"no records in {args.input!r}")
+
+    def load_chunk(path):
+        chunk, _ = read_game_avro(path, bags, id_cols, index_maps=index_maps)
+        return chunk
+
+    def score_chunk(chunk):
+        padded, real_n = _pad_pow2_rows(chunk)
+        raw = model.score(padded)[:real_n]
+        out = raw
+        if args.predict_mean:
+            out = np.asarray(get_loss(model.task_type).mean(jnp.asarray(raw)))
+        return raw, out, real_n
+
+    def on_chunk(chunk, raw):
+        if args.evaluators:
+            scores_chunks.append(np.asarray(raw))
+            label_chunks.append(chunk.label)
+            weight_chunks.append(chunk.weight)
+            for c in id_cols:
+                ids_chunks[c].append(chunk.id_columns[c])
+
+    n = common.stream_score_parts(
+        args.input, load_chunk, score_chunk,
+        os.path.join(args.output_dir, "scores.txt"), logger, on_chunk,
+    )
 
     metrics = {}
     if args.evaluators:

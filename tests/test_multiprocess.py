@@ -75,6 +75,18 @@ with open(out_path, "w") as f:
 """
 
 
+def _worker_env() -> dict:
+    """Worker subprocess environment: strip the parent's XLA_/JAX_ device
+    forcing (each worker sets its own) but keep the shared compilation
+    cache so workers load, not recompile."""
+    return {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("XLA_", "JAX_"))
+        or k.startswith("JAX_PERSISTENT_CACHE")
+        or k == "JAX_COMPILATION_CACHE_DIR"
+    }
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -88,12 +100,7 @@ def test_two_process_objective_matches_single(tmp_path):
     worker.write_text(WORKER)
     coordinator = f"127.0.0.1:{_free_port()}"
     outs = [str(tmp_path / f"out{i}.json") for i in range(2)]
-    env = {
-        k: v for k, v in os.environ.items()
-        if not k.startswith(("XLA_", "JAX_"))
-        or k.startswith("JAX_PERSISTENT_CACHE")
-        or k == "JAX_COMPILATION_CACHE_DIR"
-    }
+    env = _worker_env()
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), REPO, coordinator, str(i), outs[i]],
@@ -202,12 +209,7 @@ def test_two_process_streaming_driver_matches_single(tmp_path):
     worker = tmp_path / "stream_worker.py"
     worker.write_text(STREAM_WORKER)
     coordinator = f"127.0.0.1:{_free_port()}"
-    env = {
-        k: v for k, v in os.environ.items()
-        if not k.startswith(("XLA_", "JAX_"))
-        or k.startswith("JAX_PERSISTENT_CACHE")
-        or k == "JAX_COMPILATION_CACHE_DIR"
-    }
+    env = _worker_env()
     outs = [str(tmp_path / f"mp{i}") for i in range(2)]
     procs = [
         subprocess.Popen(
@@ -283,12 +285,7 @@ def test_two_process_game_driver_matches_single(tmp_path):
     worker = tmp_path / "game_worker.py"
     worker.write_text(GAME_WORKER)
     coordinator = f"127.0.0.1:{_free_port()}"
-    env = {
-        k: v for k, v in os.environ.items()
-        if not k.startswith(("XLA_", "JAX_"))
-        or k.startswith("JAX_PERSISTENT_CACHE")
-        or k == "JAX_COMPILATION_CACHE_DIR"
-    }
+    env = _worker_env()
     outs = [str(tmp_path / f"mp{i}") for i in range(2)]
     procs = [
         subprocess.Popen(
@@ -312,3 +309,118 @@ def test_two_process_game_driver_matches_single(tmp_path):
         assert mp_metrics[name] == pytest.approx(value, rel=2e-3), (
             name, mp_metrics[name], value
         )
+
+
+ROW_SPLIT_WORKER = r"""
+import json, os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+sys.path.insert(0, sys.argv[1])
+coordinator, pid, out_path = sys.argv[2], int(sys.argv[3]), sys.argv[4]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=coordinator, num_processes=2, process_id=pid
+)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.problem import ProblemConfig
+from photon_tpu.data.batch import SparseBatch
+from photon_tpu.parallel.distributed import solve_entities_row_split
+
+# Deterministic per-entity data; THIS process holds rows [pid*R/2, (pid+1)*R/2)
+# of EVERY entity — the row-split multi-host placement (no shuffle).
+E, R, k, d = 5, 16, 3, 10
+rng = np.random.default_rng(0)
+ids = rng.integers(1, d, (E, R, k)).astype(np.int32)
+vals = rng.standard_normal((E, R, k)).astype(np.float32)
+label = (rng.random((E, R)) < 0.5).astype(np.float32)
+weight = rng.uniform(0.5, 2.0, (E, R)).astype(np.float32)
+lo, hi = pid * R // 2, (pid + 1) * R // 2
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+def row_sharded(a):
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(None, "data", *([None] * (a.ndim - 2)))),
+        a[:, lo:hi],
+    )
+batch = SparseBatch(
+    row_sharded(ids), row_sharded(vals), row_sharded(label),
+    row_sharded(np.zeros((E, R), np.float32)), row_sharded(weight),
+)
+reg = RegularizationContext("l2", 0.8)
+cfg = ProblemConfig(optimizer="lbfgs", regularization=reg,
+                    optimizer_config=OptimizerConfig(max_iterations=12))
+obj = GlmObjective.create("logistic", reg)
+coeffs, res = solve_entities_row_split(
+    obj, cfg, batch, jnp.zeros((E, d), jnp.float32), mesh
+)
+from photon_tpu.parallel.mesh import to_host
+with open(out_path, "w") as f:
+    json.dump({"means": to_host(coeffs.means).tolist(),
+               "value": to_host(res.value).tolist()}, f)
+"""
+
+
+def test_two_process_row_split_matches_single(tmp_path):
+    """Row-split entity solves across 2 REAL processes (each holding half of
+    every entity's rows) must match a single-process co-located solve — the
+    multi-host shuffle-free random-effect path end-to-end."""
+    worker = tmp_path / "row_split_worker.py"
+    worker.write_text(ROW_SPLIT_WORKER)
+    coordinator = f"127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"out{i}.json") for i in range(2)]
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), REPO, coordinator, str(i), outs[i]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("row-split worker timed out (distributed hang)")
+        assert p.returncode == 0, f"row-split worker failed:\n{err[-2000:]}"
+    results = [json.load(open(o)) for o in outs]
+    np.testing.assert_allclose(results[0]["means"], results[1]["means"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["value"], results[1]["value"],
+                               rtol=1e-6)
+
+    # Single-process co-located reference on the same data.
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.core.objective import GlmObjective, RegularizationContext
+    from photon_tpu.core.optimizers import OptimizerConfig
+    from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
+    from photon_tpu.data.batch import SparseBatch
+
+    E, R, k, d = 5, 16, 3, 10
+    rng = np.random.default_rng(0)
+    batch = SparseBatch(
+        jnp.asarray(rng.integers(1, d, (E, R, k)).astype(np.int32)),
+        jnp.asarray(rng.standard_normal((E, R, k)).astype(np.float32)),
+        jnp.asarray((rng.random((E, R)) < 0.5).astype(np.float32)),
+        jnp.zeros((E, R), jnp.float32),
+        jnp.asarray(rng.uniform(0.5, 2.0, (E, R)).astype(np.float32)),
+    )
+    reg = RegularizationContext("l2", 0.8)
+    cfg = ProblemConfig(optimizer="lbfgs", regularization=reg,
+                        optimizer_config=OptimizerConfig(max_iterations=12))
+    obj = GlmObjective.create("logistic", reg)
+    ref_coeffs, _ = GlmOptimizationProblem(obj, cfg).solver(vmapped=True)(
+        obj, batch, jnp.zeros((E, d), jnp.float32)
+    )
+    np.testing.assert_allclose(
+        results[0]["means"], np.asarray(ref_coeffs.means),
+        rtol=2e-2, atol=2e-3,
+    )
