@@ -80,20 +80,38 @@ def training_example_schema(
 
 
 def _input_files(path: str) -> list[str]:
-    """A file, a directory of part files, or a glob -> sorted file list."""
+    """A file, a directory of part files, or a glob -> sorted file list.
+
+    Both the directory and glob branches exclude non-files and dot-/
+    underscore-prefixed names (in-progress part files and committer markers
+    like ``_SUCCESS`` / ``_tmp-0.avro`` must never reach a decoder).
+    """
     if os.path.isdir(path):
-        files = sorted(
-            p
-            for p in _glob.glob(os.path.join(path, "*"))
-            if os.path.isfile(p) and not os.path.basename(p).startswith((".", "_"))
-        )
+        pattern = os.path.join(path, "*")
     elif os.path.isfile(path):
-        files = [path]
+        return [path]
     else:
-        files = sorted(_glob.glob(path))
+        pattern = path
+    files = sorted(
+        p
+        for p in _glob.glob(pattern)
+        if os.path.isfile(p) and not os.path.basename(p).startswith((".", "_"))
+    )
     if not files:
         raise FileNotFoundError(f"no input files match {path!r}")
     return files
+
+
+def narrow_avro_dir(spec: str) -> str:
+    """A directory qualifying as Avro input -> its ``*.avro`` glob, so stray
+    plain-named files (README, schema.json) never reach the decoder; any
+    other spec passes through.  The ONE copy of this rule (read_game_avro,
+    stream_score_parts, and load_dataset all route through it)."""
+    if os.path.isdir(spec) and any(
+        f.endswith(".avro") for f in os.listdir(spec)
+    ):
+        return os.path.join(spec, "*.avro")
+    return spec
 
 
 def write_game_avro(
@@ -167,14 +185,7 @@ def read_game_avro(
     fixed-index scoring path — features absent from a map are DROPPED, and
     when an intercept is present every example keeps it.
     """
-    if os.path.isdir(path) and any(
-        f.endswith(".avro") for f in os.listdir(path)
-    ):
-        # Narrow a directory that qualifies as Avro input to its .avro part
-        # files — a stray README or _SUCCESS marker must not reach the
-        # decoder (same rule as drivers/common.load_dataset).
-        path = os.path.join(path, "*.avro")
-    files = _input_files(path)
+    files = _input_files(narrow_avro_dir(path))
     build_maps = index_maps is None
 
     # ONE streaming pass: records are decoded lazily (avro_codec.

@@ -121,16 +121,13 @@ def stream_score_parts(input_spec, load_chunk, score_chunk, scores_path,
     ``on_chunk(chunk, raw)`` accumulates whatever the caller's evaluator
     pass needs.  Returns the total row count (> 0, else NoRecordsError).
     """
-    import numpy as np
+    from photon_tpu.data.game_io import (
+        NoRecordsError,
+        _input_files,
+        narrow_avro_dir,
+    )
 
-    from photon_tpu.data.game_io import NoRecordsError, _input_files
-
-    spec = input_spec
-    if os.path.isdir(spec) and any(
-        f.endswith(".avro") for f in os.listdir(spec)
-    ):
-        spec = os.path.join(spec, "*.avro")  # strays must not reach decoders
-    files = _input_files(spec)
+    files = _input_files(narrow_avro_dir(input_spec))
     n = 0
     with open(scores_path, "w") as out_f:
         for path in files:
@@ -186,10 +183,8 @@ def load_dataset(
         from photon_tpu.game.model import shard_to_batch
 
         maps = None if index_map is None else {"global": index_map}
-        if os.path.isdir(spec):
-            # The directory qualified as Avro because it holds .avro files;
-            # read only those (a stray README must not reach the decoder).
-            spec = os.path.join(spec, "*.avro")
+        # Directory narrowing to *.avro happens inside read_game_avro
+        # (game_io.narrow_avro_dir — the one copy of the rule).
         data, out_maps = read_game_avro(
             spec, {"global": avro_field}, [], index_maps=maps,
             intercept=intercept,

@@ -255,6 +255,8 @@ summary = train_game.run(train_game.build_parser().parse_args([
     "--input", "synthetic-game:32:4:8:4:1:7",
     "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
     "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=8",
+    "--coordinate",
+    "pu_rs:type=random,shard=re0,entity=re0,max_iters=8,row_split=true",
     "--descent-iterations", "1",
     "--validation-split", "0.25",
     "--output-dir", out_dir,
@@ -267,7 +269,8 @@ if pid == 0:
 
 def test_two_process_game_driver_matches_single(tmp_path):
     """Full GAME training over a 2-process global mesh: fixed effect
-    data-sharded with psum, random effects entity-sharded, rank-0-only
+    data-sharded with psum, random effects entity-sharded AND a row-split
+    coordinate (each process holds a row slice of every entity), rank-0-only
     writes — must reproduce the single-process metrics."""
     from photon_tpu.drivers import train_game
 
@@ -276,6 +279,8 @@ def test_two_process_game_driver_matches_single(tmp_path):
         "--input", "synthetic-game:32:4:8:4:1:7",
         "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
         "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=8",
+        "--coordinate",
+        "pu_rs:type=random,shard=re0,entity=re0,max_iters=8,row_split=true",
         "--descent-iterations", "1",
         "--validation-split", "0.25",
     ]
