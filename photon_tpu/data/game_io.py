@@ -102,14 +102,20 @@ def _input_files(path: str) -> list[str]:
     return files
 
 
+def is_avro_dir(spec: str) -> bool:
+    """True when ``spec`` is a directory holding ``.avro`` part files."""
+    return os.path.isdir(spec) and any(
+        f.endswith(".avro") for f in os.listdir(spec)
+    )
+
+
 def narrow_avro_dir(spec: str) -> str:
     """A directory qualifying as Avro input -> its ``*.avro`` glob, so stray
     plain-named files (README, schema.json) never reach the decoder; any
     other spec passes through.  The ONE copy of this rule (read_game_avro,
-    stream_score_parts, and load_dataset all route through it)."""
-    if os.path.isdir(spec) and any(
-        f.endswith(".avro") for f in os.listdir(spec)
-    ):
+    stream_score_parts, and load_dataset all route through it; the
+    qualification predicate :func:`is_avro_dir` is shared too)."""
+    if is_avro_dir(spec):
         return os.path.join(spec, "*.avro")
     return spec
 

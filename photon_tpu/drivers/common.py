@@ -153,9 +153,9 @@ def stream_score_parts(input_spec, load_chunk, score_chunk, scores_path,
 def _is_avro_input(spec: str) -> bool:
     if spec.endswith(".avro"):
         return True
-    if os.path.isdir(spec):
-        return any(f.endswith(".avro") for f in os.listdir(spec))
-    return False
+    from photon_tpu.data.game_io import is_avro_dir
+
+    return is_avro_dir(spec)
 
 
 def load_dataset(

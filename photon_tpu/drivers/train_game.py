@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="one per coordinate, in update order; or a single "
                    "@configs.json")
     p.add_argument("--descent-iterations", type=int, default=1)
+    p.add_argument("--dtype", default="float32",
+                   choices=("float32", "bfloat16"),
+                   help="storage dtype for FEATURE VALUES in every shard "
+                   "(labels, weights, coefficients, and all arithmetic stay "
+                   "float32); bfloat16 halves the value stream each "
+                   "coordinate's gathers read from HBM")
     p.add_argument("--evaluators", default=None,
                    help="comma-separated; sharded variants take the id "
                    "column, e.g. SHARDED_AUC:userId")
@@ -349,6 +355,14 @@ def run(args: argparse.Namespace) -> dict:
             )
         elif args.validation_split:
             data, val_data = split_game_dataset(data, args.validation_split)
+        if args.dtype != "float32":
+            from photon_tpu.game.data import dataset_astype
+
+            # Training data only: validation stays f32 (scoring promotes
+            # anyway; metrics must not depend on the storage option).
+            data = dataset_astype(data, args.dtype)
+            logger.info("feature values stored as %s (f32 arithmetic)",
+                        args.dtype)
         logger.info(
             "train: %d examples, shards %s", data.num_examples,
             {n: s.dim for n, s in data.shards.items()},

@@ -81,3 +81,28 @@ def test_driver_dtype_flag(tmp_path):
         ]))
         outs[dtype] = summary["sweep"][0]["final_value"]
     np.testing.assert_allclose(outs["bfloat16"], outs["float32"], rtol=2e-2)
+
+
+def test_game_driver_dtype_flag(tmp_path):
+    """train_game --dtype bfloat16 trains end-to-end near the f32 metrics
+    (validation stays f32, so AUC differences are model-quality only)."""
+    import os
+
+    from photon_tpu.drivers import train_game
+
+    aucs = {}
+    for dtype in ("float32", "bfloat16"):
+        out = tmp_path / dtype
+        summary = train_game.run(train_game.build_parser().parse_args([
+            "--backend", "cpu",
+            "--input", "synthetic-game:24:8:8:4:1:4",
+            "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
+            "--coordinate", "pu:type=random,shard=re0,entity=re0,max_iters=6",
+            "--descent-iterations", "1",
+            "--validation-split", "0.25",
+            "--dtype", dtype,
+            "--output-dir", str(out),
+        ]))
+        aucs[dtype] = summary["best_metrics"]["AUC"]
+        assert os.path.isdir(str(out / "best_model"))
+    assert abs(aucs["bfloat16"] - aucs["float32"]) < 0.05, aucs
