@@ -59,6 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--variance-computation", default="none",
                    choices=("none", "simple", "full"))
     p.add_argument("--model-format", default="avro", choices=("avro", "json"))
+    p.add_argument("--sweep-warm-start", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="start each regularization weight's fit from the "
+                   "previous weight's solution (the regularization-path "
+                   "trick; the reference's warm-start option). "
+                   "--no-sweep-warm-start makes every lambda start cold")
     p.add_argument("--save-all-models", action="store_true",
                    help="write every sweep model, not just the best")
     p.add_argument("--stream", action="store_true",
@@ -194,6 +200,7 @@ def _run_streaming(args: argparse.Namespace) -> dict:
             return multihost_utils.process_allgather(x).sum(axis=0)
 
     sweep = []
+    w_start = jnp.zeros(source.dim, jnp.float32)
     for lam in common.parse_weights_list(args.reg_weights):
         reg = RegularizationContext(args.reg_type, lam, args.elastic_net_alpha)
         objective = StreamingObjective(
@@ -202,11 +209,11 @@ def _run_streaming(args: argparse.Namespace) -> dict:
         )
         with logger.timed(f"train-lambda-{lam}"):
             t0 = time.monotonic()
-            result = streaming_lbfgs(
-                objective, jnp.zeros(source.dim, jnp.float32), opt_config
-            )
+            result = streaming_lbfgs(objective, w_start, opt_config)
             jax.block_until_ready(result.w)
             wall = time.monotonic() - t0
+        if args.sweep_warm_start:
+            w_start = result.w
         tracker = OptimizationStatesTracker(result, wall)
         logger.info("lambda=%g %s", lam, tracker.summary().splitlines()[0])
         model = model_for_task(args.task, Coefficients(result.w))
@@ -353,6 +360,10 @@ def run(args: argparse.Namespace) -> dict:
             coefficients, result = problem.run(batch, w_start)
             jax.block_until_ready(coefficients.means)
             wall = time.monotonic() - t0
+        if args.sweep_warm_start:
+            # Next lambda starts from this optimum (normalized space — the
+            # original-space conversion below works on copies).
+            w_start = coefficients.means
         tracker = OptimizationStatesTracker(result, wall)
         logger.info("lambda=%g %s", lam, tracker.summary().splitlines()[0])
 

@@ -201,3 +201,22 @@ def test_score_stream_matches_whole(libsvm_files, tmp_path):
     assert streamed["metrics"]["AUC"] == pytest.approx(
         whole["metrics"]["AUC"], rel=1e-9
     )
+
+
+def test_sweep_warm_start_reduces_iterations(libsvm_files, tmp_path):
+    """The regularization path warm start must land on the same optima with
+    fewer total iterations than cold starts."""
+    train_p, _ = libsvm_files
+    totals, finals = {}, {}
+    for mode, flag in (("warm", "--sweep-warm-start"),
+                       ("cold", "--no-sweep-warm-start")):
+        out = str(tmp_path / mode)
+        summary = train_driver.run(train_driver.build_parser().parse_args([
+            "--input", train_p, "--task", "logistic_regression",
+            "--reg-weights", "10,3,1,0.3", "--max-iterations", "200",
+            flag, "--output-dir", out, "--backend", "cpu",
+        ]))
+        totals[mode] = sum(e["iterations"] for e in summary["sweep"])
+        finals[mode] = [e["final_value"] for e in summary["sweep"]]
+    np.testing.assert_allclose(finals["warm"], finals["cold"], rtol=1e-4)
+    assert totals["warm"] < totals["cold"], totals
