@@ -79,16 +79,20 @@ def test_row_split_matches_colocated(optimizer, reg_type):
     )
 
 
-def test_row_split_variance_matches():
-    """SIMPLE variance (1/diag(H)) must psum the diagonal exactly."""
-    batches = _entity_batches(seed=3)
+@pytest.mark.parametrize("variance,n_entities,seed,rtol", [
+    ("simple", 6, 3, 5e-4),   # 1/diag(H): psum-ed Hessian diagonal
+    ("full", 4, 7, 2e-3),     # diag(H^-1): psum-ed dense Hessian, Cholesky
+])
+def test_row_split_variance_matches(variance, n_entities, seed, rtol):
+    """Variance computation under row-split must match co-located solves."""
+    batches = _entity_batches(n_entities=n_entities, seed=seed)
     d = 16
     reg = RegularizationContext("l2", 1.0)
     cfg = ProblemConfig(optimizer="lbfgs", regularization=reg,
                         optimizer_config=OptimizerConfig(max_iterations=12),
-                        variance_computation="simple")
+                        variance_computation=variance)
     obj = GlmObjective.create("logistic", reg)
-    w0s = jnp.zeros((batches.ids.shape[0], d), jnp.float32)
+    w0s = jnp.zeros((n_entities, d), jnp.float32)
     ref_coeffs, _ = GlmOptimizationProblem(obj, cfg).solver(vmapped=True)(
         obj, batches, w0s
     )
@@ -96,7 +100,7 @@ def test_row_split_variance_matches():
     split_coeffs, _ = solve_entities_row_split(obj, cfg, batches, w0s, mesh)
     np.testing.assert_allclose(
         np.asarray(split_coeffs.variances), np.asarray(ref_coeffs.variances),
-        rtol=5e-4, atol=1e-6,
+        rtol=rtol, atol=1e-6,
     )
 
 
