@@ -99,17 +99,40 @@ SQUARED = PointwiseLoss(
 )
 
 
+# float32 exp overflows to inf at z ~ 88 (f64 at ~709, so the reference
+# tolerates margins ours cannot) — and Hessian terms ACCUMULATE d2 = e^z
+# across rows, so the cap must leave headroom for row sums too: e^30 ~ 1e13
+# is astronomically above any real Poisson rate yet ~25 orders below f32
+# max.  The clamped exp is a custom_jvp whose derivative is ITSELF, so
+# every autodiff order agrees with the analytic d1/d2 (a plain
+# exp(minimum(z, cap)) would autodiff to slope 0 past the cap, giving the
+# value a spurious -y gradient that points optimizers TOWARD +inf margins).
+_POISSON_MAX_EXPONENT = 30.0
+
+
+@jax.custom_jvp
+def _poisson_exp(z: Array) -> Array:
+    return jnp.exp(jnp.minimum(z, _POISSON_MAX_EXPONENT))
+
+
+@_poisson_exp.defjvp
+def _poisson_exp_jvp(primals, tangents):
+    (z,), (dz,) = primals, tangents
+    ez = _poisson_exp(z)
+    return ez, ez * dz
+
+
 def _poisson_value(z: Array, y: Array) -> Array:
     # Negative log-likelihood up to a label-only constant: e^z - y*z.
-    return jnp.exp(z) - y * z
+    return _poisson_exp(z) - y * z
 
 
 POISSON = PointwiseLoss(
     name="poisson",
     value=_poisson_value,
-    d1=lambda z, y: jnp.exp(z) - y,
-    d2=lambda z, y: jnp.exp(z),
-    mean=jnp.exp,
+    d1=lambda z, y: _poisson_exp(z) - y,
+    d2=lambda z, y: _poisson_exp(z),
+    mean=_poisson_exp,
 )
 
 

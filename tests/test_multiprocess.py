@@ -253,10 +253,10 @@ summary = train_game.run(train_game.build_parser().parse_args([
     "--coordinator", coordinator, "--process-id", str(pid),
     "--num-processes", "2",
     "--input", "synthetic-game:32:4:8:4:1:7",
-    "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
-    "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=8",
+    "--coordinate", "fixed:type=fixed,shard=global,max_iters=6",
+    "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=5",
     "--coordinate",
-    "pu_rs:type=random,shard=re0,entity=re0,max_iters=8,row_split=true",
+    "pu_rs:type=random,shard=re0,entity=re0,max_iters=5,row_split=true",
     "--descent-iterations", "1",
     "--validation-split", "0.25",
     "--output-dir", out_dir,
@@ -277,10 +277,10 @@ def test_two_process_game_driver_matches_single(tmp_path):
     argv = [
         "--backend", "cpu",
         "--input", "synthetic-game:32:4:8:4:1:7",
-        "--coordinate", "fixed:type=fixed,shard=global,max_iters=10",
-        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=8",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=6",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=5",
         "--coordinate",
-        "pu_rs:type=random,shard=re0,entity=re0,max_iters=8,row_split=true",
+        "pu_rs:type=random,shard=re0,entity=re0,max_iters=5,row_split=true",
         "--descent-iterations", "1",
         "--validation-split", "0.25",
     ]
