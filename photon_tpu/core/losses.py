@@ -100,38 +100,51 @@ SQUARED = PointwiseLoss(
 
 
 # float32 exp overflows to inf at z ~ 88 (f64 at ~709, so the reference
-# tolerates margins ours cannot) — and Hessian terms ACCUMULATE d2 = e^z
-# across rows, so the cap must leave headroom for row sums too: e^30 ~ 1e13
-# is astronomically above any real Poisson rate yet ~25 orders below f32
-# max.  The clamped exp is a custom_jvp whose derivative is ITSELF, so
-# every autodiff order agrees with the analytic d1/d2 (a plain
-# exp(minimum(z, cap)) would autodiff to slope 0 past the cap, giving the
-# value a spurious -y gradient that points optimizers TOWARD +inf margins).
+# tolerates margins ours cannot) — and objective/Hessian terms ACCUMULATE
+# e^z across rows, so the cap must leave headroom for row sums too:
+# e^30 ~ 1e13 is astronomically above any real Poisson rate yet ~25 orders
+# below f32 max.  Beyond the cap the NLL continues LINEARLY at the
+# clamped-exp slope, and d1/d2 are the EXACT first/second derivatives of
+# that linearized objective (d2 = 0 past the cap): a flat value — or a d2
+# claiming e^cap curvature the value no longer has — would make Armijo
+# trials or TRON's accept/reject model mispredict and stall in exactly the
+# diverging region the optimizer must escape from.  Autodiff matches the
+# analytic derivatives everywhere except the measure-zero cap point itself
+# (min/max tie gradients average the one-sided slopes there); for any sane
+# fit (rate <= e^30) all of this is byte-identical to the plain exp.
 _POISSON_MAX_EXPONENT = 30.0
 
 
-@jax.custom_jvp
 def _poisson_exp(z: Array) -> Array:
+    """Clamped rate e^min(z, cap) — slope of the linearized NLL (d1 + y)
+    and the prediction mean."""
     return jnp.exp(jnp.minimum(z, _POISSON_MAX_EXPONENT))
 
 
-@_poisson_exp.defjvp
-def _poisson_exp_jvp(primals, tangents):
-    (z,), (dz,) = primals, tangents
+def _poisson_exp_linearized(z: Array) -> Array:
+    """exp below the cap, linear continuation above (same value and slope
+    at the junction), so the objective stays finite AND strictly
+    increasing in z at the clamped-exp rate."""
     ez = _poisson_exp(z)
-    return ez, ez * dz
+    return ez + ez * jnp.maximum(z - _POISSON_MAX_EXPONENT, 0.0)
 
 
 def _poisson_value(z: Array, y: Array) -> Array:
     # Negative log-likelihood up to a label-only constant: e^z - y*z.
-    return _poisson_exp(z) - y * z
+    return _poisson_exp_linearized(z) - y * z
+
+
+def _poisson_d2(z: Array, y: Array) -> Array:
+    # Exact second derivative of the linearized NLL: 0 past the cap.
+    del y
+    return jnp.where(z <= _POISSON_MAX_EXPONENT, _poisson_exp(z), 0.0)
 
 
 POISSON = PointwiseLoss(
     name="poisson",
     value=_poisson_value,
     d1=lambda z, y: _poisson_exp(z) - y,
-    d2=lambda z, y: _poisson_exp(z),
+    d2=_poisson_d2,
     mean=_poisson_exp,
 )
 

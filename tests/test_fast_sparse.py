@@ -222,3 +222,36 @@ def test_distributed_fast_path_under_normalization():
     v, g = dist.value_and_grad(w, shard_batch(batch, mesh))
     np.testing.assert_allclose(v, v_ref, rtol=1e-5)
     np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_fast_path_matches_autodiff_across_random_configs():
+    """Property-style sweep over (n, k, d) configs — incl. degenerate k=1,
+    tiny d, n=1 — with round-robin losses, random l2, and a multi-block
+    feature-major layout (shards=2) whenever n is even: the fm fast path
+    must agree with the autodiff reference at several random points."""
+    rng = np.random.default_rng(2024)
+    configs = [(1, 1, 2), (3, 1, 2), (2, 5, 3), (17, 3, 9)] + [
+        (int(rng.integers(2, 200)), int(rng.integers(1, 9)),
+         int(rng.integers(2, 64)))
+        for _ in range(6)
+    ]
+    for i, (n, k, d) in enumerate(configs):
+        loss = ("logistic", "squared", "poisson")[i % 3]
+        l2 = float(rng.uniform(0, 2))
+        batch = _random_batch(n, k, d, seed=i, zipf=bool(i % 2))
+        fast = attach_feature_major(batch, shards=2 if n % 2 == 0 else 1)
+        obj = GlmObjective.create(loss, RegularizationContext("l2", l2))
+        for trial in range(2):
+            w = jnp.asarray(
+                rng.standard_normal(d).astype(np.float32) * 0.5
+            )
+            v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+            v_fm, g_fm = obj.value_and_grad(w, fast)
+            np.testing.assert_allclose(
+                float(v_fm), float(v_ref), rtol=2e-5,
+                err_msg=f"cfg {n},{k},{d} {loss} l2={l2}",
+            )
+            np.testing.assert_allclose(
+                np.asarray(g_fm), np.asarray(g_ref), rtol=2e-4, atol=2e-5,
+                err_msg=f"cfg {n},{k},{d} {loss} l2={l2}",
+            )
