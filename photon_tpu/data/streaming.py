@@ -45,6 +45,11 @@ from photon_tpu.core.optimizers.base import (
 from photon_tpu.core.optimizers.lbfgs import _two_loop_direction
 from photon_tpu.data.batch import SparseBatch
 
+# Module-level jit: a per-call `jax.jit(...)` wrapper would carry a fresh
+# trace cache, re-tracing the two-loop recursion for every lambda in a
+# streamed sweep (same discipline as core/problem.cached_solver).
+_jitted_direction = jax.jit(_two_loop_direction, static_argnames=("m",))
+
 Array = jax.Array
 
 
@@ -315,8 +320,7 @@ def streaming_lbfgs(
     m = config.history_length
     d = w0.shape[0]
     dtype = w0.dtype
-
-    direction = jax.jit(_two_loop_direction, static_argnames=("m",))
+    direction = _jitted_direction
 
     w = w0
     f, g = objective.value_and_grad(w)
