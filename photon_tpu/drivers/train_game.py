@@ -153,11 +153,20 @@ def _coordinate_specs(args) -> list[tuple[str, dict]]:
 
 def _coord_bool(value) -> bool:
     """Coordinate-spec boolean: accepts JSON true/false (the @file path
-    passes Python bools through) and the CLI strings true/1/yes (anything
-    else, including 'false'/'no'/'0', is False)."""
+    passes Python bools through) and the CLI strings true/1/yes /
+    false/0/no.  Anything else raises — a typo like ``row_split=ture``
+    silently disabling a feature is exactly the spec-validation failure
+    mode the other keys reject (ADVICE r3)."""
     if isinstance(value, bool):
         return value
-    return str(value).lower() in ("true", "1", "yes")
+    s = str(value).strip().lower()
+    if s in ("true", "1", "yes"):
+        return True
+    if s in ("false", "0", "no"):
+        return False
+    raise ValueError(
+        f"coordinate-spec boolean must be true/false/1/0/yes/no, got {value!r}"
+    )
 
 
 def _coord_config(kv: dict, lam: float, task: str = "logistic_regression"):

@@ -44,6 +44,8 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import tree_util
+from jax.experimental import pallas as pl
 
 Array = jax.Array
 
@@ -268,6 +270,185 @@ def gather_products(w: Array, layout: AlignedLayout, interpret: bool = False) ->
         jnp.asarray(layout.vals),
         interpret=interpret,
     )
+
+
+@dataclasses.dataclass(frozen=True)
+class AlignedLayoutDev:
+    """Device-resident :class:`AlignedLayout` plus the gradient-reduction
+    statics, registered as a jit pytree (all arrays are dynamic leaves —
+    shapes are static per dataset, so one compiled program serves every
+    optimizer iteration).
+
+    ``grad_perm`` / ``sorted_feats`` are the host-precomputed epilogue of the
+    aligned GRADIENT path (see :func:`aligned_segment_grad`): a stable
+    argsort of ``dup_map`` so the per-dictionary-slot partial sums (one per
+    (slab, position, lane) — ``n_slabs * 1024`` values, far fewer than the
+    entry count) reduce into coefficients with a tiny
+    ``segment_sum(indices_are_sorted=True)`` — no unsorted scatter anywhere.
+    """
+
+    lo: Array  # [total_sub, 128] int32
+    vals: Array  # [total_sub, 128] float (storage dtype; f32 arithmetic)
+    rows: Array  # [total_sub, 128] int32
+    slab_of_tile: Array  # [n_tiles] int32, non-decreasing
+    dup_map: Array  # [n_slabs * 1024] int32
+    grad_perm: Array  # [n_slabs * 1024] int32 — stable argsort of dup_map
+    sorted_feats: Array  # [n_slabs * 1024] int32 — dup_map[grad_perm]
+
+    @property
+    def n_slabs(self) -> int:
+        return int(self.dup_map.shape[0]) // SLAB_POSITIONS
+
+
+tree_util.register_dataclass(
+    AlignedLayoutDev,
+    data_fields=(
+        "lo", "vals", "rows", "slab_of_tile", "dup_map", "grad_perm",
+        "sorted_feats",
+    ),
+    meta_fields=(),
+)
+
+
+def device_layout(layout: AlignedLayout) -> AlignedLayoutDev:
+    """Put an :class:`AlignedLayout` on device with the gradient statics."""
+    perm = np.argsort(layout.dup_map, kind="stable").astype(np.int32)
+    return AlignedLayoutDev(
+        lo=jnp.asarray(layout.lo),
+        vals=jnp.asarray(layout.vals),
+        rows=jnp.asarray(layout.rows),
+        slab_of_tile=jnp.asarray(layout.slab_of_tile),
+        dup_map=jnp.asarray(layout.dup_map),
+        grad_perm=jnp.asarray(perm),
+        sorted_feats=jnp.asarray(layout.dup_map[perm]),
+    )
+
+
+def _position_reduce_kernel(smap_ref, pv_ref, lo_ref, o_ref):
+    """One tile: fold per-entry products into the slab's [8, 128] partial
+    sums — ``o[p, lane] += sum_sublane where(lo == p, products)``.
+
+    Tiles of one slab are consecutive in the grid (``slab_of_tile`` is
+    non-decreasing by construction), so the output block is revisited and
+    accumulates across them; it is zeroed on the first tile of each slab.
+    """
+    i = pl.program_id(0)
+    prev = smap_ref[jnp.maximum(i - 1, 0)]
+
+    @pl.when(jnp.logical_or(i == 0, smap_ref[i] != prev))
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    pv = pv_ref[...]  # [TILE_SUBLANES, 128] per-entry products
+    lo = lo_ref[...]  # [TILE_SUBLANES, 128] slab positions
+    for p in range(SUBLANES):
+        contrib = jnp.sum(
+            jnp.where(lo == p, pv, 0.0), axis=0, keepdims=True
+        )  # [1, 128]
+        o_ref[p : p + 1, :] += contrib
+
+
+@functools.partial(jax.jit, static_argnames=("n_slabs", "interpret"))
+def _position_partial_sums(
+    slab_of_tile: Array, pv: Array, lo: Array, n_slabs: int, interpret: bool
+) -> Array:
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_tiles = slab_of_tile.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((TILE_SUBLANES, LANES), lambda i, smap: (i, 0)),
+            pl.BlockSpec((TILE_SUBLANES, LANES), lambda i, smap: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (SUBLANES, LANES), lambda i, smap: (smap[i], 0)
+        ),
+    )
+    return pl.pallas_call(
+        _position_reduce_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_slabs * SUBLANES, LANES), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(slab_of_tile, pv, lo)
+
+
+def aligned_segment_grad(
+    per_row: Array,
+    al: AlignedLayoutDev,
+    dim: int,
+    interpret: bool | None = None,
+) -> Array:
+    """``g[f] = sum_e per_row[row_e] * val_e`` over the aligned layout — the
+    Pallas production gradient (third kernel of ops/sparse_grad_select).
+
+    Stages (KERNEL_NOTES.md 'crossing stage', option b):
+
+    1. XLA gather ``per_row[rows] * vals`` — same E-gather the fm path pays;
+    2. Pallas per-tile 8-way masked position reduce → one partial sum per
+       dictionary slot (``n_slabs * 1024`` values ≪ E) — this REPLACES the
+       fm path's E-element segment sum;
+    3. static-permutation gather + tiny sorted segment-sum over ``dup_map``
+       into the ``dim`` coefficients (duplicated features merge here).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    pv = (
+        jnp.take(per_row, al.rows.reshape(-1), axis=0).reshape(al.rows.shape)
+        * al.vals
+    ).astype(jnp.float32)
+    partial = _position_partial_sums(
+        al.slab_of_tile, pv, al.lo, n_slabs=al.n_slabs, interpret=bool(interpret)
+    )
+    flat = jnp.take(partial.reshape(-1), al.grad_perm, axis=0)
+    return jax.ops.segment_sum(
+        flat, al.sorted_feats, num_segments=dim, indices_are_sorted=True
+    )
+
+
+_REDUCE_SUPPORTED: dict = {}
+
+
+def reduce_kernel_supported() -> bool:
+    """Eager Mosaic capability probe for the position-reduce kernel (cached
+    per backend).  Same rationale as ops/pallas_sparse.kernel_supported: a
+    lowering failure surfaces when the ENCLOSING jit compiles, so probe
+    compiled (non-interpret) lowering once, eagerly, on a one-tile input."""
+    backend = jax.default_backend()
+    if backend not in _REDUCE_SUPPORTED:
+        try:
+            _position_partial_sums.lower(
+                jnp.zeros(1, jnp.int32),
+                jnp.zeros((TILE_SUBLANES, LANES), jnp.float32),
+                jnp.zeros((TILE_SUBLANES, LANES), jnp.int32),
+                n_slabs=1,
+                interpret=False,
+            ).compile()
+            _REDUCE_SUPPORTED[backend] = True
+        except Exception:  # noqa: BLE001 — any lowering failure means "no"
+            _REDUCE_SUPPORTED[backend] = False
+    return _REDUCE_SUPPORTED[backend]
+
+
+def aligned_grad_reference(
+    per_row: np.ndarray, layout: AlignedLayout, dim: int
+) -> np.ndarray:
+    """NumPy reference for tests: direct scatter over the layout's entries."""
+    g = np.zeros(dim, np.float64)
+    n_sub = layout.lo.shape[0]
+    tile_of_sub = np.arange(n_sub) // TILE_SUBLANES
+    s = layout.slab_of_tile[tile_of_sub]
+    f = layout.dup_map[
+        s[:, None] * SLAB_POSITIONS
+        + layout.lo * LANES
+        + np.arange(LANES)[None, :]
+    ]
+    np.add.at(
+        g, f.reshape(-1),
+        (np.asarray(per_row)[layout.rows] * layout.vals).reshape(-1),
+    )
+    return g.astype(np.float32)
 
 
 def gather_products_reference(w: np.ndarray, layout: AlignedLayout) -> np.ndarray:

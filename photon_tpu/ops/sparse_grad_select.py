@@ -1,24 +1,32 @@
 """Runtime selection of the sparse-gradient reduction kernel.
 
-The production gradient has two available lowerings (see
+The production gradient has three available lowerings (see
 ops/KERNEL_NOTES.md):
 
 - **fm** — the pre-sorted segment-sum over the static FeatureMajorAux
   layout (no per-evaluation device sort, but pays an extra
-  ``dz[rows]`` gather);
+  ``dz[rows]`` gather and an E-element segment sum);
 - **autodiff** — differentiate through the row-major margins, whose
   transpose is an unsorted scatter-add (XLA lowers it as sort +
-  segmented reduce on TPU, but as a fast native scatter on CPU).
+  segmented reduce on TPU, but as a fast native scatter on CPU);
+- **pallas** — the slab-aligned Mosaic kernel
+  (ops/pallas_gather.aligned_segment_grad): same ``dz[rows]`` gather,
+  then a per-tile 8-way masked position reduce in VMEM and a TINY
+  sorted segment-sum over the slab dictionary (n_slabs*1024 values
+  instead of E).  Requires the batch to carry an AlignedLayoutDev
+  (``attach_feature_major(..., aligned_dim=d)``) and Mosaic to lower
+  the kernel on the local backend.
 
-Which wins is a hardware property (measured: fm ~wins on TPU where the
-scatter's device sort dominates; autodiff wins ~2x on CPU where scatter
-is native) — so, like the reference's BLAS dispatch, the choice is made
-by a one-time EAGER measurement on the live backend, cached per
-(platform, size bucket).  The probe runs at trace time with concrete
-inputs (the same eager-probe pattern as ops/pallas_sparse.kernel_supported)
-and costs a few hundred ms once per process per shape regime.
+Which wins is a hardware property — so, like the reference's BLAS
+dispatch, the choice is made by a one-time EAGER measurement on the live
+backend, cached per (platform, size bucket, candidate set).  The probe
+runs at trace time with concrete inputs (the same eager-probe pattern as
+ops/pallas_sparse.kernel_supported) and costs a few hundred ms once per
+process per shape regime.
 
-Override with ``PHOTON_SPARSE_GRAD=fm|autodiff|auto`` (default auto).
+Override with ``PHOTON_SPARSE_GRAD=fm|autodiff|pallas|auto`` (default
+auto).  The pallas candidate enters auto mode only on a real TPU backend
+(interpret mode on CPU is a test vehicle, orders of magnitude slower).
 """
 
 from __future__ import annotations
@@ -39,7 +47,7 @@ def _bucket(n: int) -> int:
     return max(int(n).bit_length(), 1)
 
 
-def _measure(e: int, d: int, n: int) -> bool:
+def _measure(e: int, d: int, n: int, with_pallas: bool) -> str:
     import jax
     import jax.numpy as jnp
 
@@ -61,48 +69,121 @@ def _measure(e: int, d: int, n: int) -> bool:
         np.asarray(out)
         return (time.perf_counter() - t0) / reps
 
-    t_fm = t(
-        lambda dz, r, v, i: jnp.sum(jax.ops.segment_sum(
-            jnp.take(dz, r, axis=0) * v, i,
-            num_segments=d, indices_are_sorted=True,
-        )),
-        dz, rows, vals, sorted_ids,
-    )
-    t_scatter = t(
-        lambda v, i: jnp.sum(jnp.zeros(d, jnp.float32).at[i].add(v)),
-        vals, ids_j,
-    )
-    return t_fm < t_scatter
+    timings = {
+        "fm": t(
+            lambda dz, r, v, i: jnp.sum(jax.ops.segment_sum(
+                jnp.take(dz, r, axis=0) * v, i,
+                num_segments=d, indices_are_sorted=True,
+            )),
+            dz, rows, vals, sorted_ids,
+        ),
+        "autodiff": t(
+            lambda v, i: jnp.sum(jnp.zeros(d, jnp.float32).at[i].add(v)),
+            vals, ids_j,
+        ),
+    }
+    if with_pallas:
+        from photon_tpu.ops.pallas_gather import (
+            aligned_segment_grad,
+            build_aligned_layout,
+            device_layout,
+        )
+
+        # Probe on the same entry population, reshaped to the batch's [n, k]
+        # padded-COO convention so the layout build is representative.
+        k = max(e // max(n, 1), 1)
+        n_probe = e // k
+        layout = build_aligned_layout(
+            flat_ids[: n_probe * k].reshape(n_probe, k),
+            np.asarray(vals)[: n_probe * k].reshape(n_probe, k),
+            d,
+        )
+        al = device_layout(layout)
+        dz_probe = jnp.asarray(rng.standard_normal(n_probe).astype(np.float32))
+        timings["pallas"] = t(
+            lambda dz: jnp.sum(aligned_segment_grad(dz, al, d, interpret=False)),
+            dz_probe,
+        )
+    return min(timings, key=timings.get)
 
 
-def fm_path_wins(e_total: int, dim: int, n_rows: int) -> bool:
-    """True when the pre-sorted segment-sum path should carry the gradient
-    for this problem size on the current backend."""
-    mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
-    if mode == "fm":
-        return True
-    if mode == "autodiff":
-        return False
+def _pallas_eligible() -> bool:
     import jax
 
-    key = (jax.default_backend(), _bucket(e_total), _bucket(dim))
+    if jax.default_backend() != "tpu":
+        return False
+    from photon_tpu.ops.pallas_gather import reduce_kernel_supported
+
+    return reduce_kernel_supported()
+
+
+def select_kernel(
+    e_total: int,
+    dim: int,
+    n_rows: int,
+    has_fm: bool = True,
+    has_aligned: bool = False,
+) -> str:
+    """Pick the gradient kernel — ``"fm"``, ``"autodiff"``, or ``"pallas"``
+    — for this problem size on the current backend, restricted to the
+    layouts the batch actually carries."""
+    mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
+    if mode == "autodiff":
+        return "autodiff"
+    if mode == "fm":
+        return "fm" if has_fm else "autodiff"
+    if mode == "pallas":
+        # Forced pallas runs in interpret mode off-TPU (tests / parity
+        # checks); it still needs the aligned layout on the batch.
+        return "pallas" if has_aligned else ("fm" if has_fm else "autodiff")
+    import jax
+
+    with_pallas = has_aligned and _pallas_eligible()
+    key = (jax.default_backend(), _bucket(e_total), _bucket(dim), with_pallas)
     if key not in _CACHE:
         try:
             scale = max(1, -(-e_total // _PROBE_MAX_ENTRIES))  # ceil: cap probe size
             e = max(e_total // scale, 1 << 10)
             n = max(n_rows // scale, 64)
-            _CACHE[key] = _measure(e, dim, n)
+            _CACHE[key] = _measure(e, dim, n, with_pallas)
         except Exception:  # noqa: BLE001 — a failed probe must not kill training
-            _CACHE[key] = True  # fm is the TPU-safe default
+            _CACHE[key] = "fm"  # fm is the TPU-safe default
         import logging
 
         # Logged because auto-selection is a wall-clock measurement: on a
         # machine near the kernel crossover two runs can pick different
         # kernels, whose different reduction orders give slightly different
-        # float results.  Pin PHOTON_SPARSE_GRAD=fm|autodiff for bitwise
-        # same-seed reproducibility (SURVEY.md §5 determinism note).
+        # float results.  Pin PHOTON_SPARSE_GRAD=fm|autodiff|pallas for
+        # bitwise same-seed reproducibility (SURVEY.md §5 determinism note).
         logging.getLogger("photon_tpu.sparse_grad").info(
             "sparse-grad kernel for backend=%s e~2^%d d~2^%d: %s",
-            key[0], key[1], key[2], "fm" if _CACHE[key] else "autodiff",
+            key[0], key[1], key[2], _CACHE[key],
         )
-    return _CACHE[key]
+    choice = _CACHE[key]
+    if choice == "pallas" and not has_aligned:
+        choice = "fm"
+    if choice == "fm" and not has_fm:
+        choice = "autodiff"
+    return choice
+
+
+def aligned_layout_wanted() -> bool:
+    """Should batch builders pay the host-side aligned-layout construction?
+    True when the pallas kernel is forced, or could win auto-selection on
+    this backend (TPU + Mosaic lowers the reduce kernel).  Builders call
+    this so CPU runs never pay the bin-packing cost for a kernel auto mode
+    will not pick."""
+    mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
+    if mode == "pallas":
+        return True
+    if mode != "auto":
+        return False
+    try:
+        return _pallas_eligible()
+    except Exception:  # noqa: BLE001 — never block batch build on a probe
+        return False
+
+
+def fm_path_wins(e_total: int, dim: int, n_rows: int) -> bool:
+    """Back-compat boolean view of :func:`select_kernel` (fm vs autodiff)."""
+    return select_kernel(e_total, dim, n_rows, has_fm=True, has_aligned=False) == "fm"

@@ -79,7 +79,7 @@ class DistributedGlmObjective:
 
     # -- derivatives: differentiate through the psum --------------------------
     def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:
-        if self.obj._fm_ready(batch, int(w.shape[0])):
+        if self.obj._sparse_kernel(batch, int(w.shape[0])) == "fm":
             # Static-sparsity fast path: per-shard explicit value+gradient
             # over the shard's block-local feature-major layout, psum-ed —
             # the direct analog of treeAggregate(ValueAndGradientAggregator)
@@ -105,12 +105,12 @@ class DistributedGlmObjective:
         return jax.value_and_grad(self.value)(w, batch)
 
     def grad(self, w: Array, batch: Batch) -> Array:
-        if self.obj._fm_ready(batch, int(w.shape[0])):
+        if self.obj._sparse_kernel(batch, int(w.shape[0])) == "fm":
             return self.value_and_grad(w, batch)[1]
         return jax.grad(self.value)(w, batch)
 
     def hessian_vector(self, w: Array, v: Array, batch: Batch) -> Array:
-        if self.obj.normalization is None and self.obj._fm_ready(batch, int(w.shape[0])):
+        if self.obj.normalization is None and self.obj._sparse_kernel(batch, int(w.shape[0])) == "fm":
             ax = self.axis_name
 
             @partial(

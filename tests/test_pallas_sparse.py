@@ -135,7 +135,7 @@ from photon_tpu.core.optimizers import OptimizerConfig
 from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
 from photon_tpu.data.batch import SparseBatch
 rng = np.random.default_rng(1)
-n, k, d = 2000, 6, 64
+n, k, d = 800, 6, 64
 ids = rng.integers(1, d, (n, k)).astype(np.int32)
 vals = rng.standard_normal((n, k)).astype(np.float32)
 w_true = rng.standard_normal(d).astype(np.float32) * 0.3
@@ -145,7 +145,7 @@ batch = SparseBatch(jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(y),
                     jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32))
 obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
 problem = GlmOptimizationProblem(obj, ProblemConfig(
-    optimizer_config=OptimizerConfig(max_iterations=50)))
+    optimizer_config=OptimizerConfig(max_iterations=25)))
 coeffs, res = problem.run(batch, jnp.zeros(d, jnp.float32))
 print("VALUE", float(res.value))
 """

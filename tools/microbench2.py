@@ -67,10 +67,13 @@ def main():
         lambda q, i: jnp.sum(jax.ops.segment_sum(
             q, i, num_segments=d, indices_are_sorted=True)), qe, sorted_ids), e)
 
+    al = None
     try:
         from photon_tpu.ops.pallas_gather import (
-            aligned_gather_products, build_aligned_layout)
+            aligned_gather_products, aligned_segment_grad,
+            build_aligned_layout, device_layout)
         lay = build_aligned_layout(ids, vals, d)
+        al = device_layout(lay)
         smap = jnp.asarray(lay.slab_of_tile)
         lo = jnp.asarray(lay.lo)
         lvals = jnp.asarray(lay.vals)
@@ -81,21 +84,44 @@ def main():
             t, lay.padded_entries)
         res["dup-gather w[dup_map]"] = (tm(
             lambda w, m: jnp.sum(jnp.take(w, m, axis=0)), w, dup), dup.size)
+        # The round-4 production gradient kernel: dz[rows] gather + Pallas
+        # position reduce + dictionary segment-sum (vs "bwd fast" above,
+        # whose segment-sum runs over all E entries).
+        res["bwd pallas: aligned_segment_grad"] = (tm(
+            lambda u: jnp.sum(aligned_segment_grad(u, al, d, interpret=False)),
+            u), lay.padded_entries)
     except Exception as ex:  # noqa: BLE001
-        print("pallas aligned gather FAILED:", str(ex)[:200])
+        print("pallas aligned kernels FAILED:", str(ex)[:200])
 
-    # End-to-end: the two production value_and_grad paths.
+    # End-to-end: the three production value_and_grad paths (env-pinned so
+    # the measured routing is the named one, not the auto measurement).
+    import os
+
     from photon_tpu.core.objective import GlmObjective, RegularizationContext
     from photon_tpu.data.batch import SparseBatch, attach_feature_major
 
     batch = SparseBatch(ids_j, vals_j, jnp.asarray((rng.random(n) < 0.5).astype(np.float32)),
                         jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32))
     obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
-    res["value_and_grad autodiff (r1 path)"] = (tm(
-        lambda w: obj.value_and_grad(w, batch)[1].sum(), w), e)
-    fast = attach_feature_major(batch)
-    res["value_and_grad fast (fm path)"] = (tm(
-        lambda w: obj.value_and_grad(w, fast)[1].sum(), w), e)
+    prev = os.environ.get("PHOTON_SPARSE_GRAD")
+    try:
+        os.environ["PHOTON_SPARSE_GRAD"] = "autodiff"
+        res["value_and_grad autodiff (r1 path)"] = (tm(
+            lambda w: obj.value_and_grad(w, batch)[1].sum(), w), e)
+        os.environ["PHOTON_SPARSE_GRAD"] = "fm"
+        fast = attach_feature_major(batch)
+        res["value_and_grad fast (fm path)"] = (tm(
+            lambda w: obj.value_and_grad(w, fast)[1].sum(), w), e)
+        if al is not None:
+            os.environ["PHOTON_SPARSE_GRAD"] = "pallas"
+            aligned = fast._replace(al=al)
+            res["value_and_grad pallas (r4 path)"] = (tm(
+                lambda w: obj.value_and_grad(w, aligned)[1].sum(), w), e)
+    finally:
+        if prev is None:
+            os.environ.pop("PHOTON_SPARSE_GRAD", None)
+        else:
+            os.environ["PHOTON_SPARSE_GRAD"] = prev
 
     for name, (t, cnt) in res.items():
         print(f"{name:45s} {t*1e3:8.2f} ms   {cnt/t/1e9:7.2f} Gelem/s")
