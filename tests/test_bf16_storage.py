@@ -95,7 +95,7 @@ def test_game_driver_dtype_flag(tmp_path):
         out = tmp_path / dtype
         summary = train_game.run(train_game.build_parser().parse_args([
             "--backend", "cpu",
-            "--input", "synthetic-game:24:8:8:4:1:4",
+            "--input", "synthetic-game:16:6:8:4:1:4",
             "--coordinate", "fixed:type=fixed,shard=global,max_iters=5",
             "--coordinate", "pu:type=random,shard=re0,entity=re0,max_iters=4",
             "--descent-iterations", "1",

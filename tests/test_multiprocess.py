@@ -218,7 +218,7 @@ train.run(train.build_parser().parse_args([
     "--coordinator", coordinator, "--process-id", str(pid),
     "--num-processes", "2",
     "--input", input_dir, "--task", "logistic_regression",
-    "--stream", "--reg-weights", "1.0", "--max-iterations", "10",
+    "--stream", "--reg-weights", "1.0", "--max-iterations", "6",
     "--output-dir", out_dir,
 ]))
 # Every rank (not just the writing rank 0) records the kernel it resolved:
@@ -258,7 +258,7 @@ def test_two_process_streaming_driver_matches_single(tmp_path):
     train.run(train.build_parser().parse_args([
         "--backend", "cpu", "--input", str(input_dir),
         "--task", "logistic_regression", "--stream",
-        "--reg-weights", "1.0", "--max-iterations", "10",
+        "--reg-weights", "1.0", "--max-iterations", "6",
         "--output-dir", single_out,
     ]))
 
