@@ -154,6 +154,25 @@ class GlmObjective:
         w_eff, correction = self.normalization.effective_coefficients(w)
         return margins(w_eff, batch) - correction
 
+    def _xu_product(self, kernel: str, u: Array, batch: Batch) -> Array:
+        """Per-row ``X u`` products (no offset) through the selected
+        kernel's forward: the pallas path uses the TRANSPOSED aligned
+        layout when the batch carries one (``sum_e u[f_e] v_e`` per row via
+        the same position-reduce kernel — KERNEL_NOTES.md option (a));
+        everything else takes the row-major XLA gather.  The single
+        dispatch point for margins AND Hv's ``X v``."""
+        if kernel == "pallas" and batch.al_t is not None:
+            from photon_tpu.ops.pallas_gather import aligned_segment_grad
+
+            return aligned_segment_grad(u, batch.al_t, batch.ids.shape[0])
+        return jnp.sum(jnp.take(u, batch.ids, axis=0) * batch.vals, axis=-1)
+
+    def _margins_for_kernel(self, kernel: str, w: Array, batch: Batch) -> Array:
+        if self.normalization is None:
+            return self._xu_product(kernel, w, batch) + batch.offset
+        w_eff, correction = self.normalization.effective_coefficients(w)
+        return self._xu_product(kernel, w_eff, batch) + batch.offset - correction
+
     # -- value / gradient ------------------------------------------------------
     def data_value(self, w: Array, batch: Batch) -> Array:
         z = self._margins(w, batch)
@@ -207,7 +226,7 @@ class GlmObjective:
         ``g = F (Xᵀ dz - s Σ dz)`` — one extra scalar sum and two
         elementwise ops over the same sorted segment sum (the sparse batch
         never densifies, mirroring hessian_diagonal's algebra)."""
-        z = self._margins(w, batch)
+        z = self._margins_for_kernel(kernel, w, batch)
         v = jnp.sum(batch.weight * self.loss.value(z, batch.label))
         dz = batch.weight * self.loss.d1(z, batch.label)
         g = self._segment_grad(kernel, dz, batch, w.shape[0])
@@ -222,10 +241,18 @@ class GlmObjective:
         self, w: Array, v: Array, batch: Batch, kernel: str = "fm"
     ) -> Array:
         """Data term of ``H v = Xᵀ diag(weight·d2) X v`` — exact for GLMs
-        (margins are linear in w), same layout trick as the gradient."""
-        z = margins(w, batch)
+        (margins are linear in w), same layout trick as the gradient.
+        Both ``X·u`` products route through the kernel's forward (the
+        pallas path reuses the transposed layout for ``X v`` too).
+        Unnormalized objectives only — callers gate on it (normalized Hv
+        goes through jvp of the normalized gradient instead), and the
+        algebra below would be silently half-normalized otherwise."""
+        assert self.normalization is None, (
+            "fast Hv requires an unnormalized objective"
+        )
+        z = self._margins_for_kernel(kernel, w, batch)
         d2w = batch.weight * self.loss.d2(z, batch.label)
-        xv = jnp.sum(jnp.take(v, batch.ids, axis=0) * batch.vals, axis=-1)
+        xv = self._xu_product(kernel, v, batch)
         return self._segment_grad(kernel, d2w * xv, batch, w.shape[0])
 
     def value_and_grad(self, w: Array, batch: Batch) -> tuple[Array, Array]:

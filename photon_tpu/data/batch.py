@@ -104,6 +104,10 @@ class SparseBatch(NamedTuple):
     # ``attach_feature_major(..., aligned_dim=d)``.  Single-block batches
     # only (each shard of a distributed batch builds its own).
     al: Optional["object"] = None
+    # Optional TRANSPOSED aligned layout (rows as the slab dictionary) for
+    # the Pallas FORWARD (margins) direction; attach with
+    # ``attach_feature_major(..., aligned_dim=d, aligned_forward=True)``.
+    al_t: Optional["object"] = None
 
     @property
     def num_examples(self) -> int:
@@ -205,7 +209,10 @@ def with_offset(batch: Batch, offset: Array) -> Batch:
 
 
 def attach_feature_major(
-    batch: SparseBatch, shards: int = 1, aligned_dim: int | None = None
+    batch: SparseBatch,
+    shards: int = 1,
+    aligned_dim: int | None = None,
+    aligned_forward: bool | None = None,
 ) -> SparseBatch:
     """Attach the static feature-major layout (:class:`FeatureMajorAux`).
 
@@ -223,6 +230,12 @@ def attach_feature_major(
     ops/sparse_grad_select.  Single-block (``shards == 1``) only: the
     aligned layout stores global rows, so a sharded batch would need one per
     shard block.
+
+    ``aligned_forward`` additionally builds the transposed (row-dictionary)
+    layout so the Pallas path computes MARGINS through the same kernel
+    (``batch.al_t``) — costs a second layout's host build and device
+    memory, so it defaults to the ``PHOTON_SPARSE_MARGIN=pallas`` env
+    opt-in.
     """
     if not isinstance(batch, SparseBatch) or batch.ids.ndim != 2:
         raise ValueError("feature-major layout requires a 2-D SparseBatch")
@@ -242,15 +255,35 @@ def attach_feature_major(
         rows=jnp.asarray(take(rows, order, axis=1)),
         vals=jnp.asarray(take(vals, order, axis=1)),
     ))
+    if aligned_forward and aligned_dim is None:
+        raise ValueError(
+            "aligned_forward requires aligned_dim (the transposed layout "
+            "only serves the pallas kernel, which needs the aligned "
+            "gradient layout too)"
+        )
     if aligned_dim is not None:
         if shards != 1:
             raise ValueError("aligned layout requires shards == 1")
-        from photon_tpu.ops.pallas_gather import build_aligned_layout, device_layout
-
-        layout = build_aligned_layout(
-            np.asarray(batch.ids), np.asarray(batch.vals, np.float32), aligned_dim
+        from photon_tpu.ops.pallas_gather import (
+            build_aligned_layout,
+            build_row_aligned_layout,
+            device_layout,
         )
+
+        ids_np = np.asarray(batch.ids)
+        vals_np = np.asarray(batch.vals, np.float32)
+        layout = build_aligned_layout(ids_np, vals_np, aligned_dim)
         batch = batch._replace(al=device_layout(layout))
+        if aligned_forward is None:
+            import os
+
+            aligned_forward = (
+                os.environ.get("PHOTON_SPARSE_MARGIN", "xla") == "pallas"
+            )
+        if aligned_forward:
+            batch = batch._replace(
+                al_t=device_layout(build_row_aligned_layout(ids_np, vals_np))
+            )
     return batch
 
 
@@ -270,12 +303,15 @@ def batch_astype(batch: Batch, dtype) -> Batch:
     out = batch._replace(vals=batch.vals.astype(dtype))
     if out.fm is not None:
         out = out._replace(fm=out.fm._replace(vals=out.fm.vals.astype(dtype)))
-    if out.al is not None:
+    if out.al is not None or out.al_t is not None:
         import dataclasses
 
-        out = out._replace(
-            al=dataclasses.replace(out.al, vals=out.al.vals.astype(dtype))
-        )
+        for aux in ("al", "al_t"):
+            lay = getattr(out, aux)
+            if lay is not None:
+                out = out._replace(**{
+                    aux: dataclasses.replace(lay, vals=lay.vals.astype(dtype))
+                })
     return out
 
 
@@ -297,7 +333,7 @@ def pad_batch(batch: Batch, target_n: int) -> Batch:
     # dependent; padding per-leaf would corrupt them.  Strip them (padded
     # rows carry only zero-value entries, so an aux rebuilt after padding is
     # equivalent) and let the caller re-attach at the final row count.
-    for aux in ("fm", "al"):
+    for aux in ("fm", "al", "al_t"):
         if getattr(batch, aux, None) is not None:
             batch = batch._replace(**{aux: None})
     return jax.tree.map(_pad, batch)

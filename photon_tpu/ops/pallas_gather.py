@@ -113,8 +113,40 @@ def build_aligned_layout(ids: np.ndarray, vals: np.ndarray, dim: int) -> Aligned
     flat_f = ids.reshape(-1).astype(np.int64)
     flat_v = vals.reshape(-1).astype(np.float32)
     flat_r = np.repeat(np.arange(n, dtype=np.int64), k)
+    return _build_aligned_from_flat(flat_f, flat_r, flat_v, dim)
+
+
+def build_row_aligned_layout(
+    ids: np.ndarray, vals: np.ndarray
+) -> AlignedLayout:
+    """The TRANSPOSED layout: rows are the slab dictionary, features the
+    per-entry payload.  With it the position-reduce kernel runs the
+    FORWARD direction — ``aligned_segment_grad(w, row_layout, n)`` yields
+    per-row sums ``sum_e w[f_e] * val_e`` (margins minus offset) — because
+    the reduction is role-symmetric: it groups entries by dictionary id and
+    gathers ``per_row`` at the payload index (KERNEL_NOTES.md 'crossing
+    stage', option (a))."""
+    n, k = ids.shape
+    flat_f = ids.reshape(-1).astype(np.int64)
+    flat_v = vals.reshape(-1).astype(np.float32)
+    flat_r = np.repeat(np.arange(n, dtype=np.int64), k)
+    return _build_aligned_from_flat(flat_r, flat_f, flat_v, n)
+
+
+def _build_aligned_from_flat(
+    flat_key: np.ndarray, flat_payload: np.ndarray, flat_v: np.ndarray, dim: int
+) -> AlignedLayout:
+    """Core bin-packing builder over flat entry streams.
+
+    ``flat_key`` is the grouping id each entry reduces into (stored in the
+    slab dictionary / ``dup_map``); ``flat_payload`` is the id whose vector
+    element the entry multiplies (stored in ``AlignedLayout.rows``, gathered
+    at runtime as ``per_row[rows]``).  The standard gradient layout uses
+    (key=feature, payload=row); the transposed forward layout swaps them.
+    Pad entries (val == 0) are dropped.
+    """
     keep = flat_v != 0.0
-    flat_f, flat_v, flat_r = flat_f[keep], flat_v[keep], flat_r[keep]
+    flat_f, flat_v, flat_r = flat_key[keep], flat_v[keep], flat_payload[keep]
     if flat_f.size and (flat_f.min() < 0 or flat_f.max() >= dim):
         raise ValueError("feature id out of range for dim")
     e_total = int(flat_f.size)

@@ -75,10 +75,13 @@ def shard_batch(
     n = batch.num_examples
     target = ((n + n_shards - 1) // n_shards) * n_shards
     padded = pad_batch(batch, target)
-    if isinstance(padded, SparseBatch) and padded.al is not None:
-        # The slab-aligned (Pallas) layout is single-block; it cannot be
-        # row-sharded.  Strip it — sharded objectives use the per-shard fm.
-        padded = padded._replace(al=None)
+    if isinstance(padded, SparseBatch) and (
+        padded.al is not None or padded.al_t is not None
+    ):
+        # The slab-aligned (Pallas) layouts are single-block; they cannot
+        # be row-sharded.  Strip them — sharded objectives use the
+        # per-shard fm.
+        padded = padded._replace(al=None, al_t=None)
     if build_fm and isinstance(padded, SparseBatch) and padded.ids.ndim == 2:
         padded = attach_feature_major(padded._replace(fm=None), shards=n_shards)
     return jax.device_put(padded, batch_sharding(mesh, padded, axis_name))

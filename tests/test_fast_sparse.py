@@ -276,6 +276,56 @@ def test_pallas_kernel_under_normalization(monkeypatch):
     np.testing.assert_allclose(g_p, g_ref, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("zipf", [False, True])
+def test_pallas_forward_margins_via_transposed_layout(monkeypatch, zipf):
+    """aligned_forward=True builds the row-dictionary layout; the pallas
+    path then computes margins AND Hv products through the same
+    position-reduce kernel (KERNEL_NOTES option (a)) — must match the
+    autodiff reference, incl. under normalization."""
+    from photon_tpu.core.normalization import NormalizationContext
+    from photon_tpu.core.stats import BasicStatisticalSummary
+    from photon_tpu.ops.pallas_gather import aligned_segment_grad
+
+    n, k, d = 320, 7, 56
+    batch = _random_batch(n, k, d, seed=80, zipf=zipf)
+    fast = attach_feature_major(batch, aligned_dim=d, aligned_forward=True)
+    assert fast.al is not None and fast.al_t is not None
+    rng = np.random.default_rng(81)
+    w = jnp.asarray(rng.standard_normal(d), jnp.float32) * 0.1
+
+    # Raw margins through the transposed layout == row-major gather.
+    from photon_tpu.data.batch import margins as rowmajor_margins
+
+    z_t = aligned_segment_grad(w, fast.al_t, n, interpret=True) + batch.offset
+    np.testing.assert_allclose(
+        np.asarray(z_t), np.asarray(rowmajor_margins(w, batch)),
+        rtol=2e-4, atol=1e-5,
+    )
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.5))
+    v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+    v_p, g_p = obj.value_and_grad(w, fast)
+    np.testing.assert_allclose(v_p, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g_p, g_ref, rtol=2e-4, atol=1e-5)
+    vec = jnp.asarray(rng.standard_normal(d), jnp.float32)
+    hv_ref = jax.jvp(lambda u: jax.grad(obj.value)(u, batch), (w,), (vec,))[1]
+    np.testing.assert_allclose(
+        obj.hessian_vector(w, vec, fast), hv_ref, rtol=2e-4, atol=1e-5
+    )
+
+    # Under normalization (the shifted-margin correction rides along).
+    summary = BasicStatisticalSummary.from_batch(batch, d)
+    norm = NormalizationContext.build("standardization", summary, intercept_id=0)
+    obj_n = GlmObjective.create(
+        "logistic", RegularizationContext("l2", 0.5), normalization=norm
+    )
+    v_ref, g_ref = jax.value_and_grad(obj_n.value)(w, batch)
+    v_p, g_p = obj_n.value_and_grad(w, fast)
+    np.testing.assert_allclose(v_p, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g_p, g_ref, rtol=2e-4, atol=1e-5)
+
+
 def test_pallas_kernel_normalized_hessian_vector(monkeypatch):
     """Normalized Hv falls back to jvp-of-grad; pallas_call has no JVP
     rule, so the inner grad must re-route to the (differentiable) fm

@@ -67,7 +67,7 @@ def main():
         lambda q, i: jnp.sum(jax.ops.segment_sum(
             q, i, num_segments=d, indices_are_sorted=True)), qe, sorted_ids), e)
 
-    al = None
+    al = al_t = None
     try:
         from photon_tpu.ops.pallas_gather import (
             aligned_gather_products, aligned_segment_grad,
@@ -90,6 +90,15 @@ def main():
         res["bwd pallas: aligned_segment_grad"] = (tm(
             lambda u: jnp.sum(aligned_segment_grad(u, al, d, interpret=False)),
             u), lay.padded_entries)
+        # The transposed (row-dictionary) layout: same kernel runs the
+        # FORWARD — margins as per-row sums (vs "fwd: gather+rowsum" above).
+        from photon_tpu.ops.pallas_gather import build_row_aligned_layout
+
+        lay_t = build_row_aligned_layout(ids, vals)
+        al_t = device_layout(lay_t)
+        res[f"fwd pallas: aligned margins (pad {lay_t.padding_factor:.2f}x)"] = (
+            tm(lambda w: jnp.sum(aligned_segment_grad(w, al_t, n, interpret=False)),
+               w), lay_t.padded_entries)
     except Exception as ex:  # noqa: BLE001
         print("pallas aligned kernels FAILED:", str(ex)[:200])
 
@@ -115,8 +124,12 @@ def main():
         if al is not None:
             os.environ["PHOTON_SPARSE_GRAD"] = "pallas"
             aligned = fast._replace(al=al)
-            res["value_and_grad pallas (r4 path)"] = (tm(
+            res["value_and_grad pallas bwd (r4)"] = (tm(
                 lambda w: obj.value_and_grad(w, aligned)[1].sum(), w), e)
+            if al_t is not None:
+                aligned_fb = aligned._replace(al_t=al_t)
+                res["value_and_grad pallas fwd+bwd (r4)"] = (tm(
+                    lambda w: obj.value_and_grad(w, aligned_fb)[1].sum(), w), e)
     finally:
         if prev is None:
             os.environ.pop("PHOTON_SPARSE_GRAD", None)
