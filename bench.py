@@ -257,14 +257,16 @@ def _bench_config(num: int) -> None:
     from photon_tpu.drivers import train_game
 
     if num == 4:
-        from photon_tpu.data.fixtures import make_movielens_like
+        from photon_tpu.data.fixtures import movielens_dataset
         from photon_tpu.data.game_io import write_game_avro
 
         # MovieLens-1M user/item counts; ratings-per-user scaled so the
-        # host-side Avro fixture write stays bounded (~300K rows).
+        # host-side Avro fixture write stays bounded (~300K rows).  When
+        # PHOTON_REAL_DATA_DIR/ml-1m exists, the REAL MovieLens-1M is used
+        # instead (true literature-comparable metrics).
         ml_kw = dict(n_users=6040, n_items=3700, mean_ratings=50) if big \
             else {}
-        data, ml_maps = make_movielens_like(**ml_kw)
+        data, ml_maps = movielens_dataset(**ml_kw)
         avro_path = os.path.join(tmp, "movielens.avro")
         write_game_avro(avro_path, data, ml_maps)
         coords = [

@@ -168,6 +168,9 @@ class GlmObjective:
         return jnp.sum(jnp.take(u, batch.ids, axis=0) * batch.vals, axis=-1)
 
     def _margins_for_kernel(self, kernel: str, w: Array, batch: Batch) -> Array:
+        if not (kernel == "pallas" and batch.al_t is not None):
+            # Single home of the normalization algebra for the XLA forward.
+            return self._margins(w, batch)
         if self.normalization is None:
             return self._xu_product(kernel, w, batch) + batch.offset
         w_eff, correction = self.normalization.effective_coefficients(w)

@@ -157,8 +157,111 @@ def make_movielens_like(
     return data, index_maps
 
 
+# MovieLens-1M genre vocabulary (README order; 18 genres).
+_ML_GENRES = (
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+)
+
+
+def movielens_dataset(**fixture_kw):
+    """GAME MovieLens dataset: the REAL MovieLens-1M when operators provide
+    it (``PHOTON_REAL_DATA_DIR/ml-1m/{ratings,movies}.dat`` — no network
+    egress here; VERDICT r3 item 9), else the statistics-matched generator
+    :func:`make_movielens_like` with ``fixture_kw``.  Both return
+    ``(GameDataset, index_maps)`` with identical shard structure, so bench
+    config 4 and drivers are agnostic to which backs them."""
+    real_dir = os.environ.get("PHOTON_REAL_DATA_DIR")
+    if real_dir:
+        mdir = os.path.join(real_dir, "ml-1m")
+        if os.path.exists(os.path.join(mdir, "ratings.dat")) and os.path.exists(
+            os.path.join(mdir, "movies.dat")
+        ):
+            return _movielens_real(mdir)
+    return make_movielens_like(**fixture_kw)
+
+
+def _movielens_real(mdir: str):
+    """Parse the verbatim MovieLens-1M distribution into the GAME layout
+    used by the fixture: label = rating >= 4, global + per-user shards of
+    the rated item's genre indicators + intercept."""
+    from photon_tpu.data.index_map import IndexMap, feature_key
+    from photon_tpu.game.data import DenseShard, GameDataset
+
+    n_genres = len(_ML_GENRES)
+    gidx = {g: i for i, g in enumerate(_ML_GENRES)}
+    genres_by_movie: dict = {}
+    with open(os.path.join(mdir, "movies.dat"), encoding="latin-1") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("::")
+            if len(parts) != 3:
+                continue
+            vec = np.zeros(n_genres, np.float32)
+            for g in parts[2].split("|"):
+                gi = gidx.get(g.strip())
+                if gi is not None:
+                    vec[gi] = 1.0
+            genres_by_movie[int(parts[0])] = vec
+    users, items, labels = [], [], []
+    with open(os.path.join(mdir, "ratings.dat"), encoding="latin-1") as f:
+        for line in f:
+            parts = line.split("::")
+            if len(parts) < 3:
+                continue
+            movie = int(parts[1])
+            if movie not in genres_by_movie:
+                continue
+            users.append(int(parts[0]))
+            items.append(movie)
+            labels.append(1.0 if float(parts[2]) >= 4.0 else 0.0)
+    if not users:
+        raise ValueError(
+            f"no joinable ratings found in {mdir!r}: ratings.dat rows must "
+            "reference movie ids present in movies.dat (truncated or "
+            "mismatched MovieLens drop-in?)"
+        )
+    users = np.asarray(users, np.int64)
+    items = np.asarray(items, np.int64)
+    labels = np.asarray(labels, np.float32)
+    n = len(labels)
+    item_genres = np.stack([genres_by_movie[m] for m in items])
+    x_global = np.concatenate([item_genres, np.ones((n, 1), np.float32)], axis=1)
+    shards = {
+        "global": DenseShard(x_global),
+        "per_user": DenseShard(x_global.copy()),
+    }
+    index_maps = {
+        name: IndexMap.build(
+            [feature_key(f"genre{g}") for g in range(n_genres)], intercept=True
+        )
+        for name in shards
+    }
+    data = GameDataset(
+        shards=shards,
+        label=labels,
+        offset=np.zeros(n, np.float32),
+        weight=np.ones(n, np.float32),
+        id_columns={"userId": users, "itemId": items},
+    )
+    return data, index_maps
+
+
 def a1a_fixture_paths() -> tuple[str, str]:
-    """Repo-committed fixture locations (generated once, checked in)."""
+    """a1a train/test file locations.
+
+    If operators provide the REAL datasets (no network egress here, so
+    they must be dropped in by hand — VERDICT r3 item 9), point
+    ``PHOTON_REAL_DATA_DIR`` at a directory containing ``a1a`` and
+    ``a1a.t`` (the verbatim LIBSVM files); benches and anchor tests then
+    run on the real data and report true literature-comparable AUCs.
+    Otherwise the repo-committed statistics-matched fixtures are used.
+    """
+    real_dir = os.environ.get("PHOTON_REAL_DATA_DIR")
+    if real_dir:
+        train, test = os.path.join(real_dir, "a1a"), os.path.join(real_dir, "a1a.t")
+        if os.path.exists(train) and os.path.exists(test):
+            return train, test
     base = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         "tests", "fixtures",

@@ -29,11 +29,14 @@ Design (full analysis + measurement log: photon_tpu/ops/KERNEL_NOTES.md):
   on zipf(1.3) ids (judge-measured; see KERNEL_NOTES.md).
 
 ``AlignedLayout.padding_factor`` exposes padded/real entries; tests assert
-<= 1.5x on zipf(1.3).  The products come out feature-major; routing them
-back to row-major (for per-row margin sums) is the crossing stage analyzed
-in KERNEL_NOTES.md — which is why the full objective routes through the
-pre-sorted segment-sum path (core/objective.py) until the crossing is
-measured worth building.
+<= 1.5x on zipf(1.3).  Both directions of the crossing stage analyzed in
+KERNEL_NOTES.md are built here: :func:`aligned_segment_grad` over the
+standard layout is the production GRADIENT (third kernel of
+ops/sparse_grad_select, ``PHOTON_SPARSE_GRAD=pallas``), and the same
+function over :func:`build_row_aligned_layout`'s transposed layout is the
+FORWARD — per-row margin sums (``PHOTON_SPARSE_MARGIN=pallas``).  Default
+routing stays with the pre-sorted segment-sum path (core/objective.py)
+until hardware measurement picks the winner.
 """
 
 from __future__ import annotations
@@ -130,11 +133,15 @@ def build_row_aligned_layout(
     flat_f = ids.reshape(-1).astype(np.int64)
     flat_v = vals.reshape(-1).astype(np.float32)
     flat_r = np.repeat(np.arange(n, dtype=np.int64), k)
-    return _build_aligned_from_flat(flat_r, flat_f, flat_v, n)
+    return _build_aligned_from_flat(flat_r, flat_f, flat_v, n, key_role="row")
 
 
 def _build_aligned_from_flat(
-    flat_key: np.ndarray, flat_payload: np.ndarray, flat_v: np.ndarray, dim: int
+    flat_key: np.ndarray,
+    flat_payload: np.ndarray,
+    flat_v: np.ndarray,
+    dim: int,
+    key_role: str = "feature",
 ) -> AlignedLayout:
     """Core bin-packing builder over flat entry streams.
 
@@ -148,7 +155,7 @@ def _build_aligned_from_flat(
     keep = flat_v != 0.0
     flat_f, flat_v, flat_r = flat_key[keep], flat_v[keep], flat_payload[keep]
     if flat_f.size and (flat_f.min() < 0 or flat_f.max() >= dim):
-        raise ValueError("feature id out of range for dim")
+        raise ValueError(f"{key_role} id out of range for dim {dim}")
     e_total = int(flat_f.size)
     if e_total == 0:
         return AlignedLayout(

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# TPU-day evidence pack: run the moment the tunneled chip answers.
+#
+# Produces, under tools/tpu_day_out/:
+#   00_probe.txt        backend probe (subprocess-guarded, bounded)
+#   01_microbench2.txt  primitive table -> paste into ops/KERNEL_NOTES.md
+#   02_headline_*.txt   bench headline per kernel (fm / pallas / pallas+fwd)
+#                       and bf16 storage, cold then warm
+#   03_configs.txt      bench configs 1-5 (quality anchors)
+#   04_stream_scale.txt streaming-ingestion proof
+#
+# Every step is individually timeout-bounded so a mid-run tunnel drop
+# cannot hang the pack; partial output is still evidence.  Run from the
+# repo root: bash tools/tpu_day.sh
+set -u
+cd "$(dirname "$0")/.."
+OUT=tools/tpu_day_out
+mkdir -p "$OUT"
+
+# Fresh probe (bench.py caches a cpu-fallback verdict for 1h; clear it).
+rm -f "${TMPDIR:-/tmp}/photon_bench_backend_probe.json"
+echo "== probe =="
+timeout 300 python -c "import jax; print(jax.devices())" \
+    > "$OUT/00_probe.txt" 2>&1
+if ! grep -qi "tpu\|axon" "$OUT/00_probe.txt"; then
+    echo "no TPU visible; pack aborted (see $OUT/00_probe.txt)"
+    exit 1
+fi
+
+echo "== microbench2 (primitive table) =="
+timeout 900 python tools/microbench2.py > "$OUT/01_microbench2.txt" 2>&1
+
+echo "== headline per kernel (cold, then warm) =="
+for kernel in fm pallas autodiff; do
+    for pass in cold warm; do
+        PHOTON_SPARSE_GRAD=$kernel timeout 900 python bench.py --headline-only \
+            > "$OUT/02_headline_${kernel}_${pass}.txt" 2>&1
+    done
+done
+# Full-pallas pipeline (forward margins through the transposed layout).
+PHOTON_SPARSE_GRAD=pallas PHOTON_SPARSE_MARGIN=pallas \
+    timeout 900 python bench.py --headline-only \
+    > "$OUT/02_headline_pallas_fwd_warm.txt" 2>&1
+# bf16 value storage delta on the best kernel.
+PHOTON_BENCH_DTYPE=bfloat16 timeout 900 python bench.py --headline-only \
+    > "$OUT/02_headline_fm_bf16.txt" 2>&1
+
+echo "== configs 1-5 =="
+: > "$OUT/03_configs.txt"
+for c in 1 2 3 4 5; do
+    timeout 900 python bench.py --config "$c" >> "$OUT/03_configs.txt" 2>&1
+done
+
+echo "== stream-scale =="
+timeout 3600 python bench.py --stream-scale > "$OUT/04_stream_scale.txt" 2>&1
+
+echo "pack complete: $OUT/"
+grep -h '"metric"' "$OUT"/02_headline_*.txt "$OUT/03_configs.txt" \
+    "$OUT/04_stream_scale.txt" 2>/dev/null | tail -20
