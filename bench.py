@@ -106,13 +106,21 @@ def _acquire_backend(timeout_s: float | None = None) -> None:
 
 
 def _build_batch(n: int, k: int, d: int, seed: int = 0):
-    """Synthetic sparse logistic data in the framework's padded-COO layout."""
+    """Synthetic sparse logistic data in the framework's padded-COO layout.
+
+    ``PHOTON_BENCH_SKEW=zipf`` draws power-law feature ids (the realistic
+    sparse-GLM regime and the adversarial case for aligned-layout padding);
+    default is uniform ids.
+    """
     import jax.numpy as jnp
 
     from photon_tpu.data.batch import SparseBatch
 
     rng = np.random.default_rng(seed)
-    ids = rng.integers(1, d, size=(n, k), dtype=np.int32)  # id 0 = pad/intercept
+    if os.environ.get("PHOTON_BENCH_SKEW", "uniform") == "zipf":
+        ids = (1 + (rng.zipf(1.3, size=(n, k)) - 1) % (d - 1)).astype(np.int32)
+    else:
+        ids = rng.integers(1, d, size=(n, k), dtype=np.int32)  # id 0 = pad/intercept
     vals = rng.standard_normal((n, k)).astype(np.float32)
     w_true = rng.standard_normal(d).astype(np.float32) * 0.1
     margin = (w_true[ids] * vals).sum(axis=1)
@@ -590,6 +598,7 @@ def main() -> None:
         "dim": d,
         "dtype": bench_dtype,
         "kernel": os.environ.get("PHOTON_SPARSE_GRAD", "auto"),
+        "skew": os.environ.get("PHOTON_BENCH_SKEW", "uniform"),
         "platform": platform,
         "rows_per_sec": round(steps_per_sec * n, 1),
         "effective_gb_per_sec": round(eff_gb_s, 2),

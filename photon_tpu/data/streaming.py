@@ -411,7 +411,17 @@ def streaming_lbfgs(
 
 def _scan_rows_nnz(path: str) -> tuple[int, int]:
     """(row count, max nnz per row) without materializing values — the
-    metadata-only pass used when the feature dimension is already known."""
+    metadata-only pass used when the feature dimension is already known.
+    Uses the native line indexer when available (the Python fallback is
+    the measurable cost of the metadata phase at 10M-row scale)."""
+    try:
+        from photon_tpu.native import libsvm_native
+
+        meta = libsvm_native.scan_meta(path)
+        if meta is not None:
+            return meta
+    except Exception:  # noqa: BLE001 — metadata must not depend on the .so
+        pass
     rows, max_nnz = 0, 0
     with open(path, "rb") as f:
         for raw in f:

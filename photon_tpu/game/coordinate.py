@@ -239,8 +239,16 @@ class FixedEffectDeviceData:
             self.batch = shard_batch(self.batch, mesh, build_fm=build_fm)
         elif build_fm and isinstance(self.batch, SparseBatch):
             from photon_tpu.data.batch import attach_feature_major
+            from photon_tpu.ops.sparse_grad_select import aligned_layout_wanted
 
-            self.batch = attach_feature_major(self.batch)
+            # Single-device: the GAME fixed effect is the framework's big
+            # sparse solve, so it gets the same Pallas-kernel eligibility
+            # as the legacy driver (aligned layouts only when the selector
+            # could route to them).
+            self.batch = attach_feature_major(
+                self.batch,
+                aligned_dim=self.dim if aligned_layout_wanted() else None,
+            )
 
     def offsets_to_device(self, offsets: np.ndarray) -> Array:
         if self.train_rows is not None:

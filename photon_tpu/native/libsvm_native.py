@@ -19,6 +19,43 @@ def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
 
 
+def _open_indexed(path: str):
+    """Shared prologue: open + line-index a file natively.
+
+    Returns ``(lib, handle, n, nnz)`` — the caller owns ``svm_close`` —
+    or None when the native library is unavailable or the open fails
+    (IO error / empty file: the Python path reports those).  ``nnz`` is
+    None for 0-row files.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    handle = lib.svm_open(path.encode())
+    if not handle:
+        return None
+    n = lib.svm_rows(handle)
+    if n == 0:
+        return lib, handle, 0, None
+    nnz = np.empty(n, np.int64)
+    lib.svm_row_nnz(handle, _ptr(nnz, ctypes.c_int64))
+    return lib, handle, int(n), nnz
+
+
+def scan_meta(path: str) -> Optional[tuple[int, int]]:
+    """(row count, max nnz per row) via the native line indexer only — no
+    value parsing or materialization.  The metadata pass of the streaming
+    pipeline (data/streaming.LibsvmFileSource with a known feature dim);
+    None when the native library is unavailable."""
+    opened = _open_indexed(path)
+    if opened is None:
+        return None
+    lib, handle, n, nnz = opened
+    try:
+        return (n, int(nnz.max())) if n else (0, 0)
+    finally:
+        lib.svm_close(handle)
+
+
 def parse_file(path: str, zero_based: bool = False) -> Optional[tuple]:
     """(rows, labels, dim) or None when the native path is unavailable.
 
@@ -26,18 +63,13 @@ def parse_file(path: str, zero_based: bool = False) -> Optional[tuple]:
     failure behavior rather than silently falling back to it, which would
     parse the bad file a second time just to fail again).
     """
-    lib = get_lib()
-    if lib is None:
+    opened = _open_indexed(path)
+    if opened is None:
         return None
-    handle = lib.svm_open(path.encode())
-    if not handle:
-        return None  # IO error/empty: let the Python path report it
+    lib, handle, n, nnz = opened
     try:
-        n = lib.svm_rows(handle)
         if n == 0:
             return [], np.zeros(0, np.float32), 0
-        nnz = np.empty(n, np.int64)
-        lib.svm_row_nnz(handle, _ptr(nnz, ctypes.c_int64))
         row_ptr = np.zeros(n + 1, np.int64)
         np.cumsum(nnz, out=row_ptr[1:])
         total = int(row_ptr[-1])

@@ -115,3 +115,38 @@ def test_empty_batch():
         np.zeros((4, 3), np.int32), np.zeros((4, 3), np.float32), 64
     )
     assert lay.n_entries == 0 and lay.padding_factor >= 1.0
+
+
+def test_row_aligned_layout_edge_cases():
+    """Transposed (row-dictionary) layout edge cases: n=1, k=1, rows that
+    are entirely padding, and a single hot feature shared by every row."""
+    import jax.numpy as jnp
+
+    from photon_tpu.ops.pallas_gather import (
+        aligned_segment_grad,
+        build_row_aligned_layout,
+        device_layout,
+    )
+
+    rng = np.random.default_rng(9)
+    cases = []
+    # n=1, k=1
+    cases.append((np.array([[3]], np.int32), np.array([[2.0]], np.float32), 8))
+    # k=1 column, several rows
+    cases.append((
+        rng.integers(0, 5, (6, 1)).astype(np.int32),
+        rng.standard_normal((6, 1)).astype(np.float32), 5,
+    ))
+    # middle row entirely padding; one hot feature everywhere else
+    ids = np.full((5, 3), 2, np.int32)
+    vals = rng.standard_normal((5, 3)).astype(np.float32)
+    ids[2] = 0
+    vals[2] = 0.0
+    cases.append((ids, vals, 7))
+    for ids, vals, d in cases:
+        n = ids.shape[0]
+        al_t = device_layout(build_row_aligned_layout(ids, vals))
+        w = jnp.asarray(rng.standard_normal(d), jnp.float32)
+        z = np.asarray(aligned_segment_grad(w, al_t, n, interpret=True))
+        z_ref = (np.asarray(w)[ids] * vals).sum(axis=1)
+        np.testing.assert_allclose(z, z_ref, rtol=2e-5, atol=1e-6)

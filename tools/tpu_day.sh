@@ -20,10 +20,16 @@ mkdir -p "$OUT"
 # Fresh probe (bench.py caches a cpu-fallback verdict for 1h; clear it).
 rm -f "${TMPDIR:-/tmp}/photon_bench_backend_probe.json"
 echo "== probe =="
-timeout 300 python -c "import jax; print(jax.devices())" \
-    > "$OUT/00_probe.txt" 2>&1
-if ! grep -qi "tpu\|axon" "$OUT/00_probe.txt"; then
-    echo "no TPU visible; pack aborted (see $OUT/00_probe.txt)"
+# Gate on the resolved backend, not on output text: JAX's failure warnings
+# mention "tpu" too, and a CPU-only pack must never masquerade as TPU
+# evidence.
+timeout 300 python -c "
+import jax
+print(jax.devices())
+print('BACKEND=' + jax.default_backend())
+" > "$OUT/00_probe.txt" 2>&1
+if ! grep -q "^BACKEND=\(tpu\|axon\)" "$OUT/00_probe.txt"; then
+    echo "no TPU backend resolved; pack aborted (see $OUT/00_probe.txt)"
     exit 1
 fi
 
@@ -31,19 +37,28 @@ echo "== microbench2 (primitive table) =="
 timeout 900 python tools/microbench2.py > "$OUT/01_microbench2.txt" 2>&1
 
 echo "== headline per kernel (cold, then warm) =="
+# Every run pins ALL PHOTON_* knobs it does not intend to vary, so an
+# operator's ambient exports cannot contaminate the labeled files.
+BASE="PHOTON_SPARSE_MARGIN= PHOTON_BENCH_DTYPE=float32 PHOTON_BENCH_SKEW=uniform"
 for kernel in fm pallas autodiff; do
     for pass in cold warm; do
-        PHOTON_SPARSE_GRAD=$kernel timeout 900 python bench.py --headline-only \
+        env $BASE PHOTON_SPARSE_GRAD=$kernel \
+            timeout 900 python bench.py --headline-only \
             > "$OUT/02_headline_${kernel}_${pass}.txt" 2>&1
     done
 done
 # Full-pallas pipeline (forward margins through the transposed layout).
-PHOTON_SPARSE_GRAD=pallas PHOTON_SPARSE_MARGIN=pallas \
+env $BASE PHOTON_SPARSE_GRAD=pallas PHOTON_SPARSE_MARGIN=pallas \
     timeout 900 python bench.py --headline-only \
     > "$OUT/02_headline_pallas_fwd_warm.txt" 2>&1
-# bf16 value storage delta on the best kernel.
-PHOTON_BENCH_DTYPE=bfloat16 timeout 900 python bench.py --headline-only \
+# bf16 value storage delta on the pinned fm kernel.
+env $BASE PHOTON_SPARSE_GRAD=fm PHOTON_BENCH_DTYPE=bfloat16 \
+    timeout 900 python bench.py --headline-only \
     > "$OUT/02_headline_fm_bf16.txt" 2>&1
+# Skewed-ids variant: the aligned layout's robustness case.
+env $BASE PHOTON_SPARSE_GRAD=pallas PHOTON_BENCH_SKEW=zipf \
+    timeout 900 python bench.py --headline-only \
+    > "$OUT/02_headline_pallas_zipf_warm.txt" 2>&1
 
 echo "== configs 1-5 =="
 : > "$OUT/03_configs.txt"
