@@ -295,8 +295,10 @@ def write_container(path: str, schema: dict, records: list, sync: bytes | None =
             f.write(sync)
 
 
-def _read_header(f, path: str) -> tuple[dict, "_Named", bytes]:
-    """Parse the container header; returns (schema, named registry, sync)."""
+def read_header_meta(f, path: str) -> tuple[dict, dict, bytes]:
+    """Parse the container header; returns (schema, metadata map, sync).
+    Leaves ``f`` positioned at the first data block (the offset native
+    decoders start from)."""
     if f.read(4) != MAGIC:
         raise ValueError(f"{path}: not an Avro container file")
     meta = {}
@@ -311,9 +313,18 @@ def _read_header(f, path: str) -> tuple[dict, "_Named", bytes]:
             k = read_string(f)
             meta[k] = read_bytes(f)
     schema = json.loads(meta["avro.schema"].decode())
+    sync = f.read(16)
+    if len(sync) != 16:
+        raise ValueError(f"{path}: truncated container header (sync marker)")
+    return schema, meta, sync
+
+
+def _read_header(f, path: str) -> tuple[dict, "_Named", bytes]:
+    """Parse the container header; returns (schema, named registry, sync)."""
+    schema, _, sync = read_header_meta(f, path)
     named = _Named()
     _register_named(schema, named)
-    return schema, named, f.read(16)
+    return schema, named, sync
 
 
 def _read_blocks(f, schema: dict, named: "_Named", sync: bytes, path: str):

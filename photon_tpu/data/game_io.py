@@ -205,6 +205,15 @@ def read_game_avro(
     files = _input_files(narrow_avro_dir(path))
     build_maps = index_maps is None
 
+    native = _read_native(files, feature_bags, id_columns, index_maps, intercept)
+    if native is not None:
+        label, offset, weight, ids_cols, flat_ids, flat_vals, nnz, vocab, n = native
+        return _assemble_game_read(
+            path, n, label, offset, weight, ids_cols, flat_ids, flat_vals,
+            nnz, vocab if build_maps else None, feature_bags, id_columns,
+            index_maps, intercept,
+        )
+
     # ONE streaming pass: records are decoded lazily (avro_codec.
     # iter_container) and never retained — host memory is bounded by the
     # flat CSR accumulators below (~entry-sized, i.e. the size of the final
@@ -271,7 +280,185 @@ def read_game_avro(
                     m += 1
                 nnz[shard_name].append(m)
             i += 1
-    n = i
+    return _assemble_game_read(
+        path, i, label, offset, weight, ids_cols, flat_ids, flat_vals, nnz,
+        vocab if build_maps else None, feature_bags, id_columns, index_maps,
+        intercept,
+    )
+
+
+def _read_native(files, feature_bags, id_columns, index_maps, intercept):
+    """Columnar native decode of all files (src/avro_game.cpp); returns the
+    same accumulator tuple the Python loop produces, or None whenever any
+    file falls outside the native subset (non-null codec, unexpected field
+    types, missing id columns, stale .so) — the Python reader then runs.
+
+    Per-record Python work is eliminated: the C++ decoder emits flat
+    streams with (name, term) pairs interned in first-seen ENTRY order, so
+    feature-id assignment (a Python dict walk in the record loop) becomes a
+    vocab-sized loop plus numpy remaps — identical ids, values, and
+    ordering (intercept appended last within each record) to the Python
+    path, pinned by tests.
+    """
+    if os.environ.get("PHOTON_TPU_NO_NATIVE_AVRO", "") not in ("", "0"):
+        return None
+    try:
+        from photon_tpu.native import avro_native
+        from photon_tpu.native.build import get_lib
+    except Exception:  # noqa: BLE001 — native is always optional
+        return None
+    if get_lib() is None:
+        return None
+    from photon_tpu.data.index_map import INTERCEPT_KEY, feature_key
+
+    build_maps = index_maps is None
+    bag_fields = set(feature_bags.values())
+
+    # Header-only pre-flight over ALL files: the fallback decision must be
+    # O(files), never O(dataset) — decoding 63 parts natively and then
+    # discovering part 64 is outside the subset would throw that work away
+    # and re-read everything in Python.
+    plans = []
+    try:
+        for fp in files:
+            with open(fp, "rb") as f:
+                schema, meta, sync = avro_codec.read_header_meta(f, fp)
+                data_offset = f.tell()
+            if meta.get("avro.codec", b"null") not in (b"null", b""):
+                return None
+            if not isinstance(schema, dict):
+                return None
+            fields = {fld["name"] for fld in schema.get("fields", [])}
+            id_field_of = {}
+            for col in id_columns:
+                field = f"{col}__id" if f"{col}__id" in fields else col
+                if field not in fields:
+                    return None  # Python path raises the canonical KeyError
+                id_field_of[col] = field
+            compiled = avro_native.compile_schema(
+                schema, bag_fields, set(id_field_of.values()),
+                opt_defaults={"offset": 0.0, "weight": 1.0},
+            )
+            if compiled is None or "response" not in compiled.dbl_slots:
+                return None
+            plans.append((fp, data_offset, sync, compiled, id_field_of))
+    except ValueError:
+        # Malformed header: the Python reader produces the canonical error.
+        return None
+
+    labels, offsets, weights = [], [], []
+    idcols_out: Dict[str, list] = {c: [] for c in id_columns}
+    flat_parts: Dict[str, tuple] = {s: ([], [], []) for s in feature_bags}
+    gvocab = {s: {} for s in feature_bags} if build_maps else None
+    SKIP = -2  # removed entry: intercept-in-data or dropped-by-fixed-map
+    n_total = 0
+
+    for fp, data_offset, sync, compiled, id_field_of in plans:
+        decoded = avro_native.decode_file(fp, data_offset, sync, compiled)
+        if decoded is None:
+            return None
+        n = decoded.n
+        n_total += n
+        labels.append(decoded.doubles["response"].astype(np.float32))
+        offsets.append(
+            decoded.doubles.get("offset", np.zeros(n)).astype(np.float32)
+        )
+        weights.append(
+            decoded.doubles.get("weight", np.ones(n)).astype(np.float32)
+        )
+        for col in id_columns:
+            idcols_out[col].extend(decoded.id_columns[id_field_of[col]].tolist())
+
+        for shard_name, field in feature_bags.items():
+            nnz_f, pair_ids, vals, pairs = decoded.bags[field]
+            nnz_f = nnz_f.astype(np.int64)
+            # Vocab-sized feature-id lookup table (pairs are in first-seen
+            # entry order, so setdefault here reproduces the Python loop's
+            # per-entry first-seen assignment exactly).
+            lut = np.empty(max(len(pairs), 1), np.int64)
+            if build_maps:
+                seen = gvocab[shard_name]
+                for pi, (nm, tm) in enumerate(pairs):
+                    key = feature_key(nm, tm)
+                    lut[pi] = SKIP if key == INTERCEPT_KEY else seen.setdefault(
+                        key, len(seen)
+                    )
+            else:
+                imap = index_maps[shard_name]
+                for pi, (nm, tm) in enumerate(pairs):
+                    key = feature_key(nm, tm)
+                    if key == INTERCEPT_KEY:
+                        lut[pi] = SKIP
+                    else:
+                        fid = imap.get_id(key)
+                        lut[pi] = fid if fid >= 0 else SKIP
+            entry_fids = (
+                lut[pair_ids] if len(pair_ids) else np.empty(0, np.int64)
+            )
+            keep = entry_fids != SKIP
+            nnz_kept = nnz_f
+            if not keep.all():
+                row_idx = np.repeat(np.arange(n, dtype=np.int64), nnz_f)
+                nnz_kept = nnz_f - np.bincount(row_idx[~keep], minlength=n)
+            kept_fids = entry_fids[keep]
+            kept_vals = vals[keep]
+            add_intercept = (build_maps and intercept) or (
+                not build_maps
+                and index_maps[shard_name].intercept_id is not None
+            )
+            if add_intercept:
+                # Intercept entry appended LAST within each record, exactly
+                # like the Python loop: scatter kept entries to their final
+                # per-row positions, then fill the per-row tail slot.
+                final_nnz = nnz_kept + 1
+                total = int(final_nnz.sum())
+                out_ids = np.empty(total, np.int64)
+                out_vals = np.empty(total, np.float32)
+                starts = np.concatenate(([0], np.cumsum(final_nnz)))[:-1]
+                kept_rows = np.repeat(np.arange(n, dtype=np.int64), nnz_kept)
+                kept_starts = np.concatenate(([0], np.cumsum(nnz_kept)))[:-1]
+                idx_in_row = np.arange(
+                    int(nnz_kept.sum()), dtype=np.int64
+                ) - np.repeat(kept_starts, nnz_kept)
+                pos = starts[kept_rows] + idx_in_row
+                out_ids[pos] = kept_fids
+                out_vals[pos] = kept_vals
+                tail = starts + nnz_kept
+                out_ids[tail] = (
+                    -1 if build_maps else index_maps[shard_name].intercept_id
+                )
+                out_vals[tail] = 1.0
+            else:
+                final_nnz, out_ids, out_vals = nnz_kept, kept_fids, kept_vals
+            fids_l, vals_l, nnz_l = flat_parts[shard_name]
+            fids_l.append(out_ids.astype(np.int32))
+            vals_l.append(out_vals.astype(np.float32))
+            nnz_l.append(final_nnz.astype(np.int32))
+
+    def _cat(parts, dtype):
+        return (
+            np.concatenate(parts) if parts else np.empty(0, dtype)
+        ).astype(dtype, copy=False)
+
+    flat_ids = {s: _cat(flat_parts[s][0], np.int32) for s in feature_bags}
+    flat_vals = {s: _cat(flat_parts[s][1], np.float32) for s in feature_bags}
+    nnz = {s: _cat(flat_parts[s][2], np.int32) for s in feature_bags}
+    return (
+        _cat(labels, np.float32), _cat(offsets, np.float32),
+        _cat(weights, np.float32), idcols_out, flat_ids, flat_vals, nnz,
+        gvocab, n_total,
+    )
+
+
+def _assemble_game_read(
+    path, n, label, offset, weight, ids_cols, flat_ids, flat_vals, nnz,
+    vocab, feature_bags, id_columns, index_maps, intercept,
+):
+    """Shared tail of the Python and native read paths: vocab -> index
+    maps, vectorized flat CSR -> padded-COO shards, dataset assembly.
+    Accumulators may be stdlib ``array`` (Python loop) or numpy arrays
+    (native decoder); ``vocab`` is non-None exactly in build-maps mode."""
+    build_maps = vocab is not None
     if n == 0:
         raise NoRecordsError(f"no records in {path!r}")
 
@@ -285,9 +472,9 @@ def read_game_avro(
     shards: Dict[str, SparseShard] = {}
     for shard_name in feature_bags:
         imap = index_maps[shard_name]
-        counts = np.frombuffer(nnz[shard_name], dtype=np.int32).astype(np.int64)
-        ids_f = np.frombuffer(flat_ids[shard_name], dtype=np.int32).copy()
-        vals_f = np.frombuffer(flat_vals[shard_name], dtype=np.float32)
+        counts = np.asarray(nnz[shard_name], dtype=np.int32).astype(np.int64)
+        ids_f = np.array(flat_ids[shard_name], dtype=np.int32)
+        vals_f = np.asarray(flat_vals[shard_name], dtype=np.float32)
         if build_maps and imap.intercept_id is not None:
             ids_f[ids_f < 0] = imap.intercept_id
         k = pad_row_capacity(counts)
@@ -303,9 +490,9 @@ def read_game_avro(
         shards[shard_name] = SparseShard(ids, vals, len(imap))
 
     dataset = GameDataset(
-        label=np.frombuffer(label, dtype=np.float32).copy(),
-        offset=np.frombuffer(offset, dtype=np.float32).copy(),
-        weight=np.frombuffer(weight, dtype=np.float32).copy(),
+        label=np.asarray(label, dtype=np.float32),
+        offset=np.asarray(offset, dtype=np.float32),
+        weight=np.asarray(weight, dtype=np.float32),
         shards=shards,
         id_columns={c: np.asarray(v) for c, v in ids_cols.items()},
     )

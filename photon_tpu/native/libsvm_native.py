@@ -33,12 +33,18 @@ def _open_indexed(path: str):
     handle = lib.svm_open(path.encode())
     if not handle:
         return None
-    n = lib.svm_rows(handle)
-    if n == 0:
-        return lib, handle, 0, None
-    nnz = np.empty(n, np.int64)
-    lib.svm_row_nnz(handle, _ptr(nnz, ctypes.c_int64))
-    return lib, handle, int(n), nnz
+    try:
+        n = lib.svm_rows(handle)
+        if n == 0:
+            return lib, handle, 0, None
+        nnz = np.empty(n, np.int64)
+        lib.svm_row_nnz(handle, _ptr(nnz, ctypes.c_int64))
+        return lib, handle, int(n), nnz
+    except BaseException:
+        # The caller only owns svm_close after a successful return; an
+        # allocation failure here must not leak the mmap + fd.
+        lib.svm_close(handle)
+        raise
 
 
 def scan_meta(path: str) -> Optional[tuple[int, int]]:
