@@ -494,9 +494,10 @@ def main() -> None:
     # numbers must be attributable to a named kernel, not to whichever side
     # of the auto-measurement crossover this run landed on (VERDICT r3
     # weak 2).  Compare kernels explicitly via PHOTON_SPARSE_GRAD=fm|
-    # autodiff|pallas runs.
+    # autodiff|pallas runs.  Default pin: autodiff — measured fastest on
+    # real TPU at the headline shape (KERNEL_NOTES.md round-4 table).
     if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "auto":
-        os.environ["PHOTON_SPARSE_GRAD"] = "fm"
+        os.environ["PHOTON_SPARSE_GRAD"] = "autodiff"
     if len(sys.argv) > 1 and sys.argv[1] == "--stream-scale":
         _stream_scale()
         return

@@ -338,6 +338,7 @@ def _read_native(files, feature_bags, id_columns, index_maps, intercept):
             compiled = avro_native.compile_schema(
                 schema, bag_fields, set(id_field_of.values()),
                 opt_defaults={"offset": 0.0, "weight": 1.0},
+                dbl_fields={"response", "offset", "weight"},
             )
             if compiled is None or "response" not in compiled.dbl_slots:
                 return None
@@ -360,11 +361,13 @@ def _read_native(files, feature_bags, id_columns, index_maps, intercept):
         n = decoded.n
         n_total += n
         labels.append(decoded.doubles["response"].astype(np.float32))
+        off = decoded.doubles.get("offset")
         offsets.append(
-            decoded.doubles.get("offset", np.zeros(n)).astype(np.float32)
+            np.zeros(n, np.float32) if off is None else off.astype(np.float32)
         )
+        wgt = decoded.doubles.get("weight")
         weights.append(
-            decoded.doubles.get("weight", np.ones(n)).astype(np.float32)
+            np.ones(n, np.float32) if wgt is None else wgt.astype(np.float32)
         )
         for col in id_columns:
             idcols_out[col].extend(decoded.id_columns[id_field_of[col]].tolist())

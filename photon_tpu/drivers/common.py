@@ -84,10 +84,12 @@ def maybe_init_distributed(args: argparse.Namespace) -> bool:
     # processes could pick different kernels — different per-shard reduction
     # orders — giving non-identical float results across ranks (VERDICT r3
     # weak 2).  An explicit PHOTON_SPARSE_GRAD (any value but "auto") is the
-    # operator's pin and is respected; otherwise every rank defaults to fm,
-    # the TPU-safe choice.
+    # operator's pin and is respected; otherwise every rank defaults to
+    # autodiff — the measured-fastest kernel on real TPU hardware at the
+    # headline shape (1.881 vs fm's 1.124 steps/s; ops/KERNEL_NOTES.md
+    # round-4 hardware table).
     if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "auto":
-        os.environ["PHOTON_SPARSE_GRAD"] = "fm"
+        os.environ["PHOTON_SPARSE_GRAD"] = "autodiff"
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=args.num_processes,

@@ -97,6 +97,7 @@ def _is_feature_record(items, named: dict) -> bool:
 def compile_schema(
     schema: dict, bag_fields: set, id_fields: set,
     opt_defaults: Optional[dict] = None,
+    dbl_fields: Optional[set] = None,
 ) -> Optional[CompiledSchema]:
     """Record schema -> opcode descriptor; None when any field falls
     outside the native subset (caller then uses the Python reader).
@@ -104,6 +105,8 @@ def compile_schema(
     ``opt_defaults`` maps field name -> value substituted for null in
     ``["null", "double"]`` unions (0.0 when unlisted — matching the Python
     reader's ``rec.get(...) or 0.0`` for offset; weight passes 1.0).
+    ``dbl_fields`` limits which PLAIN double fields are decoded (others
+    are skipped without storage); None decodes all of them.
     """
     if not isinstance(schema, dict) or schema.get("type") != "record":
         return None
@@ -168,9 +171,12 @@ def compile_schema(
                 return None
             continue
         if ftype == "double":
-            out.append(_OP_DOUBLE)
-            dbl_slots[name] = n_dbl
-            n_dbl += 1
+            if dbl_fields is None or name in dbl_fields:
+                out.append(_OP_DOUBLE)
+                dbl_slots[name] = n_dbl
+                n_dbl += 1
+            else:
+                out.append(_OP_SKIP_DOUBLE)
             continue
         if ftype == "string":
             if name in id_fields:

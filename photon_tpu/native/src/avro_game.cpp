@@ -190,7 +190,12 @@ bool decode_record(GavFile* h, Cursor& c) {
           if (c.fail) return false;
           if (n == 0) break;
           if (n < 0) {  // block with byte-size prefix
+            if (n == INT64_MIN) {  // -n would be signed-overflow UB
+              c.fail = true;
+              return false;
+            }
             read_varlong(c);
+            if (c.fail) return false;
             n = -n;
           }
           for (int64_t i = 0; i < n; i++) {
@@ -285,6 +290,10 @@ int64_t gav_decode(void* hp) {
   while (c.p < c.end) {
     int64_t count = read_varlong(c);
     if (c.fail) { h->error = "truncated block header"; return -1; }
+    if (count < 0) {  // would desync n_records from the column lengths
+      h->error = "negative block record count";
+      return -1;
+    }
     int64_t bytes = read_varlong(c);
     if (c.fail || bytes < 0 || bytes > c.end - c.p) {
       h->error = "bad block byte size";

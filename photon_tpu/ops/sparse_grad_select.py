@@ -147,7 +147,9 @@ def select_kernel(
             n = max(n_rows // scale, 64)
             _CACHE[key] = _measure(e, dim, n, with_pallas)
         except Exception:  # noqa: BLE001 — a failed probe must not kill training
-            _CACHE[key] = "fm"  # fm is the TPU-safe default
+            # Measured on real TPU hardware (KERNEL_NOTES.md round-4 table):
+            # autodiff beats fm 1.881 vs 1.124 steps/s at the headline shape.
+            _CACHE[key] = "autodiff"
         import logging
 
         # Logged because auto-selection is a wall-clock measurement: on a

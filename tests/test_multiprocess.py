@@ -297,12 +297,13 @@ def test_two_process_streaming_driver_matches_single(tmp_path):
     assert not os.path.exists(os.path.join(outs[1], "training_summary.json"))
 
     # Kernel pinning (VERDICT r3 weak 2): both ranks started on "auto" and
-    # must have resolved the SAME pinned kernel (the fm default) — never a
-    # per-rank measurement that could mix reduction orders across shards.
+    # must have resolved the SAME pinned kernel (the autodiff default —
+    # measured fastest on real TPU, KERNEL_NOTES.md round-4 table) — never
+    # a per-rank measurement that could mix reduction orders across shards.
     kernels = [
         json.load(open(os.path.join(o, "kernel.json")))["kernel"] for o in outs
     ]
-    assert kernels == ["fm", "fm"], kernels
+    assert kernels == ["autodiff", "autodiff"], kernels
 
 
 GAME_WORKER = r"""

@@ -324,6 +324,55 @@ def test_native_avro_reader_matches_python(tmp_path, monkeypatch):
         np.testing.assert_array_equal(ds_py2.shard(s).vals, ds_nat2.shard(s).vals)
 
 
+def test_native_avro_skips_unwanted_double_fields(tmp_path, monkeypatch):
+    """Extra plain-double fields (e.g. a timestamp) are SKIPPED by the
+    native decoder — OP_SKIP_DOUBLE, no decoded storage — while response/
+    offset/weight and the bags stay byte-exact with the Python reader."""
+    import numpy as np
+
+    from photon_tpu.data import avro_codec
+    from photon_tpu.data.fixtures import make_movielens_like
+    from photon_tpu.data.game_io import read_game_avro, write_game_avro
+
+    data, maps = make_movielens_like(n_users=20, n_items=15, mean_ratings=5)
+    base = str(tmp_path / "base.avro")
+    write_game_avro(base, data, maps)
+    schema, records = avro_codec.read_container(base)
+    schema["fields"].insert(1, {"name": "ts", "type": "double"})
+    for i, rec in enumerate(records):
+        rec["ts"] = 1e9 + i
+    path = str(tmp_path / "with_ts.avro")
+    avro_codec.write_container(path, schema, records)
+
+    bags = {"global": "global", "per_user": "per_user"}
+    cols = ["userId", "itemId"]
+    monkeypatch.setenv("PHOTON_TPU_NO_NATIVE_AVRO", "1")
+    ds_py, _ = read_game_avro(path, bags, cols)
+    monkeypatch.setenv("PHOTON_TPU_NO_NATIVE_AVRO", "0")
+
+    from photon_tpu.native import avro_native
+
+    calls = []
+    real_decode = avro_native.decode_file
+
+    def spy(fp, data_offset, sync, compiled, *a, **kw):
+        # The skipped field must not occupy a decoded double slot.
+        assert "ts" not in compiled.dbl_slots
+        out = real_decode(fp, data_offset, sync, compiled, *a, **kw)
+        calls.append(out is not None)
+        return out
+
+    monkeypatch.setattr(avro_native, "decode_file", spy)
+    ds_nat, _ = read_game_avro(path, bags, cols)
+    assert calls == [True], f"native decoder did not run: {calls}"
+    np.testing.assert_array_equal(ds_py.label, ds_nat.label)
+    np.testing.assert_array_equal(ds_py.offset, ds_nat.offset)
+    np.testing.assert_array_equal(ds_py.weight, ds_nat.weight)
+    for s in bags:
+        np.testing.assert_array_equal(ds_py.shard(s).ids, ds_nat.shard(s).ids)
+        np.testing.assert_array_equal(ds_py.shard(s).vals, ds_nat.shard(s).vals)
+
+
 def test_native_avro_schema_compiler_rejects_unsupported():
     """Schemas outside the native subset compile to None (Python fallback):
     map fields, non-null unions, int id columns."""
