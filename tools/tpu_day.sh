@@ -33,28 +33,40 @@ if ! grep -q "^BACKEND=\(tpu\|axon\)" "$OUT/00_probe.txt"; then
     exit 1
 fi
 
-echo "== microbench2 (primitive table) =="
-timeout 900 python tools/microbench2.py > "$OUT/01_microbench2.txt" 2>&1
-
-echo "== headline per kernel (cold, then warm) =="
+# PRIORITY ORDER (the 2026-07-30 window lasted ~8 minutes): the pallas
+# headline is the only UNMEASURED kernel — fm (1.124 steps/s) and autodiff
+# (1.881) were banked on hardware that day (KERNEL_NOTES.md round-4 table).
+# Bank the unknown first; re-confirm the known later.
 # Every run pins ALL PHOTON_* knobs it does not intend to vary, so an
 # operator's ambient exports cannot contaminate the labeled files.
 BASE="PHOTON_SPARSE_MARGIN= PHOTON_BENCH_DTYPE=float32 PHOTON_BENCH_SKEW=uniform"
-for kernel in fm pallas autodiff; do
+
+echo "== headline: pallas (UNMEASURED — run first) =="
+for pass in cold warm; do
+    env $BASE PHOTON_SPARSE_GRAD=pallas \
+        timeout 900 python bench.py --headline-only \
+        > "$OUT/02_headline_pallas_${pass}.txt" 2>&1
+done
+# Full-pallas pipeline (forward margins through the transposed layout).
+env $BASE PHOTON_SPARSE_GRAD=pallas PHOTON_SPARSE_MARGIN=pallas \
+    timeout 900 python bench.py --headline-only \
+    > "$OUT/02_headline_pallas_fwd_warm.txt" 2>&1
+
+echo "== microbench2 (primitive table) =="
+timeout 900 python tools/microbench2.py > "$OUT/01_microbench2.txt" 2>&1
+
+echo "== headline: remaining kernels/variants =="
+for kernel in fm autodiff; do
     for pass in cold warm; do
         env $BASE PHOTON_SPARSE_GRAD=$kernel \
             timeout 900 python bench.py --headline-only \
             > "$OUT/02_headline_${kernel}_${pass}.txt" 2>&1
     done
 done
-# Full-pallas pipeline (forward margins through the transposed layout).
-env $BASE PHOTON_SPARSE_GRAD=pallas PHOTON_SPARSE_MARGIN=pallas \
+# bf16 value storage delta on the autodiff kernel (the measured default).
+env $BASE PHOTON_SPARSE_GRAD=autodiff PHOTON_BENCH_DTYPE=bfloat16 \
     timeout 900 python bench.py --headline-only \
-    > "$OUT/02_headline_pallas_fwd_warm.txt" 2>&1
-# bf16 value storage delta on the pinned fm kernel.
-env $BASE PHOTON_SPARSE_GRAD=fm PHOTON_BENCH_DTYPE=bfloat16 \
-    timeout 900 python bench.py --headline-only \
-    > "$OUT/02_headline_fm_bf16.txt" 2>&1
+    > "$OUT/02_headline_autodiff_bf16.txt" 2>&1
 # Skewed-ids variant: the aligned layout's robustness case.
 env $BASE PHOTON_SPARSE_GRAD=pallas PHOTON_BENCH_SKEW=zipf \
     timeout 900 python bench.py --headline-only \
