@@ -49,9 +49,9 @@ def _acquire_backend(timeout_s: float | None = None) -> None:
         # when healthy-but-slow; 240s balances that against the wait a
         # genuinely-down tunnel costs (paid once per hour via the cache).
         timeout_s = float(os.environ.get("PHOTON_BENCH_PROBE_TIMEOUT", "240"))
-    # A round runs bench.py once plus five --config invocations; cache the
-    # CPU-FALLBACK outcome (with a TTL) so they don't each wait out the
-    # probe timeout.  A successful TPU probe is deliberately NOT cached:
+    # Cache the CPU-FALLBACK outcome (15-minute TTL) so back-to-back bench
+    # invocations against a dead tunnel re-pay the probe timeout at most
+    # once per TTL window.  A successful TPU probe is deliberately NOT cached:
     # the tunnel can drop mid-round, and a cached "tpu" would skip the
     # subprocess guard and reintroduce the unbounded in-process hang.
     cache_path = os.path.join(
@@ -59,7 +59,10 @@ def _acquire_backend(timeout_s: float | None = None) -> None:
     )
     try:
         st = os.stat(cache_path)
-        if time.time() - st.st_mtime < 3600:
+        # 15-minute TTL: bounds a dead tunnel's probe-timeout cost to one
+        # wait per window, while a recovered tunnel is noticed within 15
+        # minutes (an hour-long TTL once masked a live chip all round).
+        if time.time() - st.st_mtime < 900:
             with open(cache_path) as f:
                 cached = json.load(f)
             if cached.get("platform") == "cpu-fallback":
@@ -490,14 +493,18 @@ def _enable_compilation_cache() -> None:
 def main() -> None:
     _acquire_backend()
     _enable_compilation_cache()
-    # Pin the sparse-gradient kernel unless the operator chose one: bench
-    # numbers must be attributable to a named kernel, not to whichever side
-    # of the auto-measurement crossover this run landed on (VERDICT r3
-    # weak 2).  Compare kernels explicitly via PHOTON_SPARSE_GRAD=fm|
-    # autodiff|pallas runs.  Default pin: autodiff — measured fastest on
-    # real TPU at the headline shape (KERNEL_NOTES.md round-4 table).
+    # Kernel attribution (VERDICT r3 weak 2): every emitted line names the
+    # kernel its numbers belong to.  An explicit PHOTON_SPARSE_GRAD is the
+    # operator's pin; otherwise the headline stays in auto mode but raises
+    # the selection probe's size cap to the FULL headline entry count, so
+    # the one-time eager measurement (ops/sparse_grad_select) compares
+    # fm/autodiff/pallas at the true shape on the live backend and the
+    # round-end number automatically belongs to the day's fastest kernel.
+    # The resolved choice is recorded in the emitted JSON ("kernel").
     if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "auto":
-        os.environ["PHOTON_SPARSE_GRAD"] = "autodiff"
+        os.environ.setdefault(
+            "PHOTON_SPARSE_PROBE_MAX_ENTRIES", str(1 << 25)
+        )
     if len(sys.argv) > 1 and sys.argv[1] == "--stream-scale":
         _stream_scale()
         return
@@ -593,12 +600,23 @@ def main() -> None:
     val_bytes = jnp.dtype(bench_dtype).itemsize
     eff_gb_s = steps_per_sec * nnz * 2 * (4 + val_bytes) / 1e9  # 2 passes x (id + val)
     hbm_gb_s = 819.0  # v5e HBM peak; CPU numbers are sanity-only
+    # Attribute the number to the kernel that actually ran: in auto mode
+    # select_kernel's cache already holds the measured winner for this
+    # shape (the timed steps above used it), so this lookup is a cache hit.
+    kernel = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
+    if kernel == "auto":
+        from photon_tpu.ops.sparse_grad_select import select_kernel
+
+        kernel = "auto:" + select_kernel(
+            nnz, d, n, has_fm=batch.fm is not None,
+            has_aligned=batch.al is not None,
+        )
     _emit("glm_grad_steps_per_sec", steps_per_sec, "steps/s", {
         "rows": n,
         "nnz_per_row": k,
         "dim": d,
         "dtype": bench_dtype,
-        "kernel": os.environ.get("PHOTON_SPARSE_GRAD", "auto"),
+        "kernel": kernel,
         "skew": os.environ.get("PHOTON_BENCH_SKEW", "uniform"),
         "platform": platform,
         "rows_per_sec": round(steps_per_sec * n, 1),

@@ -40,7 +40,22 @@ _CACHE: dict = {}
 
 # Probe arrays are capped so the one-time measurement stays cheap even for
 # billion-entry datasets; relative kernel cost is stable above this size.
+# Overridable (PHOTON_SPARSE_PROBE_MAX_ENTRIES) for callers who want the
+# probe at the true problem shape — bench.py pays ~10 s once to attribute
+# its headline to the kernel that actually wins at full size.
 _PROBE_MAX_ENTRIES = 1 << 21
+
+
+def _probe_cap() -> int:
+    try:
+        cap = int(os.environ.get(
+            "PHOTON_SPARSE_PROBE_MAX_ENTRIES", _PROBE_MAX_ENTRIES
+        ))
+    except ValueError:
+        return _PROBE_MAX_ENTRIES
+    # Clamp: 0 would divide-by-zero in the ceil, negatives would uncap the
+    # probe (a billion-entry dataset would then build a multi-GB probe).
+    return cap if cap >= 1 else _PROBE_MAX_ENTRIES
 
 
 def _bucket(n: int) -> int:
@@ -142,7 +157,7 @@ def select_kernel(
     key = (jax.default_backend(), _bucket(e_total), _bucket(dim), with_pallas)
     if key not in _CACHE:
         try:
-            scale = max(1, -(-e_total // _PROBE_MAX_ENTRIES))  # ceil: cap probe size
+            scale = max(1, -(-e_total // _probe_cap()))  # ceil: cap probe size
             e = max(e_total // scale, 1 << 10)
             n = max(n_rows // scale, 64)
             _CACHE[key] = _measure(e, dim, n, with_pallas)

@@ -373,6 +373,25 @@ def test_select_kernel_availability_fallbacks(monkeypatch):
     assert not sel.aligned_layout_wanted()
 
 
+def test_probe_cap_env_override(monkeypatch):
+    """The selection probe's size cap is env-tunable (bench.py raises it to
+    probe at the true headline shape); garbage values fall back to the
+    default instead of crashing training."""
+    import photon_tpu.ops.sparse_grad_select as sel
+
+    monkeypatch.delenv("PHOTON_SPARSE_PROBE_MAX_ENTRIES", raising=False)
+    assert sel._probe_cap() == sel._PROBE_MAX_ENTRIES
+    monkeypatch.setenv("PHOTON_SPARSE_PROBE_MAX_ENTRIES", "4096")
+    assert sel._probe_cap() == 4096
+    monkeypatch.setenv("PHOTON_SPARSE_PROBE_MAX_ENTRIES", "not-a-number")
+    assert sel._probe_cap() == sel._PROBE_MAX_ENTRIES
+    # 0 would divide-by-zero in the ceil; negatives would uncap the probe.
+    monkeypatch.setenv("PHOTON_SPARSE_PROBE_MAX_ENTRIES", "0")
+    assert sel._probe_cap() == sel._PROBE_MAX_ENTRIES
+    monkeypatch.setenv("PHOTON_SPARSE_PROBE_MAX_ENTRIES", "-5")
+    assert sel._probe_cap() == sel._PROBE_MAX_ENTRIES
+
+
 def test_aligned_layout_survives_astype_and_pad_strip(monkeypatch):
     """batch_astype converts al.vals in place; pad_batch strips al (it is
     row-structure-dependent) so shard_batch rebuilds per-shard fm only."""

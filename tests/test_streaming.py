@@ -239,6 +239,10 @@ def test_stream_scale_bench_mode(tmp_path):
         PHOTON_STREAM_SCALE_ROWS="3000",
         PHOTON_STREAM_SCALE_DIR=str(tmp_path / "data"),
         PHOTON_BENCH_PROBE_TIMEOUT="5",
+        # Isolate the backend-probe cache: without this the test's 5s-probe
+        # cpu-fallback verdict lands in the shared TMPDIR cache and a real
+        # bench run within the TTL would silently skip the TPU probe.
+        TMPDIR=str(tmp_path),
         PHOTON_BENCH_COMPILATION_CACHE=os.environ.get(
             "JAX_COMPILATION_CACHE_DIR", str(tmp_path / "cache")
         ),

@@ -4,8 +4,9 @@
 # Produces, under tools/tpu_day_out/:
 #   00_probe.txt        backend probe (subprocess-guarded, bounded)
 #   01_microbench2.txt  primitive table -> paste into ops/KERNEL_NOTES.md
-#   02_headline_*.txt   bench headline per kernel (fm / pallas / pallas+fwd)
-#                       and bf16 storage, cold then warm
+#   02_headline_*.txt   bench headline per kernel (pallas first — the
+#                       unmeasured one — then fm / autodiff / pallas+fwd)
+#                       and bf16 storage on autodiff, cold then warm
 #   03_configs.txt      bench configs 1-5 (quality anchors)
 #   04_stream_scale.txt streaming-ingestion proof
 #
@@ -17,7 +18,7 @@ cd "$(dirname "$0")/.."
 OUT=tools/tpu_day_out
 mkdir -p "$OUT"
 
-# Fresh probe (bench.py caches a cpu-fallback verdict for 1h; clear it).
+# Fresh probe (bench.py caches a cpu-fallback verdict for 15 min; clear it).
 rm -f "${TMPDIR:-/tmp}/photon_bench_backend_probe.json"
 echo "== probe =="
 # Gate on the resolved backend, not on output text: JAX's failure warnings
