@@ -579,18 +579,41 @@ def main() -> None:
         v, g = obj.value_and_grad(w, batch)
         return w - 1e-3 * g, v
 
+    reps = 20 if platform != "cpu" else 5
+    # PHOTON_BENCH_FUSED=1 runs all reps inside ONE dispatch (lax.scan over
+    # the same chained step) — the shape real fits take (optimizers are
+    # fully jitted while_loops, one dispatch per fit), and the honest view
+    # once per-step time approaches the ~9 ms tunnel dispatch overhead.
+    # Default stays per-step dispatch: comparable with the r1 baseline.
+    fused = os.environ.get("PHOTON_BENCH_FUSED", "0") == "1"
+    if fused:
+        from jax import lax
+
+        @jax.jit
+        def run_all(w, batch):
+            def body(w, _):
+                w2, v = step(w, batch)
+                return w2, v
+            return lax.scan(body, w, None, length=reps)
+
     # Warm up: compile + one execution.  np.asarray (device_get) rather than
     # block_until_ready: on the tunneled TPU platform block_until_ready
     # returns before execution finishes, which once inflated this benchmark
     # ~20000x; a host copy of the result cannot lie.
-    w, v = step(w, batch)
-    np.asarray(w)
-
-    reps = 20 if platform != "cpu" else 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    if fused:
+        w0, vs = run_all(w, batch)
+        np.asarray(w0)
+        t0 = time.perf_counter()
+        w, vs = run_all(w, batch)
+        np.asarray(w)
+        v = vs[-1]
+    else:
         w, v = step(w, batch)
-    np.asarray(w)
+        np.asarray(w)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            w, v = step(w, batch)
+        np.asarray(w)
     wall = time.perf_counter() - t0
     steps_per_sec = reps / wall
 
@@ -617,6 +640,7 @@ def main() -> None:
         "dim": d,
         "dtype": bench_dtype,
         "kernel": kernel,
+        "dispatch": "fused" if fused else "per-step",
         "skew": os.environ.get("PHOTON_BENCH_SKEW", "uniform"),
         "platform": platform,
         "rows_per_sec": round(steps_per_sec * n, 1),

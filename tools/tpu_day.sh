@@ -40,7 +40,7 @@ fi
 # Bank the unknown first; re-confirm the known later.
 # Every run pins ALL PHOTON_* knobs it does not intend to vary, so an
 # operator's ambient exports cannot contaminate the labeled files.
-BASE="PHOTON_SPARSE_MARGIN= PHOTON_BENCH_DTYPE=float32 PHOTON_BENCH_SKEW=uniform"
+BASE="PHOTON_SPARSE_MARGIN= PHOTON_BENCH_DTYPE=float32 PHOTON_BENCH_SKEW=uniform PHOTON_BENCH_FUSED=0"
 
 echo "== headline: pallas (UNMEASURED — run first) =="
 for pass in cold warm; do
@@ -72,6 +72,13 @@ env $BASE PHOTON_SPARSE_GRAD=autodiff PHOTON_BENCH_DTYPE=bfloat16 \
 env $BASE PHOTON_SPARSE_GRAD=pallas PHOTON_BENCH_SKEW=zipf \
     timeout 900 python bench.py --headline-only \
     > "$OUT/02_headline_pallas_zipf_warm.txt" 2>&1
+# Fused dispatch: all reps in one device program (lax.scan) — isolates the
+# ~9 ms/call tunnel dispatch overhead from true device-side step time.
+for kernel in autodiff pallas; do
+    env $BASE PHOTON_SPARSE_GRAD=$kernel PHOTON_BENCH_FUSED=1 \
+        timeout 900 python bench.py --headline-only \
+        > "$OUT/02_headline_${kernel}_fused.txt" 2>&1
+done
 
 echo "== configs 1-5 =="
 : > "$OUT/03_configs.txt"
