@@ -606,7 +606,6 @@ def main() -> None:
         t0 = time.perf_counter()
         w, vs = run_all(w, batch)
         np.asarray(w)
-        v = vs[-1]
     else:
         w, v = step(w, batch)
         np.asarray(w)

@@ -66,6 +66,22 @@ def main():
     res["bwd fast: segment_sum sorted"] = (tm(
         lambda q, i: jnp.sum(jax.ops.segment_sum(
             q, i, num_segments=d, indices_are_sorted=True)), qe, sorted_ids), e)
+    # Lowering-diagnostic variants: if these differ materially from the rows
+    # above, the bottleneck is XLA's choice of lowering, not the hardware.
+    res["bwd alt: scatter-add 2D [n,k] ids"] = (tm(
+        lambda v2, i2: jnp.sum(jnp.zeros(d, jnp.float32).at[i2].add(v2)),
+        vals_j, ids_j), e)
+    res["bwd alt: weighted bincount"] = (tm(
+        lambda q, i: jnp.sum(jnp.bincount(i.reshape(-1), weights=q, length=d)),
+        qe, ids_j), e)
+    # Small-table gather: same 33.5M lookups, 1024-entry (4KB) table.  If
+    # this is fast while the 4MB-table row is slow, gathers are cache/HBM
+    # bound (layout fixes help); if both are slow, the lowering is serial
+    # per element (only an in-kernel gather helps).
+    small = jnp.asarray(rng.standard_normal(1024).astype(np.float32))
+    rows_small = jnp.asarray(((order // k) % 1024).astype(np.int32))
+    res["gather small-table 33.5M from 4KB"] = (tm(
+        lambda t, r: jnp.sum(jnp.take(t, r, axis=0)), small, rows_small), e)
 
     al = al_t = None
     try:
