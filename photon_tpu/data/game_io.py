@@ -354,8 +354,18 @@ def _read_native(files, feature_bags, id_columns, index_maps, intercept):
     SKIP = -2  # removed entry: intercept-in-data or dropped-by-fixed-map
     n_total = 0
 
-    for fp, data_offset, sync, compiled, id_field_of in plans:
-        decoded = avro_native.decode_file(fp, data_offset, sync, compiled)
+    # Decode files on the host-IO pool (the native call releases the GIL);
+    # results are consumed strictly in file order, so first-seen vocab
+    # interning stays byte-identical to a sequential read.
+    from photon_tpu.utils.io_pool import map_ordered
+
+    decoded_iter = map_ordered(
+        lambda plan: avro_native.decode_file(plan[0], plan[1], plan[2], plan[3]),
+        plans,
+    )
+    for (fp, data_offset, sync, compiled, id_field_of), decoded in zip(
+        plans, decoded_iter
+    ):
         if decoded is None:
             return None
         n = decoded.n

@@ -145,21 +145,32 @@ def _run_streaming(args: argparse.Namespace) -> dict:
 
         with logger.timed("validate-data"):
             # Host-side pass over the raw parses: no device round-trip for
-            # data that is streamed precisely because it is large.
-            issues = []
-            for fpath in source.files:
+            # data that is streamed precisely because it is large.  Files
+            # validate on the host-IO pool; issues keep file order.  Each
+            # in-progress file holds a full parse transiently, so cap the
+            # concurrency below the general IO width.
+            from photon_tpu.utils.io_pool import io_threads, map_ordered
+
+            def _file_issues(fpath):
                 data = parse_libsvm(fpath)
                 labels = data.labels
                 if args.task in BINARY_TASKS:
                     labels = normalize_binary_labels(labels)
-                issues.extend(validate_columns(labels, None, None, args.task))
+                out = list(validate_columns(labels, None, None, args.task))
                 if data.rows:
                     allv = np.concatenate([v for _, v in data.rows])
-                    issues.extend(
+                    out.extend(
                         _feature_issues(
                             allv.reshape(-1, 1), os.path.basename(fpath)
                         )
                     )
+                return out
+
+            issues = []
+            for file_issues in map_ordered(
+                _file_issues, source.files, workers=min(io_threads(), 4)
+            ):
+                issues.extend(file_issues)
             if jax.process_count() > 1:
                 # Agreement step: every process must reach the same
                 # pass/fail decision, else a bad shard on one host would

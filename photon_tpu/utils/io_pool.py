@@ -1,0 +1,79 @@
+"""Bounded, ordered host-IO thread pool.
+
+The reference gets per-host decode parallelism from Spark executor threads
+(each partition parsed on its own core — SURVEY.md §2.6); the analog here
+is a small thread pool over FILES/CHUNKS whose native decode calls (ctypes
+releases the GIL) run concurrently while results are consumed strictly in
+submission order — so vocabularies built by first-seen interning stay
+byte-identical to the sequential read.
+
+``PHOTON_IO_THREADS`` sets the pool width (default: the host CPU count,
+capped at 8; 1 disables pooling entirely).  The in-flight window is
+bounded, so memory never scales with the number of files.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def io_threads() -> int:
+    """Configured host-IO parallelism (>= 1)."""
+    try:
+        n = int(os.environ.get("PHOTON_IO_THREADS", 0))
+    except ValueError:
+        n = 0
+    if n >= 1:
+        return n
+    return max(1, min(os.cpu_count() or 1, 8))
+
+
+def map_ordered(
+    fn: Callable[[T], R],
+    items: Sequence[T] | Iterable[T],
+    workers: Optional[int] = None,
+    window: Optional[int] = None,
+) -> Iterator[R]:
+    """``map(fn, items)`` with up to ``workers`` concurrent calls, results
+    yielded strictly in input order, and at most ``window`` calls in flight
+    (default ``2 * workers``) so memory stays bounded.
+
+    With ``workers <= 1`` (or a single item) this degrades to a plain lazy
+    map — no threads, no queueing.  An exception from any call is re-raised
+    at its in-order position.  Abandoning the iterator cancels calls that
+    have not started; calls already RUNNING keep running on pool threads
+    (their results are discarded) and, like any executor thread, are joined
+    at interpreter exit — so ``fn`` should not block indefinitely.
+
+    Concurrency/memory tradeoff is the caller's: up to ``window`` call
+    RESULTS are resident at once (plus ``workers`` in-progress calls'
+    transient memory) — map memory-heavy work through a reducer so the
+    window holds summaries, not payloads.
+    """
+    items = list(items)
+    if workers is None:
+        workers = io_threads()
+    if workers <= 1 or len(items) <= 1:
+        for it in items:
+            yield fn(it)
+        return
+    if window is None:
+        window = 2 * workers
+    window = max(window, 1)
+    ex = ThreadPoolExecutor(max_workers=workers)
+    try:
+        futs: deque = deque()
+        idx = 0
+        while futs or idx < len(items):
+            while idx < len(items) and len(futs) < window:
+                futs.append(ex.submit(fn, items[idx]))
+                idx += 1
+            yield futs.popleft().result()
+    finally:
+        ex.shutdown(wait=False, cancel_futures=True)

@@ -76,7 +76,10 @@ def test_chunked_objective_full_fit_matches():
     np.testing.assert_allclose(np.asarray(r1.w), np.asarray(r2.w), rtol=1e-2, atol=1e-3)
 
 
-def test_stream_chunks_order_and_prefetch():
+def test_stream_chunks_order_and_prefetch(monkeypatch):
+    # Pin to the single-worker prefetch path: its contract includes strict
+    # LOAD order (pooled delivery order is covered by test_io_pool).
+    monkeypatch.setenv("PHOTON_IO_THREADS", "1")
     seen = []
 
     def load(i):
@@ -86,6 +89,32 @@ def test_stream_chunks_order_and_prefetch():
     out = list(stream_chunks(load, 5, prefetch=2))
     assert [int(o[0]) for o in out] == [0, 1, 2, 3, 4]
     assert seen == [0, 1, 2, 3, 4]
+
+
+def test_stream_chunks_pooled_delivery_order(monkeypatch):
+    # Pooled path (multi-core hosts): DELIVERY stays strictly ordered even
+    # when loads finish out of order; device-chunk residency stays bounded
+    # by prefetch (workers are capped to the window).
+    monkeypatch.setenv("PHOTON_IO_THREADS", "4")
+    import threading
+    import time as _time
+
+    lock = threading.Lock()
+    live = [0]
+    peak = [0]
+
+    def load(i):
+        with lock:
+            live[0] += 1
+            peak[0] = max(peak[0], live[0])
+        _time.sleep(0.002 * ((i * 3) % 4))
+        with lock:
+            live[0] -= 1
+        return jnp.full((2,), float(i))
+
+    out = list(stream_chunks(load, 8, prefetch=2))
+    assert [int(o[0]) for o in out] == list(range(8))
+    assert peak[0] <= 2, f"more than prefetch chunks in flight: {peak[0]}"
 
 
 def test_stream_chunks_propagates_worker_error():
