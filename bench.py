@@ -475,19 +475,14 @@ def _enable_compilation_cache() -> None:
     """Persistent XLA compilation cache (repo-local, gitignored): repeat
     bench runs measure compute, not recompilation — the analog of the
     reference benchmarking on a warmed JVM.  First run still compiles."""
-    import jax
+    from photon_tpu.utils.compilation_cache import enable
 
-    cache_dir = os.environ.get(
+    enable(
         "PHOTON_BENCH_COMPILATION_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_bench_cache"),
+        respect_existing=False,  # bench always measures against ITS cache
     )
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception as ex:  # noqa: BLE001 — caching is best-effort
-        print(f"WARNING: compilation cache disabled: {ex}", file=sys.stderr)
 
 
 def main() -> None:

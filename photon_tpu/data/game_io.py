@@ -356,12 +356,15 @@ def _read_native(files, feature_bags, id_columns, index_maps, intercept):
 
     # Decode files on the host-IO pool (the native call releases the GIL);
     # results are consumed strictly in file order, so first-seen vocab
-    # interning stays byte-identical to a sequential read.
-    from photon_tpu.utils.io_pool import map_ordered
+    # interning stays byte-identical to a sequential read.  Each in-flight
+    # decode holds a full file's columns, so cap the concurrency and keep
+    # the result window tight (workers + 1 resident files, not 2*workers).
+    from photon_tpu.utils.io_pool import io_threads, map_ordered
 
+    decode_workers = min(io_threads(), 4)
     decoded_iter = map_ordered(
         lambda plan: avro_native.decode_file(plan[0], plan[1], plan[2], plan[3]),
-        plans,
+        plans, workers=decode_workers, window=decode_workers + 1,
     )
     for (fp, data_offset, sync, compiled, id_field_of), decoded in zip(
         plans, decoded_iter

@@ -227,12 +227,14 @@ def stream_chunks(
     from photon_tpu.utils.io_pool import io_threads, map_ordered
 
     workers = io_threads()
-    if workers > 1 and num_chunks > 1:
-        window = max(1, prefetch)
+    # The pooled path needs prefetch >= 2 to beat the single-worker queue
+    # (with a window of 1 it would serialize load and compute, losing even
+    # the overlap the queue below provides).
+    if workers > 1 and num_chunks > 1 and prefetch >= 2:
         yield from (
             c for c in map_ordered(
                 load_chunk, range(num_chunks),
-                workers=min(workers, window), window=window,
+                workers=min(workers, prefetch), window=prefetch,
             ) if c is not None
         )
         return
@@ -487,7 +489,7 @@ class LibsvmFileSource:
         dim, capacity, total = feature_dim or 0, 1, 0
         if feature_dim is None:
             from photon_tpu.data.libsvm import parse_libsvm
-            from photon_tpu.utils.io_pool import map_ordered
+            from photon_tpu.utils.io_pool import io_threads, map_ordered
 
             def _meta(f):
                 # Reduce INSIDE the worker: the pool's result window then
@@ -496,7 +498,11 @@ class LibsvmFileSource:
                 cap = max((len(r[0]) for r in data.rows), default=1)
                 return data.dim, cap, data.num_examples
 
-            for fdim, fcap, fn_rows in map_ordered(_meta, self.files):
+            # Each in-progress parse holds a whole file transiently: cap
+            # the concurrency (same rationale as the validate-data pass).
+            for fdim, fcap, fn_rows in map_ordered(
+                _meta, self.files, workers=min(io_threads(), 4)
+            ):
                 dim = max(dim, fdim)
                 capacity = max(capacity, fcap)
                 total += fn_rows

@@ -28,6 +28,19 @@ def select_backend(backend: str) -> None:
     if backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
     # "tpu": leave the environment's platform selection alone.
+    _enable_compilation_cache()
+
+
+def _enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache for every driver run
+    (``PHOTON_COMPILATION_CACHE`` overrides the location, ``off`` disables;
+    an already-configured cache dir — tests, bench, the operator — wins)."""
+    from photon_tpu.utils.compilation_cache import enable
+
+    enable(
+        "PHOTON_COMPILATION_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "photon_tpu_xla"),
+    )
 
 
 def add_common_args(parser: argparse.ArgumentParser) -> None:

@@ -1,0 +1,40 @@
+"""Shared persistent-XLA-compilation-cache setup.
+
+One implementation behind both the drivers (every CLI run) and bench.py —
+driver programs are identical run-to-run, so caching them cuts a repeat
+GAME fit from ~14 s to ~3 s on a 1-core host (the analog of the reference
+benefitting from a warmed JVM).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable(
+    env_var: str,
+    default_dir: str,
+    min_compile_secs: float = 0.2,
+    respect_existing: bool = True,
+) -> None:
+    """Point JAX's persistent compilation cache at ``$env_var`` (or
+    ``default_dir``).  ``$env_var`` set to ``0``/``off``/``none``/
+    ``disabled`` disables; with ``respect_existing`` a cache dir already
+    configured (tests, an enclosing tool, the operator) wins.  Best-effort:
+    never raises.
+    """
+    import jax
+
+    spec = os.environ.get(env_var, "")
+    if spec.lower() in ("0", "off", "none", "disabled"):
+        return
+    try:
+        if respect_existing and jax.config.jax_compilation_cache_dir:
+            return
+        jax.config.update("jax_compilation_cache_dir", spec or default_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 — caching is best-effort, never fatal
+        pass

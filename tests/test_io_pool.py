@@ -13,22 +13,26 @@ import pytest
 def test_map_ordered_preserves_order_and_window():
     from photon_tpu.utils.io_pool import map_ordered
 
-    in_flight = [0]
-    peak = [0]
-    lock = threading.Lock()
+    started = []
 
     def work(i):
-        with lock:
-            in_flight[0] += 1
-            peak[0] = max(peak[0], in_flight[0])
+        started.append(i)
         time.sleep(0.002 * (7 - i % 8))  # later items often finish first
-        with lock:
-            in_flight[0] -= 1
         return i * i
 
-    out = list(map_ordered(work, range(40), workers=4, window=6))
+    # The real memory bound is SUBMITTED-but-unconsumed results, not
+    # concurrently-running workers: submission only advances between
+    # yields, so started <= consumed + window must hold at every step
+    # (deleting the window logic would submit all 40 upfront).
+    out = []
+    for r in map_ordered(work, range(40), workers=4, window=6):
+        out.append(r)
+        time.sleep(0.004)  # slow consumer: unbounded submission would race
+        # ahead (workers churn the whole input while we sleep)
+        assert len(started) <= len(out) + 6, (
+            f"window exceeded: {len(started)} started, {len(out)} consumed"
+        )
     assert out == [i * i for i in range(40)]
-    assert peak[0] <= 6, f"window exceeded: {peak[0]} in flight"
 
 
 def test_map_ordered_sequential_fallback_and_errors():
