@@ -99,6 +99,7 @@ def _measure(e: int, d: int, n: int, with_pallas: bool) -> str:
     }
     if with_pallas:
         from photon_tpu.ops.pallas_gather import (
+            aligned_grad_reference,
             aligned_segment_grad,
             build_aligned_layout,
             device_layout,
@@ -115,10 +116,27 @@ def _measure(e: int, d: int, n: int, with_pallas: bool) -> str:
         )
         al = device_layout(layout)
         dz_probe = jnp.asarray(rng.standard_normal(n_probe).astype(np.float32))
-        timings["pallas"] = t(
-            lambda dz: jnp.sum(aligned_segment_grad(dz, al, d, interpret=False)),
-            dz_probe,
-        )
+        # Correctness gate BEFORE timing eligibility: the XLA candidates are
+        # stock lowerings, but pallas is our Mosaic kernel running on
+        # whatever backend is live — validate its full gradient against the
+        # NumPy layout reference once, on-device, and disqualify on any
+        # mismatch rather than silently corrupting production training.
+        g_dev = np.asarray(aligned_segment_grad(dz_probe, al, d, interpret=False))
+        g_ref = aligned_grad_reference(np.asarray(dz_probe), layout, d)
+        scale = max(float(np.abs(g_ref).max()), 1.0)
+        if np.allclose(g_dev, g_ref, rtol=2e-4, atol=1e-4 * scale):
+            timings["pallas"] = t(
+                lambda dz: jnp.sum(aligned_segment_grad(dz, al, d, interpret=False)),
+                dz_probe,
+            )
+        else:
+            import logging
+
+            logging.getLogger("photon_tpu.sparse_grad").warning(
+                "pallas kernel FAILED the on-device correctness gate "
+                "(max abs err %.3g); excluded from auto selection",
+                float(np.abs(g_dev - g_ref).max()),
+            )
     return min(timings, key=timings.get)
 
 

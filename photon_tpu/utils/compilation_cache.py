@@ -36,5 +36,9 @@ def enable(
             "jax_persistent_cache_min_compile_time_secs", min_compile_secs
         )
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:  # noqa: BLE001 — caching is best-effort, never fatal
-        pass
+    except Exception as ex:  # noqa: BLE001 — caching is best-effort, never fatal
+        import logging
+
+        logging.getLogger("photon_tpu.compilation_cache").warning(
+            "persistent compilation cache disabled: %s", ex
+        )

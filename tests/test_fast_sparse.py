@@ -373,6 +373,32 @@ def test_select_kernel_availability_fallbacks(monkeypatch):
     assert not sel.aligned_layout_wanted()
 
 
+def test_measure_correctness_gate_excludes_bad_pallas(monkeypatch):
+    """A Mosaic kernel that miscompiles on the live backend must be
+    DISQUALIFIED by the probe's on-device correctness gate, never timed
+    into production eligibility; a correct kernel passes the gate."""
+    import numpy as np
+
+    import photon_tpu.ops.pallas_gather as pg
+    import photon_tpu.ops.sparse_grad_select as sel
+
+    real = pg.aligned_segment_grad
+
+    def garbage(per_row, al, dim, interpret=None):
+        return real(per_row, al, dim, interpret=True) + 1.0  # wrong output
+
+    def correct(per_row, al, dim, interpret=None):
+        return real(per_row, al, dim, interpret=True)  # CPU-safe, right math
+
+    monkeypatch.setattr(pg, "aligned_segment_grad", garbage)
+    choice = sel._measure(1 << 12, 256, 256, with_pallas=True)
+    assert choice in ("fm", "autodiff"), "garbage pallas must be excluded"
+
+    monkeypatch.setattr(pg, "aligned_segment_grad", correct)
+    choice2 = sel._measure(1 << 12, 256, 256, with_pallas=True)
+    assert choice2 in ("fm", "autodiff", "pallas")  # gate passed; timing decides
+
+
 def test_probe_cap_env_override(monkeypatch):
     """The selection probe's size cap is env-tunable (bench.py raises it to
     probe at the true headline shape); garbage values fall back to the

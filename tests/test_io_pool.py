@@ -3,7 +3,6 @@ exception propagation — and the pooled read paths (native Avro, streamed
 chunks) must be byte-identical to their sequential reads.
 """
 
-import threading
 import time
 
 import numpy as np
