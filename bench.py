@@ -137,7 +137,7 @@ def _build_batch(n: int, k: int, d: int, seed: int = 0):
         label=jnp.asarray(label),
         offset=jnp.zeros(n, jnp.float32),
         weight=jnp.ones(n, jnp.float32),
-    ), aligned_dim=d if aligned_layout_wanted() else None)
+    ), aligned_dim=d if aligned_layout_wanted(n * k) else None)
 
 
 def _emit(metric: str, value: float, unit: str, detail: dict) -> None:

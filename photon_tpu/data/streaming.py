@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import queue
 import threading
 from typing import Callable, Iterable, Iterator, List, NamedTuple, Optional, Sequence
@@ -541,7 +542,15 @@ class LibsvmFileSource:
         return batch
 
     def chunk_iter_factory(self) -> Iterable[SparseBatch]:
-        return stream_chunks(self._load_chunk, len(self.files))
+        # PHOTON_STREAM_PREFETCH raises the in-flight chunk window (each
+        # chunk is device-resident, so this trades device memory for host
+        # parse parallelism on multi-core hosts — see stream_chunks).
+        from photon_tpu.utils.env import env_int
+
+        return stream_chunks(
+            self._load_chunk, len(self.files),
+            prefetch=env_int("PHOTON_STREAM_PREFETCH", 2, minimum=1),
+        )
 
 
 # ---------------------------------------------------------------------------

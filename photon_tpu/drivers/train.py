@@ -331,7 +331,9 @@ def run(args: argparse.Namespace) -> dict:
             # The slab-aligned layout (Pallas kernel eligibility) is built
             # only when the selector could actually route to it.
             batch = attach_feature_major(
-                batch, aligned_dim=dim if aligned_layout_wanted() else None
+                batch,
+                aligned_dim=dim
+                if aligned_layout_wanted(int(batch.ids.size)) else None,
             )
 
     if args.dtype != "float32":

@@ -245,9 +245,10 @@ class FixedEffectDeviceData:
             # sparse solve, so it gets the same Pallas-kernel eligibility
             # as the legacy driver (aligned layouts only when the selector
             # could route to them).
+            e_total = int(self.batch.ids.size)
             self.batch = attach_feature_major(
                 self.batch,
-                aligned_dim=self.dim if aligned_layout_wanted() else None,
+                aligned_dim=self.dim if aligned_layout_wanted(e_total) else None,
             )
 
     def offsets_to_device(self, offsets: np.ndarray) -> Array:

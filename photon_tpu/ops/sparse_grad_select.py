@@ -47,15 +47,20 @@ _PROBE_MAX_ENTRIES = 1 << 21
 
 
 def _probe_cap() -> int:
-    try:
-        cap = int(os.environ.get(
-            "PHOTON_SPARSE_PROBE_MAX_ENTRIES", _PROBE_MAX_ENTRIES
-        ))
-    except ValueError:
-        return _PROBE_MAX_ENTRIES
-    # Clamp: 0 would divide-by-zero in the ceil, negatives would uncap the
-    # probe (a billion-entry dataset would then build a multi-GB probe).
-    return cap if cap >= 1 else _PROBE_MAX_ENTRIES
+    # Clamp at 1: 0 would divide-by-zero in the ceil, negatives would uncap
+    # the probe (a billion-entry dataset would then build a multi-GB probe).
+    from photon_tpu.utils.env import env_int
+
+    return env_int(
+        "PHOTON_SPARSE_PROBE_MAX_ENTRIES", _PROBE_MAX_ENTRIES, minimum=1
+    )
+
+
+def _probe_floor() -> int:
+    # 0 (or negative == default-out) disables the floor entirely.
+    from photon_tpu.utils.env import env_int
+
+    return env_int("PHOTON_SPARSE_PROBE_FLOOR", 1 << 20, minimum=0)
 
 
 def _bucket(n: int) -> int:
@@ -171,6 +176,13 @@ def select_kernel(
         return "pallas" if has_aligned else ("fm" if has_fm else "autodiff")
     import jax
 
+    # Probe floor: below ~1M entries the eager measurement costs more than
+    # any kernel difference could repay (GAME runs hit MANY small shape
+    # buckets — one probe each), and autodiff is the measured winner on
+    # both real TPU and CPU at small scale (KERNEL_NOTES round-4 table).
+    if e_total < _probe_floor():
+        return "autodiff"
+
     with_pallas = has_aligned and _pallas_eligible()
     key = (jax.default_backend(), _bucket(e_total), _bucket(dim), with_pallas)
     if key not in _CACHE:
@@ -202,16 +214,20 @@ def select_kernel(
     return choice
 
 
-def aligned_layout_wanted() -> bool:
+def aligned_layout_wanted(e_total: int | None = None) -> bool:
     """Should batch builders pay the host-side aligned-layout construction?
     True when the pallas kernel is forced, or could win auto-selection on
     this backend (TPU + Mosaic lowers the reduce kernel).  Builders call
     this so CPU runs never pay the bin-packing cost for a kernel auto mode
-    will not pick."""
+    will not pick.  Pass the entry count when known: below the probe floor
+    auto mode is guaranteed to run autodiff, so the build would be pure
+    wasted host time."""
     mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
     if mode == "pallas":
         return True
     if mode != "auto":
+        return False
+    if e_total is not None and e_total < _probe_floor():
         return False
     try:
         return _pallas_eligible()

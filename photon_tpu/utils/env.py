@@ -1,0 +1,23 @@
+"""Tiny env-var parsing helpers shared by the tuning knobs."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
+    """``int(os.environ[name])`` with explicit fallback rules: unset or
+    unparsable returns ``default``; a parsed value below ``minimum`` (when
+    given) also returns ``default`` — every knob states its clamp here
+    instead of hand-rolling a subtly different one."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        return default
+    if minimum is not None and val < minimum:
+        return default
+    return val

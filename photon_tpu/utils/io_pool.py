@@ -24,14 +24,12 @@ R = TypeVar("R")
 
 
 def io_threads() -> int:
-    """Configured host-IO parallelism (>= 1)."""
-    try:
-        n = int(os.environ.get("PHOTON_IO_THREADS", 0))
-    except ValueError:
-        n = 0
-    if n >= 1:
-        return n
-    return max(1, min(os.cpu_count() or 1, 8))
+    """Configured host-IO parallelism (>= 1); unset/invalid falls back to
+    the host CPU count, capped at 8."""
+    from photon_tpu.utils.env import env_int
+
+    default = max(1, min(os.cpu_count() or 1, 8))
+    return env_int("PHOTON_IO_THREADS", default, minimum=1)
 
 
 def map_ordered(

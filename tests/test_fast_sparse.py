@@ -136,6 +136,9 @@ def test_sparse_grad_kernel_selection(monkeypatch):
     import photon_tpu.core.objective as obj_mod
     import photon_tpu.ops.sparse_grad_select as sel
 
+    # Drop the probe floor so this tiny problem exercises the measured
+    # path (production small shapes short-circuit to autodiff).
+    monkeypatch.setenv("PHOTON_SPARSE_PROBE_FLOOR", "0")
     n, k, d = 256, 4, 64
     batch = attach_feature_major(_random_batch(n, k, d, seed=20))
     obj = GlmObjective.create("logistic")
@@ -361,6 +364,9 @@ def test_select_kernel_availability_fallbacks(monkeypatch):
     assert sel.select_kernel(1024, 64, 256, has_fm=False, has_aligned=False) == "autodiff"
     assert sel.select_kernel(1024, 64, 256, has_fm=False, has_aligned=True) == "pallas"
     monkeypatch.setenv("PHOTON_SPARSE_GRAD", "auto")
+    # Drop the floor so the 1024-entry call reaches the MEASURED path —
+    # the pallas-exclusion assertion is about the probe, not the floor.
+    monkeypatch.setenv("PHOTON_SPARSE_PROBE_FLOOR", "0")
     sel._CACHE.clear()
     choice = sel.select_kernel(1024, 64, 256, has_fm=True, has_aligned=True)
     assert choice in ("fm", "autodiff"), "CPU auto must exclude pallas"
@@ -416,6 +422,30 @@ def test_probe_cap_env_override(monkeypatch):
     assert sel._probe_cap() == sel._PROBE_MAX_ENTRIES
     monkeypatch.setenv("PHOTON_SPARSE_PROBE_MAX_ENTRIES", "-5")
     assert sel._probe_cap() == sel._PROBE_MAX_ENTRIES
+
+
+def test_probe_floor_skips_measurement_for_small_problems(monkeypatch):
+    """Below the probe floor auto mode returns autodiff WITHOUT running the
+    eager measurement (GAME runs hit many small shape buckets; a probe per
+    bucket costs more than any kernel difference repays)."""
+    import photon_tpu.ops.sparse_grad_select as sel
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "auto")
+
+    def boom(*a, **k):
+        raise AssertionError("probe must not run below the floor")
+
+    monkeypatch.setattr(sel, "_measure", boom)
+    sel._CACHE.clear()
+    assert sel.select_kernel(1 << 10, 64, 256, has_fm=True) == "autodiff"
+    # At/above the floor the measurement DOES run (here: boom fires, and
+    # select_kernel's failure fallback also resolves to autodiff — assert
+    # via the cache to distinguish the probed path from the floor path).
+    monkeypatch.setenv("PHOTON_SPARSE_PROBE_FLOOR", "512")
+    sel._CACHE.clear()
+    assert sel.select_kernel(1 << 10, 64, 256, has_fm=True) == "autodiff"
+    assert sel._CACHE, "above the floor the probe path must engage"
+    sel._CACHE.clear()
 
 
 def test_aligned_layout_survives_astype_and_pad_strip(monkeypatch):
