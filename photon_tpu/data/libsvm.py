@@ -88,6 +88,80 @@ def normalize_binary_labels(labels: np.ndarray) -> np.ndarray:
     return out
 
 
+def parse_csr_or_none(path: str):
+    """Native flat-CSR parse, or None when the native library is absent or
+    cannot handle the file — malformed input still raises (ValueError), so
+    bad files fail loudly instead of being re-parsed by the fallback just
+    to fail again.  The ONE home of the fallback policy for CSR consumers
+    (streaming chunk loads, metadata scans)."""
+    try:
+        from photon_tpu.native import libsvm_native
+
+        return libsvm_native.parse_file_csr(path)
+    except ValueError:
+        raise
+    except Exception:  # noqa: BLE001 — native unavailable: caller falls back
+        return None
+
+
+def csr_to_sparse_batch(
+    labels: np.ndarray,
+    row_ptr: np.ndarray,
+    flat_ids: np.ndarray,
+    flat_vals: np.ndarray,
+    dim: int | None = None,
+    intercept: bool = True,
+    capacity: int | None = None,
+    binary_labels: bool = True,
+) -> tuple["SparseBatch", int]:
+    """Vectorized flat-CSR -> padded SparseBatch (the hot streaming path;
+    byte-identical output to :func:`to_sparse_batch` over the same rows,
+    without materializing n per-row arrays).
+
+    ``dim`` is the feature dimension BEFORE the intercept column; defaults
+    to ``flat_ids.max() + 1``.  ``capacity`` counts the intercept slot when
+    ``intercept=True``, exactly like the rows-based builder.
+    """
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import pad_row_capacity
+
+    n = int(row_ptr.shape[0]) - 1
+    d = int(dim) if dim is not None else (
+        int(flat_ids.max()) + 1 if flat_ids.size else 0
+    )
+    nnz = np.diff(row_ptr)
+    k_row = nnz + (1 if intercept else 0)
+    k = capacity if capacity is not None else pad_row_capacity(k_row)
+    if n and int(k_row.max()) > k:
+        raise ValueError(
+            f"row with {int(k_row.max())} nonzeros exceeds capacity {k}; "
+            f"raise `capacity` instead of truncating features"
+        )
+    ids = np.zeros((n, k), dtype=np.int32)
+    vals = np.zeros((n, k), dtype=np.float32)
+    if flat_ids.size:
+        row_of = np.repeat(np.arange(n, dtype=np.int64), nnz)
+        within = np.arange(flat_ids.size, dtype=np.int64) - np.repeat(
+            row_ptr[:-1], nnz
+        )
+        ids[row_of, within] = flat_ids
+        vals[row_of, within] = flat_vals
+    if intercept and n:
+        rows_idx = np.arange(n, dtype=np.int64)
+        ids[rows_idx, nnz] = d
+        vals[rows_idx, nnz] = 1.0
+    out_labels = normalize_binary_labels(labels) if binary_labels else labels
+    batch = SparseBatch(
+        ids=jnp.asarray(ids),
+        vals=jnp.asarray(vals),
+        label=jnp.asarray(np.asarray(out_labels, np.float32)),
+        offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+    )
+    return batch, d + (1 if intercept else 0)
+
+
 def to_sparse_batch(
     data: LibsvmData,
     dim: int | None = None,

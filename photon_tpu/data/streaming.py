@@ -495,6 +495,14 @@ class LibsvmFileSource:
             def _meta(f):
                 # Reduce INSIDE the worker: the pool's result window then
                 # holds 3-int tuples, not whole parsed files.
+                from photon_tpu.data.libsvm import parse_csr_or_none
+
+                csr = parse_csr_or_none(f)
+                if csr is not None:
+                    _, row_ptr, _, _, fdim = csr
+                    counts = np.diff(row_ptr)
+                    cap = int(counts.max()) if counts.size else 1
+                    return fdim, max(cap, 1), int(row_ptr.shape[0]) - 1
                 data = parse_libsvm(f)
                 cap = max((len(r[0]) for r in data.rows), default=1)
                 return data.dim, cap, data.num_examples
@@ -527,8 +535,26 @@ class LibsvmFileSource:
         return out
 
     def _load_chunk(self, i: int) -> SparseBatch:
-        from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
+        from photon_tpu.data.libsvm import (
+            csr_to_sparse_batch,
+            parse_csr_or_none,
+            parse_libsvm,
+            to_sparse_batch,
+        )
 
+        # Flat-CSR fast path: skips materializing n per-row numpy views,
+        # which costs more than the C++ parse itself at streaming scale.
+        csr = parse_csr_or_none(self.files[i])
+        if csr is not None:
+            labels, row_ptr, flat_ids, flat_vals, _ = csr
+            batch, _ = csr_to_sparse_batch(
+                labels, row_ptr, flat_ids, flat_vals,
+                dim=self.feature_dim,
+                intercept=self.intercept,
+                capacity=self.capacity,
+                binary_labels=self.binary_labels,
+            )
+            return batch
         data = parse_libsvm(self.files[i])
         # self.capacity already counts the appended intercept column; the
         # padding in to_sparse_batch applies after that append.

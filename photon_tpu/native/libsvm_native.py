@@ -62,6 +62,42 @@ def scan_meta(path: str) -> Optional[tuple[int, int]]:
         lib.svm_close(handle)
 
 
+def parse_file_csr(path: str, zero_based: bool = False) -> Optional[tuple]:
+    """Flat-CSR parse: ``(labels, row_ptr, ids, vals, dim)`` — no per-row
+    materialization.  The hot-path variant of :func:`parse_file` for
+    consumers that pad/assemble vectorized (building n per-row numpy views
+    costs more than the C++ parse itself at streaming scale); None when the
+    native library is unavailable.  Raises ValueError on malformed input.
+    """
+    opened = _open_indexed(path)
+    if opened is None:
+        return None
+    lib, handle, n, nnz = opened
+    try:
+        if n == 0:
+            return (np.zeros(0, np.float32), np.zeros(1, np.int64),
+                    np.zeros(0, np.int32), np.zeros(0, np.float32), 0)
+        row_ptr = np.zeros(n + 1, np.int64)
+        np.cumsum(nnz, out=row_ptr[1:])
+        total = int(row_ptr[-1])
+        labels = np.empty(n, np.float32)
+        ids = np.empty(total, np.int32)
+        vals = np.empty(total, np.float32)
+        max_id = lib.svm_parse(
+            handle,
+            _ptr(row_ptr, ctypes.c_int64),
+            _ptr(labels, ctypes.c_float),
+            _ptr(ids, ctypes.c_int32),
+            _ptr(vals, ctypes.c_float),
+            1 if zero_based else 0,
+        )
+        if max_id == -2:
+            raise ValueError(f"{path}: malformed LIBSVM input")
+        return labels, row_ptr, ids, vals, int(max_id) + 1
+    finally:
+        lib.svm_close(handle)
+
+
 def parse_file(path: str, zero_based: bool = False) -> Optional[tuple]:
     """(rows, labels, dim) or None when the native path is unavailable.
 

@@ -438,6 +438,10 @@ def test_probe_floor_skips_measurement_for_small_problems(monkeypatch):
     monkeypatch.setattr(sel, "_measure", boom)
     sel._CACHE.clear()
     assert sel.select_kernel(1 << 10, 64, 256, has_fm=True) == "autodiff"
+    # The cache stays empty on the floor path — if the floor were removed,
+    # boom would fire into select_kernel's failure fallback, which ALSO
+    # returns autodiff but caches it; the cache is the discriminator.
+    assert not sel._CACHE, "below the floor the probe path must not engage"
     # At/above the floor the measurement DOES run (here: boom fires, and
     # select_kernel's failure fallback also resolves to autodiff — assert
     # via the cache to distinguish the probed path from the floor path).

@@ -116,6 +116,48 @@ def test_stream_chunks_pooled_delivery_order(monkeypatch):
     assert [int(o[0]) for o in out] == list(range(8))
 
 
+def test_csr_chunk_path_matches_rows_path(tmp_path):
+    """The flat-CSR fast chunk loader must produce byte-identical batches
+    to the rows-based builder (same padding, intercept column, label
+    normalization), and reject malformed input with the same error."""
+    import numpy as np
+
+    from photon_tpu.data.libsvm import (
+        csr_to_sparse_batch,
+        parse_libsvm,
+        to_sparse_batch,
+    )
+    from photon_tpu.native import libsvm_native
+
+    p = str(tmp_path / "part.libsvm")
+    with open(p, "w") as f:
+        f.write("1 3:0.5 7:-1.25\n")
+        f.write("-1 1:2.0\n")
+        f.write("1 2:1.0 4:4.0 9:0.125\n")
+
+    csr = libsvm_native.parse_file_csr(p)
+    if csr is None:
+        pytest.skip("native library unavailable (source-only checkout)")
+    labels, row_ptr, ids, vals, dim = csr
+    b_csr, d_csr = csr_to_sparse_batch(
+        labels, row_ptr, ids, vals, dim=dim, intercept=True, capacity=8
+    )
+    b_rows, d_rows = to_sparse_batch(
+        parse_libsvm(p), dim=dim, intercept=True, capacity=8
+    )
+    assert d_csr == d_rows
+    np.testing.assert_array_equal(b_csr.ids, b_rows.ids)
+    np.testing.assert_array_equal(b_csr.vals, b_rows.vals)
+    np.testing.assert_array_equal(b_csr.label, b_rows.label)
+    np.testing.assert_array_equal(b_csr.weight, b_rows.weight)
+
+    bad = str(tmp_path / "bad.libsvm")
+    with open(bad, "w") as f:
+        f.write("1 3:\n")
+    with pytest.raises(ValueError):
+        libsvm_native.parse_file_csr(bad)
+
+
 def test_stream_chunks_propagates_worker_error():
     def load(i):
         if i == 2:
