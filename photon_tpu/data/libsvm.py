@@ -104,6 +104,38 @@ def parse_csr_or_none(path: str):
         return None
 
 
+def load_sparse_batch(
+    path: str,
+    dim: int | None = None,
+    intercept: bool = True,
+    capacity: int | None = None,
+    binary_labels: bool = True,
+) -> tuple["SparseBatch", int, int]:
+    """Parse + pad one LIBSVM file: ``(batch, total_dim, raw_dim)``.
+
+    THE one home of the flat-CSR-or-rows branch: tries the native CSR fast
+    path (no per-row materialization) and falls back to the rows-based
+    builder when the native library is absent; both produce byte-identical
+    batches.  ``raw_dim`` is the file's feature dimension before the
+    intercept column (callers build index maps from it)."""
+    csr = parse_csr_or_none(path)
+    if csr is not None:
+        labels, row_ptr, flat_ids, flat_vals, raw_dim = csr
+        batch, total_dim = csr_to_sparse_batch(
+            labels, row_ptr, flat_ids, flat_vals,
+            dim=raw_dim if dim is None else dim,
+            intercept=intercept, capacity=capacity,
+            binary_labels=binary_labels,
+        )
+        return batch, total_dim, raw_dim
+    data = parse_libsvm(path)
+    batch, total_dim = to_sparse_batch(
+        data, dim=dim, intercept=intercept, capacity=capacity,
+        binary_labels=binary_labels,
+    )
+    return batch, total_dim, data.dim
+
+
 def csr_to_sparse_batch(
     labels: np.ndarray,
     row_ptr: np.ndarray,

@@ -535,31 +535,13 @@ class LibsvmFileSource:
         return out
 
     def _load_chunk(self, i: int) -> SparseBatch:
-        from photon_tpu.data.libsvm import (
-            csr_to_sparse_batch,
-            parse_csr_or_none,
-            parse_libsvm,
-            to_sparse_batch,
-        )
+        from photon_tpu.data.libsvm import load_sparse_batch
 
-        # Flat-CSR fast path: skips materializing n per-row numpy views,
-        # which costs more than the C++ parse itself at streaming scale.
-        csr = parse_csr_or_none(self.files[i])
-        if csr is not None:
-            labels, row_ptr, flat_ids, flat_vals, _ = csr
-            batch, _ = csr_to_sparse_batch(
-                labels, row_ptr, flat_ids, flat_vals,
-                dim=self.feature_dim,
-                intercept=self.intercept,
-                capacity=self.capacity,
-                binary_labels=self.binary_labels,
-            )
-            return batch
-        data = parse_libsvm(self.files[i])
-        # self.capacity already counts the appended intercept column; the
-        # padding in to_sparse_batch applies after that append.
-        batch, _ = to_sparse_batch(
-            data,
+        # Flat-CSR fast path inside (skips per-row numpy views, which cost
+        # more than the C++ parse at streaming scale); self.capacity
+        # already counts the appended intercept column.
+        batch, _, _ = load_sparse_batch(
+            self.files[i],
             dim=self.feature_dim,
             intercept=self.intercept,
             capacity=self.capacity,

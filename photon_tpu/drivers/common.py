@@ -238,9 +238,12 @@ def load_dataset(
 
     if not os.path.exists(spec):
         raise FileNotFoundError(f"--input {spec} does not exist")
-    data = parse_libsvm(spec)
-    batch, dim = to_sparse_batch(data, intercept=intercept, binary_labels=binary)
-    keys = [feature_key(f"f{i}") for i in range(data.dim)]
+    from photon_tpu.data.libsvm import load_sparse_batch
+
+    batch, dim, raw_dim = load_sparse_batch(
+        spec, intercept=intercept, binary_labels=binary
+    )
+    keys = [feature_key(f"f{i}") for i in range(raw_dim)]
     return batch, dim, IndexMap.build(keys, intercept=intercept)
 
 
@@ -271,18 +274,18 @@ def load_validation(
         if dim != train_dim:
             raise ValueError(f"validation dim {dim} != train dim {train_dim}")
         return batch
-    from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
+    from photon_tpu.data.libsvm import load_sparse_batch
 
-    data = parse_libsvm(spec)
     feature_dim = train_dim - (1 if intercept else 0)
-    if data.dim > feature_dim:
-        raise ValueError(
-            f"validation data has feature id {data.dim - 1} >= train dim {feature_dim}"
-        )
-    batch, _ = to_sparse_batch(
-        data, dim=feature_dim, intercept=intercept,
+    batch, _, raw_dim = load_sparse_batch(
+        spec, dim=feature_dim, intercept=intercept,
         binary_labels=task in BINARY_TASKS,
     )
+    if raw_dim > feature_dim:
+        raise ValueError(
+            f"validation data has feature id {raw_dim - 1} >= "
+            f"train dim {feature_dim}"
+        )
     return batch
 
 

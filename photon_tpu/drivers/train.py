@@ -152,13 +152,22 @@ def _run_streaming(args: argparse.Namespace) -> dict:
             from photon_tpu.utils.io_pool import io_threads, map_ordered
 
             def _file_issues(fpath):
-                data = parse_libsvm(fpath)
-                labels = data.labels
+                from photon_tpu.data.libsvm import parse_csr_or_none
+
+                csr = parse_csr_or_none(fpath)
+                if csr is not None:  # flat values, no per-row views
+                    labels, _, _, allv, _ = csr
+                else:
+                    data = parse_libsvm(fpath)
+                    labels = data.labels
+                    allv = (
+                        np.concatenate([v for _, v in data.rows])
+                        if data.rows else np.zeros(0, np.float32)
+                    )
                 if args.task in BINARY_TASKS:
                     labels = normalize_binary_labels(labels)
                 out = list(validate_columns(labels, None, None, args.task))
-                if data.rows:
-                    allv = np.concatenate([v for _, v in data.rows])
+                if allv.size:
                     out.extend(
                         _feature_issues(
                             allv.reshape(-1, 1), os.path.basename(fpath)
