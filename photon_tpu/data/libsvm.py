@@ -110,6 +110,7 @@ def load_sparse_batch(
     intercept: bool = True,
     capacity: int | None = None,
     binary_labels: bool = True,
+    max_feature_dim: int | None = None,
 ) -> tuple["SparseBatch", int, int]:
     """Parse + pad one LIBSVM file: ``(batch, total_dim, raw_dim)``.
 
@@ -117,10 +118,22 @@ def load_sparse_batch(
     path (no per-row materialization) and falls back to the rows-based
     builder when the native library is absent; both produce byte-identical
     batches.  ``raw_dim`` is the file's feature dimension before the
-    intercept column (callers build index maps from it)."""
+    intercept column (callers build index maps from it).
+
+    ``max_feature_dim`` raises ValueError BEFORE padding when the file's
+    raw dimension exceeds it — validation loads reject oversized files
+    without paying the pad + device transfer for a batch they discard."""
+
+    def _check(raw_dim: int) -> None:
+        if max_feature_dim is not None and raw_dim > max_feature_dim:
+            raise ValueError(
+                f"{path}: feature id {raw_dim - 1} >= dim {max_feature_dim}"
+            )
+
     csr = parse_csr_or_none(path)
     if csr is not None:
         labels, row_ptr, flat_ids, flat_vals, raw_dim = csr
+        _check(raw_dim)
         batch, total_dim = csr_to_sparse_batch(
             labels, row_ptr, flat_ids, flat_vals,
             dim=raw_dim if dim is None else dim,
@@ -129,6 +142,7 @@ def load_sparse_batch(
         )
         return batch, total_dim, raw_dim
     data = parse_libsvm(path)
+    _check(data.dim)
     batch, total_dim = to_sparse_batch(
         data, dim=dim, intercept=intercept, capacity=capacity,
         binary_labels=binary_labels,

@@ -205,7 +205,6 @@ def load_dataset(
     indexing (features absent from the map are dropped).
     """
     from photon_tpu.data.index_map import IndexMap, feature_key
-    from photon_tpu.data.libsvm import parse_libsvm, to_sparse_batch
 
     binary = task in BINARY_TASKS
     if _is_avro_input(spec):
@@ -277,15 +276,11 @@ def load_validation(
     from photon_tpu.data.libsvm import load_sparse_batch
 
     feature_dim = train_dim - (1 if intercept else 0)
-    batch, _, raw_dim = load_sparse_batch(
+    batch, _, _ = load_sparse_batch(
         spec, dim=feature_dim, intercept=intercept,
         binary_labels=task in BINARY_TASKS,
+        max_feature_dim=feature_dim,  # early-reject before pad + transfer
     )
-    if raw_dim > feature_dim:
-        raise ValueError(
-            f"validation data has feature id {raw_dim - 1} >= "
-            f"train dim {feature_dim}"
-        )
     return batch
 
 

@@ -13,6 +13,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <charconv>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -39,6 +40,31 @@ unsigned nthreads(int64_t rows) {
   // Tiny files: thread spawn dominates.
   if (rows < 4096) n = 1;
   return n;
+}
+
+// Locale-free float parse via std::from_chars (~3-5x strtof), bounded at
+// `end` (a number can never bleed past the trimmed line).  Parity shims:
+// an optional leading '+' (strtof/Python accept it; from_chars does not)
+// and the out-of-range case, which falls back to strtof so overflowing
+// magnitudes become +/-inf and underflows become 0/denormal exactly as
+// before (the svm_open terminator guarantee keeps strtof in bounds).
+// Returns the end of the parsed token, or `p` itself on no-parse.
+inline const char* parse_float(const char* p, const char* end, float* out) {
+  const char* q = p;
+  // Skip one '+' only when a number follows: "+-2.5" must stay a parse
+  // error (strtof and the Python fallback both reject double signs).
+  if (q + 1 < end && *q == '+' &&
+      ((q[1] >= '0' && q[1] <= '9') || q[1] == '.' || q[1] == 'i' ||
+       q[1] == 'I' || q[1] == 'n' || q[1] == 'N'))
+    q++;
+  auto r = std::from_chars(q, end, *out);
+  if (r.ec == std::errc()) return r.ptr;
+  if (r.ec == std::errc::result_out_of_range) {
+    char* ep = nullptr;
+    *out = strtof(p, &ep);
+    return ep;
+  }
+  return p;
 }
 
 }  // namespace
@@ -172,8 +198,7 @@ int64_t svm_parse(void* h, const int64_t* row_ptr, float* labels,
       for (int64_t i = t; i < rows; i += nt) {
         const char* p = f->data + f->line_start[i];
         const char* e = f->data + f->line_end[i];
-        char* endp = nullptr;
-        labels[i] = strtof(p, &endp);
+        const char* endp = parse_float(p, e, &labels[i]);
         if (endp == p) {
           errs[t] = 1;
           return;
@@ -183,11 +208,19 @@ int64_t svm_parse(void* h, const int64_t* row_ptr, float* labels,
         while (p < e) {
           while (p < e && (*p == ' ' || *p == '\t')) p++;
           if (p >= e) break;
-          // int64 parse: on 32-bit-long platforms strtol would saturate an
-          // overflowing id to exactly INT32_MAX and slip past the range
-          // check below.
-          long long id = strtoll(p, &endp, 10);
-          if (endp == p || *endp != ':') {
+          // int64 parse (from_chars: no '+' — skip one for strtoll/Python
+          // parity); an out-of-range id errors below exactly as strtoll's
+          // LLONG_MAX saturation did.
+          const char* idp = (*p == '+' && p + 1 < e) ? p + 1 : p;
+          long long id;
+          auto idr = std::from_chars(idp, e, id);
+          if (idr.ec == std::errc::result_out_of_range) id = INT64_MAX;
+          else if (idr.ec != std::errc()) {
+            errs[t] = 1;
+            return;
+          }
+          endp = idr.ptr;
+          if (endp == idp || endp >= e || *endp != ':') {
             errs[t] = 1;
             return;
           }
@@ -209,7 +242,8 @@ int64_t svm_parse(void* h, const int64_t* row_ptr, float* labels,
             errs[t] = 1;
             return;
           }
-          float v = strtof(p, &endp);
+          float v;
+          endp = parse_float(p, e, &v);
           if (endp == p) {
             errs[t] = 1;
             return;

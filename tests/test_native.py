@@ -247,6 +247,37 @@ def test_train_driver_uses_native_parser(tmp_path):
     )
 
 
+def test_native_parser_sign_parity(tmp_path):
+    """'+1' labels/values parse (the common a1a convention) but double
+    signs like '+-2.5' are rejected — in BOTH parsers (the from_chars
+    '+'-shim must not be laxer than strtof/Python)."""
+    import numpy as np
+    import pytest
+
+    from photon_tpu.data.libsvm import _parse_libsvm_py
+    from photon_tpu.native import libsvm_native
+
+    good = tmp_path / "plus.libsvm"
+    good.write_text("+1 1:+2.5 3:-1.5\n-1 2:+0.5\n")
+    parsed = libsvm_native.parse_file(str(good))
+    if parsed is None:
+        pytest.skip("native library unavailable")
+    rows, labels, dim = parsed
+    py = _parse_libsvm_py(str(good), False)
+    np.testing.assert_array_equal(labels, py.labels)
+    assert dim == py.dim
+    for (i1, v1), (i2, v2) in zip(rows, py.rows):
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_array_equal(v1, v2)
+
+    bad = tmp_path / "doublesign.libsvm"
+    bad.write_text("1 3:+-2.5\n")
+    with pytest.raises(ValueError):
+        libsvm_native.parse_file(str(bad))
+    with pytest.raises(ValueError):
+        _parse_libsvm_py(str(bad), False)
+
+
 def test_native_parser_rejects_out_of_range_ids(tmp_path):
     # int32-overflowing and sub-minimum feature ids must be parse errors in
     # BOTH parsers, never a silent wraparound (ADVICE r1).
