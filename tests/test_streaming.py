@@ -133,6 +133,7 @@ def test_csr_chunk_path_matches_rows_path(tmp_path):
     with open(p, "w") as f:
         f.write("1 3:0.5 7:-1.25\n")
         f.write("-1 1:2.0\n")
+        f.write("0\n")  # label-only row: only the intercept column
         f.write("1 2:1.0 4:4.0 9:0.125\n")
 
     csr = libsvm_native.parse_file_csr(p)
