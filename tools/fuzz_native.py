@@ -1,0 +1,166 @@
+"""Byte-mutation fuzz harness for the native host-side components.
+
+Feeds mutated inputs to the three C++-backed readers — the LIBSVM parser,
+the GAME Avro columnar decoder, and the mmap index store — in worker
+SUBPROCESSES, so a segfault/abort in native code is observed as a worker
+crash rather than killing the harness.  Graceful errors (ValueError /
+OSError / clean parse) are the expected outcomes; any non-zero worker exit
+is a finding and the offending input is preserved under /tmp.
+
+Run: ``python tools/fuzz_native.py [mutants-per-component]`` (default 480;
+the README's robustness claim was recorded at 800/480/480 clean).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCH = 80
+
+LIBSVM_SEEDS = [
+    "1 1:0.5 3:1.25 7:-2.5\n", "-1 2:1e-3 4:3.25\n", "0\n",
+    "+1 5:+2.5 6:nan 8:inf\n", "1 9:0.1 # comment\n",
+]
+
+LIBSVM_WORKER = r'''
+import sys
+sys.path.insert(0, sys.argv[1])
+from photon_tpu.native import libsvm_native
+for path in sys.argv[2:]:
+    try:
+        libsvm_native.parse_file(path, False)
+        print(path, "OK", flush=True)
+    except ValueError:
+        print(path, "VALERR", flush=True)
+'''
+
+AVRO_WORKER = r'''
+import sys
+sys.path.insert(0, sys.argv[1])
+from photon_tpu.data.game_io import read_game_avro
+bags = {"global": "global", "per_user": "per_user"}
+for path in sys.argv[2:]:
+    try:
+        read_game_avro(path, bags, ["userId", "itemId"])
+        print(path, "OK", flush=True)
+    except Exception as ex:
+        print(path, type(ex).__name__, flush=True)
+'''
+
+PIXS_WORKER = r'''
+import sys
+sys.path.insert(0, sys.argv[1])
+from photon_tpu.data.index_map import OffHeapIndexMap
+for path in sys.argv[2:]:
+    try:
+        m = OffHeapIndexMap.open(path)
+        for probe in ("f3\x01t3", "zzz", "f1999\x01t4"):
+            m.get_id(probe)
+        for i in (0, 1, 1999, 2000):
+            try: m.get_key(i)
+            except (IndexError, OSError, ValueError, UnicodeDecodeError): pass
+        print(path, "OK", flush=True)
+    except (OSError, ValueError) as ex:
+        print(path, type(ex).__name__, flush=True)
+'''
+
+
+def mutate(base: bytes, rng: random.Random) -> bytes:
+    b = bytearray(base)
+    for _ in range(rng.randint(1, 10)):
+        if not b:
+            break
+        op, j = rng.random(), rng.randrange(len(b))
+        if op < 0.5:
+            b[j] = rng.randrange(256)
+        elif op < 0.8:
+            del b[j]
+        else:
+            b.insert(j, rng.randrange(256))
+    if rng.random() < 0.25:
+        b = b[: rng.randrange(len(b) + 1)]
+    return bytes(b)
+
+
+def run_component(name, worker, base_bytes, suffix, n_mutants, rng, td) -> int:
+    crashes = 0
+    done_mutants = 0
+    batch_idx = 0
+    while done_mutants < n_mutants:
+        count = min(BATCH, n_mutants - done_mutants)
+        paths = []
+        for i in range(count):
+            p = os.path.join(td, f"{name}_b{batch_idx}_m{i}{suffix}")
+            with open(p, "wb") as f:
+                f.write(mutate(base_bytes, rng))
+            paths.append(p)
+        out = subprocess.run(
+            [sys.executable, "-c", worker, REPO] + paths,
+            capture_output=True, text=True, timeout=600,
+        )
+        if out.returncode != 0:
+            crashes += 1
+            done = len(out.stdout.strip().splitlines())
+            bad = paths[done] if done < len(paths) else None
+            print(f"[{name}] CRASH rc={out.returncode} "
+                  f"on {bad}: {out.stderr[-400:]}")
+            if bad:
+                kept = f"/tmp/fuzz_{name}_crash_{batch_idx}{suffix}"
+                shutil.copy(bad, kept)
+                print(f"[{name}] offending input kept at {kept}")
+        done_mutants += count
+        batch_idx += 1
+    print(f"[{name}] {done_mutants} mutants, {crashes} crashing batches")
+    return crashes
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 480
+    rng = random.Random(0)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from photon_tpu.data.fixtures import make_movielens_like
+    from photon_tpu.data.game_io import write_game_avro
+    from photon_tpu.data.index_map import OffHeapIndexMap, feature_key
+
+    total = 0
+    with tempfile.TemporaryDirectory() as td:
+        svm_base = "".join(
+            random.Random(1).choices(LIBSVM_SEEDS, k=40)
+        ).encode()
+        total += run_component(
+            "libsvm", LIBSVM_WORKER, svm_base, ".libsvm", n, rng, td
+        )
+
+        avro_path = os.path.join(td, "base.avro")
+        data, maps = make_movielens_like(
+            n_users=12, n_items=10, mean_ratings=4
+        )
+        write_game_avro(avro_path, data, maps)
+        total += run_component(
+            "avro", AVRO_WORKER, open(avro_path, "rb").read(), ".avro",
+            n, rng, td,
+        )
+
+        pixs_path = os.path.join(td, "base.pixs")
+        keys = [feature_key(f"f{i}", f"t{i % 5}") for i in range(2000)]
+        OffHeapIndexMap.build_file(pixs_path, keys, intercept=True).close()
+        total += run_component(
+            "pixs", PIXS_WORKER, open(pixs_path, "rb").read(), ".pixs",
+            n, rng, td,
+        )
+    print(f"TOTAL crashing batches: {total}")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
