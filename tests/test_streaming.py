@@ -116,6 +116,38 @@ def test_stream_chunks_pooled_delivery_order(monkeypatch):
     assert [int(o[0]) for o in out] == list(range(8))
 
 
+def test_stream_scale_mp_bench_mode(tmp_path):
+    """bench.py --stream-scale-mp at toy size: the 2-process distributed
+    pass runs, the JSON line parses, and the (value, |grad|) cross-check
+    against the single-process pass holds (both CPU-pinned workers)."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        PHOTON_STREAM_SCALE_ROWS="2000",
+        PHOTON_STREAM_SCALE_DIR=str(tmp_path / "data"),
+        PHOTON_BENCH_PROBE_TIMEOUT="5",
+        TMPDIR=str(tmp_path),
+        PHOTON_BENCH_COMPILATION_CACHE=os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", str(tmp_path / "cache")
+        ),
+    )
+    out = subprocess.run(
+        [_sys.executable, os.path.join(repo, "bench.py"), "--stream-scale-mp"],
+        capture_output=True, text=True, timeout=500, env=env, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "config5_stream_mp_rows_per_sec"
+    assert line["detail"]["processes"] == 2
+    assert line["detail"]["rows"] == 2000
+    assert line["detail"]["value_match"] is True
+    assert line["detail"]["grad_l1_match"] is True
+
+
 def test_csr_chunk_path_matches_rows_path(tmp_path):
     """The flat-CSR fast chunk loader must produce byte-identical batches
     to the rows-based builder (same padding, intercept column, label
