@@ -116,10 +116,12 @@ def test_stream_chunks_pooled_delivery_order(monkeypatch):
     assert [int(o[0]) for o in out] == list(range(8))
 
 
-def test_stream_scale_mp_bench_mode(tmp_path):
-    """bench.py --stream-scale-mp at toy size: the 2-process distributed
-    pass runs, the JSON line parses, and the (value, |grad|) cross-check
-    against the single-process pass holds (both CPU-pinned workers)."""
+def _run_stream_scale_bench(tmp_path, flag, rows):
+    """Run ``bench.py <flag>`` in a subprocess at toy size and return the
+    parsed final JSON line.  Shared scaffold of the two stream-scale bench
+    tests; isolating TMPDIR keeps the test's 5s-probe cpu-fallback verdict
+    out of the shared backend-probe cache, where a real bench run within
+    the TTL would silently skip the TPU probe."""
     import json
     import subprocess
     import sys as _sys
@@ -127,7 +129,7 @@ def test_stream_scale_mp_bench_mode(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(
         os.environ,
-        PHOTON_STREAM_SCALE_ROWS="2000",
+        PHOTON_STREAM_SCALE_ROWS=str(rows),
         PHOTON_STREAM_SCALE_DIR=str(tmp_path / "data"),
         PHOTON_BENCH_PROBE_TIMEOUT="5",
         TMPDIR=str(tmp_path),
@@ -136,11 +138,18 @@ def test_stream_scale_mp_bench_mode(tmp_path):
         ),
     )
     out = subprocess.run(
-        [_sys.executable, os.path.join(repo, "bench.py"), "--stream-scale-mp"],
+        [_sys.executable, os.path.join(repo, "bench.py"), flag],
         capture_output=True, text=True, timeout=500, env=env, cwd=repo,
     )
     assert out.returncode == 0, out.stderr[-2000:]
-    line = json.loads(out.stdout.strip().splitlines()[-1])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_stream_scale_mp_bench_mode(tmp_path):
+    """bench.py --stream-scale-mp at toy size: the 2-process distributed
+    pass runs, the JSON line parses, and the (value, |grad|) cross-check
+    against the single-process pass holds (both CPU-pinned workers)."""
+    line = _run_stream_scale_bench(tmp_path, "--stream-scale-mp", 2000)
     assert line["metric"] == "config5_stream_mp_rows_per_sec"
     assert line["detail"]["processes"] == 2
     assert line["detail"]["rows"] == 2000
@@ -332,30 +341,10 @@ def test_stream_scale_bench_mode(tmp_path):
     through the production path, the JSON line parses, RSS bound holds, and
     the generator's manifest cache skips regeneration (VERDICT r3 item 3;
     full-scale 10M-row runs are recorded in BASELINE.md)."""
-    import json
-    import subprocess
     import sys as _sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(
-        os.environ,
-        PHOTON_STREAM_SCALE_ROWS="3000",
-        PHOTON_STREAM_SCALE_DIR=str(tmp_path / "data"),
-        PHOTON_BENCH_PROBE_TIMEOUT="5",
-        # Isolate the backend-probe cache: without this the test's 5s-probe
-        # cpu-fallback verdict lands in the shared TMPDIR cache and a real
-        # bench run within the TTL would silently skip the TPU probe.
-        TMPDIR=str(tmp_path),
-        PHOTON_BENCH_COMPILATION_CACHE=os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR", str(tmp_path / "cache")
-        ),
-    )
-    out = subprocess.run(
-        [_sys.executable, os.path.join(repo, "bench.py"), "--stream-scale"],
-        capture_output=True, text=True, timeout=500, env=env, cwd=repo,
-    )
-    assert out.returncode == 0, out.stderr[-2000:]
-    line = json.loads(out.stdout.strip().splitlines()[-1])
+    line = _run_stream_scale_bench(tmp_path, "--stream-scale", 3000)
     assert line["metric"] == "config5_stream_rows_per_sec"
     assert line["detail"]["rows"] == 3000
     assert line["detail"]["rss_bounded"] is True

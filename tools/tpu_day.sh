@@ -1,14 +1,17 @@
 #!/usr/bin/env bash
 # TPU-day evidence pack: run the moment the tunneled chip answers.
 #
-# Produces, under tools/tpu_day_out/:
-#   00_probe.txt        backend probe (subprocess-guarded, bounded)
-#   01_microbench2.txt  primitive table -> paste into ops/KERNEL_NOTES.md
-#   02_headline_*.txt   bench headline per kernel (pallas first — the
-#                       unmeasured one — then fm / autodiff / pallas+fwd)
-#                       and bf16 storage on autodiff, cold then warm
-#   03_configs.txt      bench configs 1-5 (quality anchors)
-#   04_stream_scale.txt streaming-ingestion proof
+# Produces, under tools/tpu_day_out/ (in RUN ORDER — unmeasured first,
+# so a mid-window tunnel drop costs only re-confirmations):
+#   00_probe.txt          backend probe (subprocess-guarded, bounded)
+#   05_probe_permute.txt  static-permutation primitive table (UNMEASURED —
+#                         decides the benes kernel design; runs FIRST)
+#   01_microbench2.txt    primitive table (never completed on TPU; second)
+#   02_headline_*.txt     bench headline per kernel (all banked on hardware
+#                         2026-07-30/31 — re-confirmation) + bf16 + zipf +
+#                         fused variants
+#   03_configs.txt        bench configs 1-5 (quality anchors)
+#   04_stream_scale.txt   streaming-ingestion proof
 #
 # Every step is individually timeout-bounded so a mid-run tunnel drop
 # cannot hang the pack; partial output is still evidence.  Run from the
@@ -34,15 +37,25 @@ if ! grep -q "^BACKEND=\(tpu\|axon\)" "$OUT/00_probe.txt"; then
     exit 1
 fi
 
-# PRIORITY ORDER (the 2026-07-30 window lasted ~8 minutes): the pallas
-# headline is the only UNMEASURED kernel — fm (1.124 steps/s) and autodiff
-# (1.881) were banked on hardware that day (KERNEL_NOTES.md round-4 table).
-# Bank the unknown first; re-confirm the known later.
+# PRIORITY ORDER (windows last ~8-13 minutes and drop mid-pack — both
+# round-4 windows did): bank UNMEASURED things first, re-confirm known
+# numbers later.  As of the 2026-07-31 window all three kernel headlines
+# are banked on hardware (autodiff 1.881 / pallas 1.63 / fm 1.124); the
+# unmeasured items are now (a) the static-permutation design's primitive
+# table (probe_permute — decides the `benes` kernel design) and (b)
+# microbench2's gather/scatter primitive rows (it has never completed on
+# TPU; both windows dropped before it finished).
 # Every run pins ALL PHOTON_* knobs it does not intend to vary, so an
 # operator's ambient exports cannot contaminate the labeled files.
 BASE="PHOTON_SPARSE_MARGIN= PHOTON_BENCH_DTYPE=float32 PHOTON_BENCH_SKEW=uniform PHOTON_BENCH_FUSED=0"
 
-echo "== headline: pallas (UNMEASURED — run first) =="
+echo "== probe_permute (UNMEASURED primitive table — run first) =="
+timeout 600 python -u tools/probe_permute.py > "$OUT/05_probe_permute.txt" 2>&1
+
+echo "== microbench2 (never completed on TPU — run second) =="
+timeout 900 python -u tools/microbench2.py > "$OUT/01_microbench2.txt" 2>&1
+
+echo "== headline: per kernel (banked 2026-07-30/31 — re-confirmation) =="
 for pass in cold warm; do
     env $BASE PHOTON_SPARSE_GRAD=pallas \
         timeout 900 python bench.py --headline-only \
@@ -53,10 +66,6 @@ env $BASE PHOTON_SPARSE_GRAD=pallas PHOTON_SPARSE_MARGIN=pallas \
     timeout 900 python bench.py --headline-only \
     > "$OUT/02_headline_pallas_fwd_warm.txt" 2>&1
 
-echo "== microbench2 (primitive table) =="
-timeout 900 python tools/microbench2.py > "$OUT/01_microbench2.txt" 2>&1
-
-echo "== headline: remaining kernels/variants =="
 for kernel in fm autodiff; do
     for pass in cold warm; do
         env $BASE PHOTON_SPARSE_GRAD=$kernel \
