@@ -158,9 +158,16 @@ class GlmObjective:
         """Per-row ``X u`` products (no offset) through the selected
         kernel's forward: the pallas path uses the TRANSPOSED aligned
         layout when the batch carries one (``sum_e u[f_e] v_e`` per row via
-        the same position-reduce kernel — KERNEL_NOTES.md option (a));
-        everything else takes the row-major XLA gather.  The single
-        dispatch point for margins AND Hv's ``X v``."""
+        the same position-reduce kernel — KERNEL_NOTES.md option (a)); the
+        benes path runs the slab gather + static Clos permutation
+        (ops/benes.py — no random E-access); everything else takes the
+        row-major XLA gather.  The single dispatch point for margins AND
+        Hv's ``X v``."""
+        if kernel == "benes":
+            from photon_tpu.ops.benes import benes_xu_product
+
+            n, k = batch.ids.shape
+            return benes_xu_product(u, batch.al, batch.benes, n, k)
         if kernel == "pallas" and batch.al_t is not None:
             from photon_tpu.ops.pallas_gather import aligned_segment_grad
 
@@ -168,7 +175,10 @@ class GlmObjective:
         return jnp.sum(jnp.take(u, batch.ids, axis=0) * batch.vals, axis=-1)
 
     def _margins_for_kernel(self, kernel: str, w: Array, batch: Batch) -> Array:
-        if not (kernel == "pallas" and batch.al_t is not None):
+        fwd_kernel = kernel == "benes" or (
+            kernel == "pallas" and batch.al_t is not None
+        )
+        if not fwd_kernel:
             # Single home of the normalization algebra for the XLA forward.
             return self._margins(w, batch)
         if self.normalization is None:
@@ -199,6 +209,7 @@ class GlmObjective:
             return None
         has_fm = batch.fm is not None
         has_al = batch.al is not None
+        has_benes = batch.benes is not None and has_al
         if not (has_fm or has_al):
             return None
         if dim is None:
@@ -206,12 +217,21 @@ class GlmObjective:
         from photon_tpu.ops.sparse_grad_select import select_kernel
 
         n, k = batch.ids.shape
-        choice = select_kernel(n * k, dim, n, has_fm=has_fm, has_aligned=has_al)
+        choice = select_kernel(
+            n * k, dim, n,
+            has_fm=has_fm, has_aligned=has_al, has_benes=has_benes,
+        )
         return None if choice == "autodiff" else choice
 
     def _segment_grad(self, kernel: str, per_row: Array, batch: Batch, dim: int) -> Array:
         """``g[f] = sum_e per_row[row_e] * val_e`` via the selected static
         layout (the reduction both the gradient and Hv share)."""
+        if kernel == "benes":
+            from photon_tpu.ops.benes import benes_segment_grad
+
+            return benes_segment_grad(
+                per_row, batch.vals, batch.al, batch.benes, dim
+            )
         if kernel == "pallas":
             from photon_tpu.ops.pallas_gather import aligned_segment_grad
 
@@ -307,9 +327,10 @@ class GlmObjective:
         pallas kernel has no JVP rule (``pallas_call`` is not
         differentiable), so callers that re-differentiate the gradient
         (normalized Hv below) route it to the fm layout — always built
-        alongside the aligned one — or plain autodiff."""
+        alongside the aligned one — or plain autodiff.  The benes path
+        contains the same pallas_call and routes identically."""
         kernel = self._sparse_kernel(batch, int(w.shape[0]))
-        if kernel == "pallas":
+        if kernel in ("pallas", "benes"):
             kernel = "fm" if batch.fm is not None else None
         if kernel is not None:
             _, g = self._fast_data_value_and_grad(w, batch, kernel)

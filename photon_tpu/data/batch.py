@@ -25,6 +25,7 @@ are needed in the hot loop.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional, Union
 
 import jax
@@ -108,6 +109,11 @@ class SparseBatch(NamedTuple):
     # the Pallas FORWARD (margins) direction; attach with
     # ``attach_feature_major(..., aligned_dim=d, aligned_forward=True)``.
     al_t: Optional["object"] = None
+    # Optional static Clos routing (ops/benes.BenesAux) for the `benes`
+    # kernel — value/grad/Hv with no random E-element access; built by
+    # ``attach_feature_major(..., aligned_dim=d)`` when
+    # ``PHOTON_SPARSE_GRAD=benes``.  Requires ``al``.
+    benes: Optional["object"] = None
 
     @property
     def num_examples(self) -> int:
@@ -275,14 +281,21 @@ def attach_feature_major(
         layout = build_aligned_layout(ids_np, vals_np, aligned_dim)
         batch = batch._replace(al=device_layout(layout))
         if aligned_forward is None:
-            import os
-
             aligned_forward = (
                 os.environ.get("PHOTON_SPARSE_MARGIN", "xla") == "pallas"
             )
         if aligned_forward:
             batch = batch._replace(
                 al_t=device_layout(build_row_aligned_layout(ids_np, vals_np))
+            )
+        if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "benes":
+            # Explicit opt-in only: the routing (host edge-coloring) is the
+            # most expensive layout build in the package; auto mode never
+            # pays it speculatively.
+            from photon_tpu.ops.benes import build_benes_aux
+
+            batch = batch._replace(
+                benes=build_benes_aux(layout, n, k)
             )
     return batch
 

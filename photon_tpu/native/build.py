@@ -95,6 +95,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ixs_close.restype = None
     lib.ixs_close.argtypes = [c.c_void_p]
 
+    lib.clos_edge_color.restype = c.c_int32
+    lib.clos_edge_color.argtypes = [
+        c.c_int64, c.c_int32, c.c_int32, c.POINTER(c.c_int32),
+        c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+    ]
+
 
 def get_lib() -> Optional[ctypes.CDLL]:
     """The native library, building it if needed; None when unavailable."""
@@ -118,7 +124,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so predating newly declared
+            # symbols (mtime >= sources, so _needs_build skipped the
+            # rebuild) — fall back to Python like any other build failure.
             _failed = True
             return None
         _lib = lib

@@ -74,6 +74,10 @@ class AlignedLayout:
     - ``dup_map`` ``[n_slabs * 1024]``: int32 feature id stored at each slab
       position (0 for unused positions — they gather ``w[0]`` but only ever
       multiply pad zeros).
+    - ``src``: int64 ORIGINAL flat entry index (row-major ``r * k + j``)
+      each slot was filled from; -1 for pad slots.  Host-only — consumed by
+      the ``benes`` kernel's static-permutation routing (ops/clos.py),
+      never shipped to device.
     - ``n_entries``: real (unpadded) entry count.
     """
 
@@ -82,6 +86,7 @@ class AlignedLayout:
     rows: np.ndarray
     slab_of_tile: np.ndarray
     dup_map: np.ndarray
+    src: np.ndarray
     n_entries: int
 
     @property
@@ -153,6 +158,7 @@ def _build_aligned_from_flat(
     Pad entries (val == 0) are dropped.
     """
     keep = flat_v != 0.0
+    orig = np.flatnonzero(keep)  # original flat (row-major) entry index
     flat_f, flat_v, flat_r = flat_key[keep], flat_v[keep], flat_payload[keep]
     if flat_f.size and (flat_f.min() < 0 or flat_f.max() >= dim):
         raise ValueError(f"{key_role} id out of range for dim {dim}")
@@ -164,12 +170,14 @@ def _build_aligned_from_flat(
             rows=np.zeros((TILE_SUBLANES, LANES), np.int32),
             slab_of_tile=np.zeros(1, np.int32),
             dup_map=np.zeros(SLAB_POSITIONS, np.int32),
+            src=np.full((TILE_SUBLANES, LANES), -1, np.int64),
             n_entries=0,
         )
 
     # Feature-sorted entry order: each feature's entries are contiguous.
     order = np.argsort(flat_f, kind="stable")
     f_s, v_s, r_s = flat_f[order], flat_v[order], flat_r[order]
+    orig_s = orig[order]
     counts = np.bincount(f_s, minlength=dim)
     present = np.flatnonzero(counts)
     feat_start = np.concatenate(([0], np.cumsum(counts)))[present]
@@ -239,6 +247,8 @@ def _build_aligned_from_flat(
     lo_arr[dst_sub, dst_lane] = rep(pos[cell_order], sizes_o).astype(np.int32)
     val_arr[dst_sub, dst_lane] = v_s[src]
     row_arr[dst_sub, dst_lane] = r_s[src].astype(np.int32)
+    src_arr = np.full((total_sub, LANES), -1, np.int64)
+    src_arr[dst_sub, dst_lane] = orig_s[src]
 
     dup_map = np.zeros(n_slabs * SLAB_POSITIONS, np.int32)
     dup_map[slab * SLAB_POSITIONS + pos * LANES + lane] = chunk_feat.astype(np.int32)
@@ -247,7 +257,8 @@ def _build_aligned_from_flat(
     )
     return AlignedLayout(
         lo=lo_arr, vals=val_arr, rows=row_arr,
-        slab_of_tile=slab_of_tile, dup_map=dup_map, n_entries=e_total,
+        slab_of_tile=slab_of_tile, dup_map=dup_map, src=src_arr,
+        n_entries=e_total,
     )
 
 
@@ -437,6 +448,22 @@ def aligned_segment_grad(
         jnp.take(per_row, al.rows.reshape(-1), axis=0).reshape(al.rows.shape)
         * al.vals
     ).astype(jnp.float32)
+    return aligned_reduce(pv, al, dim, interpret=interpret)
+
+
+def aligned_reduce(
+    pv: Array,
+    al: AlignedLayoutDev,
+    dim: int,
+    interpret: bool | None = None,
+) -> Array:
+    """Stages 2+3 of :func:`aligned_segment_grad` alone: fold per-slot
+    products ``pv`` (``[total_sub, 128]``, zeros in pad slots) into the
+    ``dim`` coefficients.  The ``benes`` kernel (ops/benes.py) computes its
+    products by static permutation instead of the E-gather and enters
+    here."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     partial = _position_partial_sums(
         al.slab_of_tile, pv, al.lo, n_slabs=al.n_slabs, interpret=bool(interpret)
     )

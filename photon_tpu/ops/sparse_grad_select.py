@@ -24,9 +24,12 @@ runs at trace time with concrete inputs (the same eager-probe pattern as
 ops/pallas_sparse.kernel_supported) and costs a few hundred ms once per
 process per shape regime.
 
-Override with ``PHOTON_SPARSE_GRAD=fm|autodiff|pallas|auto`` (default
-auto).  The pallas candidate enters auto mode only on a real TPU backend
-(interpret mode on CPU is a test vehicle, orders of magnitude slower).
+Override with ``PHOTON_SPARSE_GRAD=fm|autodiff|pallas|benes|auto``
+(default auto).  The pallas candidate enters auto mode only on a real TPU
+backend (interpret mode on CPU is a test vehicle, orders of magnitude
+slower).  ``benes`` — the static-permutation kernel (ops/benes.py, no
+random E-access in either direction) — is explicit-opt-in only until a
+hardware window measures it.
 """
 
 from __future__ import annotations
@@ -161,10 +164,11 @@ def select_kernel(
     n_rows: int,
     has_fm: bool = True,
     has_aligned: bool = False,
+    has_benes: bool = False,
 ) -> str:
-    """Pick the gradient kernel — ``"fm"``, ``"autodiff"``, or ``"pallas"``
-    — for this problem size on the current backend, restricted to the
-    layouts the batch actually carries."""
+    """Pick the gradient kernel — ``"fm"``, ``"autodiff"``, ``"pallas"``,
+    or ``"benes"`` — for this problem size on the current backend,
+    restricted to the layouts the batch actually carries."""
     mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
     if mode == "autodiff":
         return "autodiff"
@@ -174,6 +178,13 @@ def select_kernel(
         # Forced pallas runs in interpret mode off-TPU (tests / parity
         # checks); it still needs the aligned layout on the batch.
         return "pallas" if has_aligned else ("fm" if has_fm else "autodiff")
+    if mode == "benes":
+        # Explicit opt-in only (its routing is the costliest layout build);
+        # auto mode never enters it until a hardware measurement justifies
+        # probing it (KERNEL_NOTES.md round-4 second-window plan).
+        return "benes" if has_benes else (
+            "pallas" if has_aligned else ("fm" if has_fm else "autodiff")
+        )
     import jax
 
     # Probe floor: below ~1M entries the eager measurement costs more than
@@ -223,7 +234,7 @@ def aligned_layout_wanted(e_total: int | None = None) -> bool:
     auto mode is guaranteed to run autodiff, so the build would be pure
     wasted host time."""
     mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
-    if mode == "pallas":
+    if mode in ("pallas", "benes"):
         return True
     if mode != "auto":
         return False

@@ -1,0 +1,158 @@
+"""The `benes` static-permutation kernel (ops/clos.py + ops/benes.py).
+
+The kernel rewrites the row-order <-> feature-order exchange — the random
+E-element access that pins every other kernel to ~0.1% of TPU HBM
+roofline (ops/KERNEL_NOTES.md round-4 hardware verdicts) — as a 3-stage
+Clos factorization: row-local shuffles + transposes, routed host-side by
+bipartite edge-coloring (native/src/clos_route.cpp).  These tests pin
+
+- the routing itself (native and pure-Python colorings) against plain
+  ``x[perm]``,
+- the route inversion (one coloring serves both directions),
+- the end-to-end objective: value/grad/Hv through
+  ``PHOTON_SPARSE_GRAD=benes`` must match the autodiff reference exactly
+  like the fm/pallas paths do (interpret-mode reduce on CPU).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.data.batch import attach_feature_major
+from photon_tpu.ops.clos import (
+    apply_clos,
+    invert_route,
+    route_permutation,
+)
+
+from tests.test_fast_sparse import _random_batch
+
+
+@pytest.mark.parametrize("n,a,b", [
+    (16, 4, 4), (100, None, None), (4096, 64, 64), (5000, None, None),
+])
+def test_route_matches_flat_gather(n, a, b):
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(n)
+    route = route_permutation(perm, a, b)
+    x = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(apply_clos(x, route)), np.asarray(x)[perm]
+    )
+
+
+def test_route_python_fallback_matches_native():
+    rng = np.random.default_rng(1)
+    perm = rng.permutation(512)
+    x = jnp.asarray(rng.standard_normal(512).astype(np.float32))
+    r_native = route_permutation(perm, 32, 16, use_native=True)
+    r_py = route_permutation(perm, 32, 16, use_native=False)
+    ref = np.asarray(x)[perm]
+    np.testing.assert_array_equal(np.asarray(apply_clos(x, r_native)), ref)
+    np.testing.assert_array_equal(np.asarray(apply_clos(x, r_py)), ref)
+
+
+def test_route_inversion_round_trips():
+    rng = np.random.default_rng(2)
+    n = 2048
+    perm = rng.permutation(n)
+    route = route_permutation(perm, 64, 32)
+    inv = invert_route(route)
+    x = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    # inv applies perm^-1: y[perm[i]] = x[perm[i]] pulled back => identity.
+    y = apply_clos(apply_clos(x, route), inv)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    # And inv alone equals gathering by the inverse permutation.
+    inv_perm = np.empty_like(perm)
+    inv_perm[perm] = np.arange(n)
+    np.testing.assert_array_equal(
+        np.asarray(apply_clos(x, inv)), np.asarray(x)[inv_perm]
+    )
+
+
+def test_route_rejects_non_permutation():
+    with pytest.raises(ValueError):
+        route_permutation(np.array([0, 0, 2, 3]), 2, 2)
+
+
+@pytest.mark.parametrize("loss", ["logistic", "squared", "poisson"])
+@pytest.mark.parametrize("zipf", [False, True])
+def test_benes_kernel_matches_autodiff(monkeypatch, loss, zipf):
+    """PHOTON_SPARSE_GRAD=benes routes value+grad AND Hv through the
+    static-permutation pipeline — must match autodiff like fm/pallas."""
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "benes")
+    n, k, d = 256, 6, 48
+    batch = _random_batch(n, k, d, seed=90, zipf=zipf)
+    fast = attach_feature_major(batch, aligned_dim=d)
+    assert fast.al is not None and fast.benes is not None
+    obj = GlmObjective.create(loss, RegularizationContext("l2", 0.6))
+    rng = np.random.default_rng(91)
+    w = jnp.asarray(rng.standard_normal(d), jnp.float32) * 0.1
+
+    assert obj._sparse_kernel(fast, d) == "benes"
+    v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+    v_b, g_b = obj.value_and_grad(w, fast)
+    np.testing.assert_allclose(v_b, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g_b, g_ref, rtol=2e-4, atol=1e-5)
+    # Under jit (optimizers always call it jitted).
+    v_j, g_j = jax.jit(obj.value_and_grad)(w, fast)
+    np.testing.assert_allclose(g_j, g_ref, rtol=2e-4, atol=1e-5)
+
+    vec = jnp.asarray(rng.standard_normal(d), jnp.float32)
+    hv_ref = jax.jvp(lambda u: jax.grad(obj.value)(u, batch), (w,), (vec,))[1]
+    hv = obj.hessian_vector(w, vec, fast)
+    np.testing.assert_allclose(hv, hv_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_benes_kernel_under_normalization(monkeypatch):
+    from photon_tpu.core.normalization import NormalizationContext
+    from photon_tpu.core.stats import BasicStatisticalSummary
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "benes")
+    n, k, d = 192, 5, 40
+    batch = _random_batch(n, k, d, seed=92)
+    fast = attach_feature_major(batch, aligned_dim=d)
+    summary = BasicStatisticalSummary.from_batch(batch, d)
+    norm = NormalizationContext.build(
+        "standardization", summary, intercept_id=0
+    )
+    obj = GlmObjective.create(
+        "logistic", RegularizationContext("l2", 0.4), normalization=norm
+    )
+    w = jnp.asarray(
+        np.random.default_rng(93).standard_normal(d), jnp.float32
+    ) * 0.1
+    v_ref, g_ref = jax.value_and_grad(obj.value)(w, batch)
+    v_b, g_b = obj.value_and_grad(w, fast)
+    np.testing.assert_allclose(v_b, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g_b, g_ref, rtol=2e-4, atol=1e-5)
+
+
+def test_benes_aux_not_built_without_optin(monkeypatch):
+    """Auto mode must never pay the routing cost speculatively."""
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "auto")
+    batch = _random_batch(64, 4, 32, seed=94)
+    fast = attach_feature_major(batch, aligned_dim=32)
+    assert fast.benes is None
+
+
+def test_benes_lbfgs_training_converges(monkeypatch):
+    """A full L-BFGS solve through the benes kernel reaches the same
+    optimum as autodiff (end-to-end: optimizer loop, jit, line search)."""
+    from photon_tpu.core.optimizers import lbfgs
+
+    n, k, d = 256, 5, 32
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "benes")
+    batch = _random_batch(n, k, d, seed=95)
+    fast = attach_feature_major(batch, aligned_dim=d)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    w0 = jnp.zeros(d, jnp.float32)
+    res_b = lbfgs(lambda w: obj.value_and_grad(w, fast), w0)
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "autodiff")
+    res_a = lbfgs(lambda w: obj.value_and_grad(w, batch), w0)
+    np.testing.assert_allclose(
+        np.asarray(res_b.w), np.asarray(res_a.w), rtol=1e-3, atol=1e-4
+    )

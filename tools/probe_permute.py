@@ -21,6 +21,12 @@ measurement-driven (KERNEL_NOTES.md round-4 verdict 3):
                                                             reduce/gather)
   f. jnp.repeat monotonic expand w[f] by static counts      (forward side)
   g. XLA sort-by-key at E (dynamic-permutation alternative)
+  h. within-row take_along_axis at the stage shape          (one Clos stage
+                                                            as XLA sees it)
+  i. full 3-stage Clos apply (P1.T.P2.T.P3)                 (the complete
+                                                            XLA-only benes
+                                                            permute —
+                                                            ops/clos.py)
 
 Timing methodology matches tools/microbench2.py: jit once, warm up, then
 median of reps with a scalar reduction brought host-side so the timed
@@ -246,6 +252,55 @@ def probe_repeat_expand(E, d=262144):
     return t
 
 
+def probe_rowwise_gather(E):
+    # One Clos stage as XLA sees it: within-row gather on the [A, B] grid
+    # with a DIFFERENT random perm per row.  Random per-row indices are
+    # timing-equivalent to real routed stages, so no router is needed.
+    A = 8192
+    B = E // A
+    E = A * B
+    x = jnp.asarray(np.random.rand(A, B).astype(np.float32))
+    idx = jnp.asarray(
+        np.argsort(np.random.rand(A, B), axis=1).astype(np.int32)
+    )
+
+    @jax.jit
+    def f(x, idx):
+        return jnp.take_along_axis(x, idx, axis=1).sum()
+
+    t = _time(f, x, idx)
+    print(f"h. row-wise gather [{A}x{B}]  {t*1e3:8.2f} ms  "
+          f"{E/t/1e6:10.1f} Melem/s  {E*4/t/1e9:7.2f} GB/s")
+    return t
+
+
+def probe_clos_composite(E):
+    # Full 3-stage Clos apply (P1, T, P2, T, P3) with random per-row
+    # perms; upper-bounds the XLA-only benes permute cost per direction.
+    A = 8192
+    B = E // A
+    E = A * B
+    x = jnp.asarray(np.random.rand(A, B).astype(np.float32))
+    rng = np.random.default_rng(0)
+    p1 = jnp.asarray(np.argsort(rng.random((A, B)), axis=1).astype(np.int32))
+    p2 = jnp.asarray(np.argsort(rng.random((B, A)), axis=1).astype(np.int32))
+    p3 = jnp.asarray(np.argsort(rng.random((A, B)), axis=1).astype(np.int32))
+
+    @jax.jit
+    def f(x, p1, p2, p3):
+        g = jnp.take_along_axis(x, p1, axis=1)
+        g = g.T
+        g = jnp.take_along_axis(g, p2, axis=1)
+        g = g.T
+        g = jnp.take_along_axis(g, p3, axis=1)
+        return g.sum()
+
+    t = _time(f, x, p1, p2, p3)
+    print(f"i. clos 3-stage apply    E={E:>10,}  {t*1e3:8.2f} ms  "
+          f"{E/t/1e6:10.1f} Melem/s  (vs probe a = the op it replaces)")
+    return t
+
+
 def probe_sort(E):
     k = jnp.asarray(np.random.randint(0, E, size=E).astype(np.int32))
     v = jnp.arange(E, dtype=jnp.float32)
@@ -278,6 +333,8 @@ def main():
         probe_onehot_segsum,
         probe_repeat_expand,
         probe_sort,
+        probe_rowwise_gather,
+        probe_clos_composite,
     ):
         try:
             probe(E)

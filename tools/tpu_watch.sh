@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Poll the TPU tunnel; the moment it answers, run the evidence pack.
+# Round-4 windows lasted 8-13 minutes and arrived unannounced — an
+# unattended watcher is the only way not to miss one.  Probe is a
+# bounded subprocess (the axon backend init HANGS, not errors, when the
+# tunnel is down).  Exits after one successful pack so the operator (or
+# agent) is notified exactly once per window.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-300}"
+while true; do
+    rm -f "${TMPDIR:-/tmp}/photon_bench_backend_probe.json"
+    if timeout 120 python -c "
+import jax
+assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
+print('tpu up')
+" >/dev/null 2>&1; then
+        echo "$(date -u +%H:%M:%S) tunnel up — running pack"
+        bash tools/tpu_day.sh
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) tunnel down; sleeping ${INTERVAL}s"
+    sleep "$INTERVAL"
+done
