@@ -121,14 +121,36 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _needs_build(sources) and not _compile(sources):
             _failed = True
             return None
+        lib = None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
         except (OSError, AttributeError):
             # AttributeError: a stale prebuilt .so predating newly declared
             # symbols (mtime >= sources, so _needs_build skipped the
-            # rebuild) — fall back to Python like any other build failure.
-            _failed = True
-            return None
+            # rebuild — e.g. archive extraction resets mtimes).  One forced
+            # rebuild from the present sources before giving up; without it
+            # a single stale artifact permanently demotes EVERY native
+            # entry point (readers included) to the Python fallbacks.
+            # dlclose the stale handle first: the loader caches by
+            # pathname, so re-dlopening the same path would hand back the
+            # old link map even after os.replace swapped the file.
+            if lib is not None:
+                try:
+                    import _ctypes
+
+                    _ctypes.dlclose(lib._handle)
+                except Exception:  # noqa: BLE001 - best-effort unload
+                    pass
+                lib = None
+            if not _compile(sources):
+                _failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                _declare(lib)
+            except (OSError, AttributeError):
+                _failed = True
+                return None
         _lib = lib
     return _lib

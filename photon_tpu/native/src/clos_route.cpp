@@ -173,6 +173,11 @@ extern "C" {
 
 int32_t clos_edge_color(int64_t E, int32_t A, int32_t B, const int32_t* l,
                         const int32_t* r, int32_t* color) {
+  // color[] doubles as int32 scratch for dense subset indices (see
+  // color_one), so edge counts past INT32_MAX would wrap and corrupt the
+  // coloring; refuse explicitly (distinct code: -1 = bad B, -2 =
+  // internal split invariant, -3 = size limit).
+  if (E < 0 || E > INT32_MAX) return -3;
   Scratch s;
   return color_one(E, A, B, l, r, color, s);
 }

@@ -42,6 +42,7 @@ from jax import tree_util
 from photon_tpu.ops.clos import (
     ClosRoute,
     apply_clos_grid,
+    default_grid,
     invert_route,
     route_permutation,
 )
@@ -90,9 +91,7 @@ def build_benes_aux(layout, n: int, k: int, *, a: int | None = None,
     n_slots = int(slots_src.size)
     need = max(n_rowmajor, n_slots)
     if a is None or b is None:
-        bits = max(1, int(np.ceil(np.log2(max(need, 2)))))
-        a = 1 << ((bits + 1) // 2)
-        b = 1 << (bits - (bits + 1) // 2)
+        a, b = default_grid(need)
     total = a * b
     if total < need:
         raise ValueError(f"grid {a}x{b} < required {need}")

@@ -61,6 +61,20 @@ tree_util.register_dataclass(
 )
 
 
+def default_grid(n: int) -> tuple[int, int]:
+    """Most-square power-of-two (A, B) grid covering ``n`` elements.
+
+    B must be a power of two for the Euler-split coloring; A powers of two
+    keep the inter-stage transposes tile-friendly.  Shared by
+    :func:`route_permutation` and ops/benes.build_benes_aux so the aux
+    grid and the router default cannot diverge.
+    """
+    bits = max(1, int(np.ceil(np.log2(max(n, 2)))))
+    a = 1 << ((bits + 1) // 2)
+    b = 1 << (bits - (bits + 1) // 2)
+    return a, b
+
+
 def _edge_color_native(l: np.ndarray, r: np.ndarray, a: int,
                        b: int) -> Optional[np.ndarray]:
     from photon_tpu.native import build as native_build
@@ -78,6 +92,11 @@ def _edge_color_native(l: np.ndarray, r: np.ndarray, a: int,
         r.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         color.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
+    if rc == -3:
+        raise ValueError(
+            f"permutation too large for the native router ({l.size:,} "
+            f"edges > INT32_MAX); shard the layout before routing"
+        )
     if rc != 0:
         raise RuntimeError(f"clos_edge_color failed: rc={rc}")
     return color
@@ -151,11 +170,7 @@ def route_permutation(perm: np.ndarray, a: Optional[int] = None,
     perm = np.ascontiguousarray(perm, dtype=np.int64)
     n = perm.size
     if a is None or b is None:
-        bits = max(1, int(np.ceil(np.log2(max(n, 2)))))
-        a = 1 << ((bits + 1) // 2)
-        b = 1 << (bits - (bits + 1) // 2)
-        # b must be a power of two for the Euler split; a need not be,
-        # but powers of two keep transposes tile-friendly.
+        a, b = default_grid(n)
     total = a * b
     if total < n:
         raise ValueError(f"grid {a}x{b} smaller than permutation ({n})")

@@ -52,28 +52,43 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+CHAIN = 8  # ops chained per timed call (each input = previous output)
+
+
 def _time(fn, *args, reps=5):
+    """Median wall time of fn, with the result fetched host-side.
+
+    The 2026-07-31 window showed bare ``block_until_ready`` timings are
+    NOT decision-grade under the tunneled backend (an E-gather "ran" in
+    0.05 ms — 3x the HBM roofline): repeated identical calls can be
+    served without re-executing.  Every probe therefore CHAINS its op
+    ``CHAIN`` times inside one jit (data dependency per step — nothing
+    can be cached or elided) and ``float()`` forces the scalar home.
+    """
     out = fn(*args)
-    jax.block_until_ready(out)
+    float(np.asarray(out).ravel()[0])
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
         out = fn(*args)
-        jax.block_until_ready(out)
+        float(np.asarray(out).ravel()[0])
         ts.append(time.perf_counter() - t0)
     return float(np.median(ts))
 
 
 def probe_gather_baseline(E):
     perm = np.random.permutation(E).astype(np.int32)
-    x = jnp.arange(E, dtype=jnp.float32)
+    x = jnp.asarray(np.random.rand(E).astype(np.float32))
     permd = jnp.asarray(perm)
 
     @jax.jit
     def f(x, p):
-        return x[p].sum()
+        y = x
+        for _ in range(CHAIN):
+            y = y[p]  # output feeds the next gather: no step can be elided
+        return y.sum()
 
-    t = _time(f, x, permd)
+    t = _time(f, x, permd) / CHAIN
     print(f"a. XLA random gather     E={E:>10,}  {t*1e3:8.2f} ms  "
           f"{E/t/1e6:10.1f} Melem/s  {E*4/t/1e9:7.2f} GB/s")
     return t
@@ -83,17 +98,20 @@ def probe_transpose(E):
     # Exchange shape for the Clos middle stage: [A, B] -> [B, A].
     A = 8192
     B = E // A
-    x = jnp.arange(A * B, dtype=jnp.float32).reshape(A, B)
+    x = jnp.asarray(np.random.rand(A, B).astype(np.float32))
 
     @jax.jit
     def f(x):
-        # The barrier forces the transposed array to materialize; without
-        # it XLA folds the transpose into the permutation-invariant sum
-        # and the probe would time a plain sequential read.
-        y = jax.lax.optimization_barrier(x.T)
+        y = x
+        for i in range(CHAIN):
+            # *1.0000001 keeps each stage a distinct computation (T.T would
+            # fold to identity); the multiply fuses into the transpose
+            # write.  The barrier stops XLA from treating the transpose as
+            # a free layout change absorbed by a layout-agnostic consumer.
+            y = jax.lax.optimization_barrier(y.T) * jnp.float32(1.0000001)
         return y.sum()
 
-    t = _time(f, x)
+    t = _time(f, x) / CHAIN
     print(f"b. XLA transpose [{A}x{B}]      {t*1e3:8.2f} ms  "
           f"{A*B*4/t/1e9:7.2f} GB/s")
     return t
@@ -130,13 +148,19 @@ def probe_lane_gather_kernel(E):
             ],
             out_specs=pl.BlockSpec(TILE, lambda i: (i, 0)),
         )
-        g = jax.jit(lambda x, idx: f(x, idx).sum())
+        def chained(x, idx):
+            y = x
+            for _ in range(CHAIN):
+                y = f(y, idx)
+            return y.sum()
+
+        g = jax.jit(chained)
         # Correctness first: the permuted rows must sum to the same total.
         total = float(g(x, idx))
         np.testing.assert_allclose(
             total, float(xh.astype(np.float64).sum()), rtol=1e-3
         )
-        t = _time(g, x, idx)
+        t = _time(g, x, idx) / CHAIN
         print(f"c. pallas lane-gather    E={E:>10,}  {t*1e3:8.2f} ms  "
               f"{E/t/1e6:10.1f} Melem/s  {E*4/t/1e9:7.2f} GB/s")
         return t
@@ -180,8 +204,14 @@ def probe_benes_stage(E):
             ],
             out_specs=pl.BlockSpec(TILE, lambda i: (i, 0)),
         )
-        g = jax.jit(lambda x, m: f(x, m).sum())
-        t = _time(g, x, m)
+        def chained(x, m):
+            y = x
+            for _ in range(CHAIN):
+                y = f(y, m)
+            return y.sum()
+
+        g = jax.jit(chained)
+        t = _time(g, x, m) / CHAIN
         print(f"d. benes swap stage      E={E:>10,}  {t*1e3:8.2f} ms  "
               f"{E/t/1e6:10.1f} Melem/s  (x19 stages ~ "
               f"{19*t*1e3:6.1f} ms/full-perm upper bound)")
@@ -224,9 +254,16 @@ def probe_onehot_segsum(E):
             ).astype(jnp.float32)
             return jnp.einsum("nt,ntw->nw", pv_g, onehot).sum()
 
-        return jax.lax.map(group, (pv, idx)).sum()
+        s = jnp.float32(0.0)
+        for _ in range(CHAIN):
+            # Chain through the scalar: each pass's input is perturbed by
+            # the previous pass's result, so no pass can be elided.  The
+            # perturbing broadcast-add is stream-speed (noise next to the
+            # matmul passes being timed).
+            s = jax.lax.map(group, (pv + s * 1e-30, idx)).sum()
+        return s
 
-    t = _time(f, pv, idx)
+    t = _time(f, pv, idx) / CHAIN
     print(f"e. onehot segsum (MXU)   E={E:>10,}  {t*1e3:8.2f} ms  "
           f"{E/t/1e6:10.1f} Melem/s")
     return t
@@ -244,9 +281,12 @@ def probe_repeat_expand(E, d=262144):
 
     @jax.jit
     def f(w, f_sorted):
-        return w[f_sorted].sum()
+        s = jnp.float32(0.0)
+        for _ in range(CHAIN):
+            s = (w + s * 1e-30)[f_sorted].sum()  # scalar-chained: see _time
+        return s
 
-    t = _time(f, w, sorted_feat)
+    t = _time(f, w, sorted_feat) / CHAIN
     print(f"f. monotonic gather w[f] E={E:>10,}  {t*1e3:8.2f} ms  "
           f"{E/t/1e6:10.1f} Melem/s")
     return t
@@ -266,9 +306,12 @@ def probe_rowwise_gather(E):
 
     @jax.jit
     def f(x, idx):
-        return jnp.take_along_axis(x, idx, axis=1).sum()
+        y = x
+        for _ in range(CHAIN):
+            y = jnp.take_along_axis(y, idx, axis=1)
+        return y.sum()
 
-    t = _time(f, x, idx)
+    t = _time(f, x, idx) / CHAIN
     print(f"h. row-wise gather [{A}x{B}]  {t*1e3:8.2f} ms  "
           f"{E/t/1e6:10.1f} Melem/s  {E*4/t/1e9:7.2f} GB/s")
     return t
@@ -288,14 +331,16 @@ def probe_clos_composite(E):
 
     @jax.jit
     def f(x, p1, p2, p3):
-        g = jnp.take_along_axis(x, p1, axis=1)
-        g = g.T
-        g = jnp.take_along_axis(g, p2, axis=1)
-        g = g.T
-        g = jnp.take_along_axis(g, p3, axis=1)
+        g = x
+        for _ in range(CHAIN):
+            g = jnp.take_along_axis(g, p1, axis=1)
+            g = g.T
+            g = jnp.take_along_axis(g, p2, axis=1)
+            g = g.T
+            g = jnp.take_along_axis(g, p3, axis=1)
         return g.sum()
 
-    t = _time(f, x, p1, p2, p3)
+    t = _time(f, x, p1, p2, p3) / CHAIN
     print(f"i. clos 3-stage apply    E={E:>10,}  {t*1e3:8.2f} ms  "
           f"{E/t/1e6:10.1f} Melem/s  (vs probe a = the op it replaces)")
     return t
@@ -307,10 +352,15 @@ def probe_sort(E):
 
     @jax.jit
     def f(k, v):
-        _, sv = jax.lax.sort([k, v], num_keys=1)
-        return sv.sum()
+        for _ in range(CHAIN):
+            k, v = jax.lax.sort([k, v], num_keys=1)
+            # Re-randomize keys from the sorted values (cheap elementwise
+            # hash) so every chained sort does full work on unsorted keys.
+            vb = jax.lax.bitcast_convert_type(v, jnp.int32)
+            k = (vb * jnp.int32(-1640531527)) ^ k
+        return v.sum()
 
-    t = _time(f, k, v)
+    t = _time(f, k, v) / CHAIN
     print(f"g. XLA sort-by-key       E={E:>10,}  {t*1e3:8.2f} ms  "
           f"{E/t/1e6:10.1f} Melem/s")
     return t

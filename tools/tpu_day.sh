@@ -50,7 +50,17 @@ fi
 BASE="PHOTON_SPARSE_MARGIN= PHOTON_BENCH_DTYPE=float32 PHOTON_BENCH_SKEW=uniform PHOTON_BENCH_FUSED=0"
 
 echo "== probe_permute (UNMEASURED primitive table — run first) =="
-timeout 600 python -u tools/probe_permute.py > "$OUT/05_probe_permute.txt" 2>&1
+timeout 1200 python -u tools/probe_permute.py > "$OUT/05_probe_permute.txt" 2>&1
+
+echo "== probe_tiles (pallas grid-overhead sweep) =="
+timeout 1200 python -u tools/probe_tiles.py > "$OUT/07_probe_tiles.txt" 2>&1
+
+echo "== headline: benes (UNMEASURED static-permutation kernel) =="
+for pass in cold warm; do
+    env $BASE PHOTON_SPARSE_GRAD=benes \
+        timeout 900 python bench.py --headline-only \
+        > "$OUT/06_headline_benes_${pass}.txt" 2>&1
+done
 
 echo "== microbench2 (never completed on TPU — run second) =="
 timeout 900 python -u tools/microbench2.py > "$OUT/01_microbench2.txt" 2>&1
