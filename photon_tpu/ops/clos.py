@@ -161,11 +161,15 @@ def _edge_color_python(l: np.ndarray, r: np.ndarray, a: int,
 
 def route_permutation(perm: np.ndarray, a: Optional[int] = None,
                       b: Optional[int] = None, *,
-                      use_native: bool = True) -> ClosRoute:
+                      use_native: bool = True,
+                      device: bool = True) -> ClosRoute:
     """Factor ``y = x[perm]`` into the 3-stage row-local form.
 
     ``a``/``b`` default to the most square power-of-two grid covering
     ``len(perm)`` (padded with an identity tail when a*b > n).
+    ``device=False`` keeps the stage arrays as host numpy (callers that
+    re-factor stages, like ops/vperm, avoid shipping hundreds of MB of
+    intermediate routing through the device tunnel).
     """
     perm = np.ascontiguousarray(perm, dtype=np.int64)
     n = perm.size
@@ -193,7 +197,7 @@ def route_permutation(perm: np.ndarray, a: Optional[int] = None,
     if use_native:
         color = _edge_color_native(src_row, dst_row, a, b)
     if color is None:
-        if total > (1 << 18):
+        if total >= (1 << 18):
             # The Python fallback is a per-edge interpreter loop over
             # log2(b) levels — hours at production scale.  Fail fast
             # instead of silently stalling batch attach.
@@ -215,6 +219,8 @@ def route_permutation(perm: np.ndarray, a: Optional[int] = None,
     p1[src_row, color] = src_col
     p2[color, dst_row] = src_row
     p3[dst_row, dst_col] = color
+    if not device:
+        return ClosRoute(n=n, a=a, b=b, p1=p1, p2=p2, p3=p3)
     return ClosRoute(n=n, a=a, b=b, p1=jnp.asarray(p1), p2=jnp.asarray(p2),
                      p3=jnp.asarray(p3))
 

@@ -1,0 +1,297 @@
+"""vperm: fast static E-element permutations from measured-fast primitives.
+
+The round-4 chained hardware probes (ops/KERNEL_NOTES.md, third window)
+showed this chip runs data-DEPENDENT XLA ops at 23–275 Melem/s (gather
+68, row-wise gather 70, sort 275, 3-stage XLA Clos 23) while pallas
+lane-local gathers run at 3.4 Gelem/s and XLA strided transposes at
+14 GB/s.  The sparse-GLM hot loop needs exactly one data-dependent
+movement per direction — the static row-order ↔ feature-order exchange
+of the entry stream — so routing that exchange through the fast
+primitives is the whole performance ballgame.
+
+Decomposition (two-level Clos, all stages static, routed on host):
+
+    y = x[perm]  over a padded domain  N = NC × CS,  CS = CH×128 = 2^18
+
+      chunk stage R1   — arbitrary perm within each CS-element chunk,
+                         itself a fused 5-stage in-VMEM micro-Clos
+                         (lane-gather / VMEM transpose / wide row-gather
+                         / VMEM transpose / lane-gather), one pallas
+                         pass over HBM
+      transpose        — [NC, CS] → [CS, NC] (XLA, strided, fast)
+      lane stage  C    — per-column NC-perms of the transposed view,
+                         lane-packed into [total/128, 128] tiles
+                         (NC is a power of two ≤ 128, so 128/NC logical
+                         rows pack per vreg row), one pallas pass
+      transpose back   — [CS, NC] → [NC, CS]
+      chunk stage R2   — as R1
+
+Host routing is three levels of bipartite edge-coloring (Slepian–Duguid
+route construction, native/src/clos_route.cpp): one macro coloring on
+the [NC, CS] grid and two micro colorings per chunk on [CH, 128].
+Routing is one-time per dataset layout (the permutation is static data
+layout, not step data) and is carried as int8/int16 index planes so the
+per-step routing read is ~5 bytes/element.
+
+The reference has no analog: its Spark shuffle IS a dynamic random
+exchange (SURVEY.md §2.6).  This module is the TPU-native re-design
+that makes the same data movement run at sequential-stream speeds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+from photon_tpu.ops.clos import route_permutation
+
+Array = jax.Array
+
+LANES = 128
+CH = 2048                    # chunk sublane-rows
+CS = CH * LANES              # chunk elements (2^18)
+MAX_N = 128 * CS             # lane stage holds NC <= 128 chunks
+
+
+@dataclasses.dataclass(frozen=True)
+class VpermRoute:
+    """Device-ready routing for one static permutation over ``total``
+    padded elements (``n`` real).  Index planes are stored narrow
+    (int8/int16) and upcast in-kernel; shapes are static per layout.
+
+    ``i1/i3`` and ``i4/i6``: [NC*CH, 128] int8 lane indices for the two
+    chunk stages' outer lane-gathers.  ``i2``/``i5``: [NC*128, CH] int16
+    wide row-gather indices on the transposed [128, CH] chunk view.
+    ``c``: [total/128, 128] int8 lane-packed middle-stage indices
+    (``None`` when NC == 1 and the middle stage is the identity).
+    """
+
+    n: int
+    nc: int
+    i1: jnp.ndarray
+    i2: jnp.ndarray
+    i3: jnp.ndarray
+    c: object
+    i4: object
+    i5: object
+    i6: object
+
+    @property
+    def total(self) -> int:
+        return self.nc * CS
+
+
+tree_util.register_dataclass(
+    VpermRoute,
+    data_fields=("i1", "i2", "i3", "c", "i4", "i5", "i6"),
+    meta_fields=("n", "nc"),
+)
+
+
+def _chunk_stage_arrays(rows: np.ndarray):
+    """Factor per-chunk CS-perms into the 5-stage micro-Clos planes.
+
+    ``rows`` is [NC, CS] int64: row i is the permutation applied within
+    chunk i (y_chunk = x_chunk[rows[i]]).  Returns (i1 [NC*CH, 128] int8,
+    i2 [NC*128, CH] int16, i3 [NC*CH, 128] int8).
+    """
+    nc = rows.shape[0]
+    i1 = np.empty((nc * CH, LANES), np.int8)
+    i2 = np.empty((nc * LANES, CH), np.int16)
+    i3 = np.empty((nc * CH, LANES), np.int8)
+    for i in range(nc):
+        r = route_permutation(rows[i], a=CH, b=LANES, device=False)
+        # clos stage semantics (apply_clos_grid): lane-gather by p1 on
+        # [CH,128], transpose, row-gather by p2 on [128,CH], transpose,
+        # lane-gather by p3.
+        i1[i * CH:(i + 1) * CH] = r.p1.astype(np.int8)
+        i2[i * LANES:(i + 1) * LANES] = r.p2.astype(np.int16)
+        i3[i * CH:(i + 1) * CH] = r.p3.astype(np.int8)
+    return i1, i2, i3
+
+
+def _pack_middle(cidx: np.ndarray, nc: int) -> np.ndarray:
+    """Lane-pack the [CS, NC] per-row middle perms into [total/128, 128].
+
+    NC divides 128, so each vreg row holds 128/NC whole logical rows;
+    the packed lane index for flat position p*128+l is
+    ``(l//NC)*NC + cidx[s, l%NC]`` with ``s = (p*128+l)//NC`` — still a
+    within-128-lane gather.
+    """
+    cs = cidx.shape[0]
+    total = cs * nc
+    flat = np.arange(total, dtype=np.int64)
+    s = flat // nc
+    c = flat % nc
+    packed = ((flat % 128) // nc * nc + cidx[s, c]).astype(np.int8)
+    return packed.reshape(total // LANES, LANES)
+
+
+def route_vperm(perm: np.ndarray) -> VpermRoute:
+    """Route ``y = x[perm]`` (n-element permutation, n ≤ MAX_N).
+
+    The domain pads to NC whole chunks (NC a power of two ≤ 128); pad
+    slots map identically so padded inputs carry zeros through
+    untouched.
+    """
+    perm = np.ascontiguousarray(perm, dtype=np.int64)
+    n = perm.size
+    if n > MAX_N:
+        raise ValueError(
+            f"vperm supports up to {MAX_N:,} elements single-device "
+            f"(got {n:,}); shard the layout across devices first"
+        )
+    if n and (perm.min() < 0 or perm.max() >= n
+              or np.bincount(perm, minlength=n).max() != 1):
+        raise ValueError("perm is not a permutation of [0, n)")
+    nc = max(1, -(-n // CS))
+    if nc & (nc - 1):
+        nc = 1 << nc.bit_length()  # power of two so NC divides 128
+    total = nc * CS
+    full = np.arange(total, dtype=np.int64)
+    full[:n] = perm
+
+    # Macro Clos on [NC, CS]: row stages become chunk-local perms, the
+    # middle stage becomes per-column NC-perms (the lane stage after the
+    # transpose).  For NC == 1 the single chunk stage R1 carries the
+    # whole permutation and the rest of the pipeline is skipped.
+    if nc == 1:
+        i1, i2, i3 = _chunk_stage_arrays(full[None, :])
+        c = i4 = i5 = i6 = None
+    else:
+        r = route_permutation(full, a=nc, b=CS, device=False)
+        i1, i2, i3 = _chunk_stage_arrays(r.p1.astype(np.int64))
+        c = jnp.asarray(_pack_middle(r.p2.astype(np.int64), nc))
+        i4, i5, i6 = (
+            jnp.asarray(p)
+            for p in _chunk_stage_arrays(r.p3.astype(np.int64))
+        )
+
+    return VpermRoute(
+        n=n, nc=nc,
+        i1=jnp.asarray(i1), i2=jnp.asarray(i2), i3=jnp.asarray(i3),
+        c=c, i4=i4, i5=i5, i6=i6,
+    )
+
+
+def _chunk_kernel(x_ref, i1_ref, i2_ref, i3_ref, o_ref):
+    """Fused 5-stage micro-Clos over one [CH, 128] chunk in VMEM."""
+    y = jnp.take_along_axis(
+        x_ref[...], i1_ref[...].astype(jnp.int32), axis=1
+    )
+    y = y.T  # [128, CH] in VMEM
+    y = jnp.take_along_axis(y, i2_ref[...].astype(jnp.int32), axis=1)
+    y = y.T
+    o_ref[...] = jnp.take_along_axis(
+        y, i3_ref[...].astype(jnp.int32), axis=1
+    )
+
+
+def _lane_kernel(x_ref, c_ref, o_ref):
+    o_ref[...] = jnp.take_along_axis(
+        x_ref[...], c_ref[...].astype(jnp.int32), axis=1
+    )
+
+
+def _chunk_pass(x2d: Array, i1: Array, i2: Array, i3: Array, nc: int,
+                interpret: bool) -> Array:
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _chunk_kernel,
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((CH, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((CH, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((LANES, CH), lambda i: (i, 0)),
+            pl.BlockSpec((CH, LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((CH, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x2d, i1, i2, i3)
+
+
+def _lane_pass(x2d: Array, c: Array, interpret: bool) -> Array:
+    from jax.experimental import pallas as pl
+
+    n_tiles = x2d.shape[0] // CH
+    return pl.pallas_call(
+        _lane_kernel,
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((CH, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((CH, LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((CH, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x2d, c)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def apply_vperm(x: Array, route: VpermRoute,
+                interpret: bool = False) -> Array:
+    """Apply the routed permutation to a flat [n] array → flat [n].
+
+    Pipeline: chunk pass R1 → transpose [NC,CS]→[CS,NC] → lane-packed
+    middle pass → transpose back → chunk pass R2.  Three pallas passes
+    plus two XLA transposes, no data-dependent XLA ops.  NC == 1 runs
+    the single chunk pass only.
+    """
+    n, nc, total = route.n, route.nc, route.total
+    if x.shape[0] != n:
+        raise ValueError(f"length {x.shape[0]} != routed n {n}")
+    dtype = x.dtype
+    if total > n:
+        x = jnp.concatenate([x, jnp.zeros(total - n, dtype)])
+    g = x.reshape(nc * CH, LANES)
+    g = _chunk_pass(g, route.i1, route.i2, route.i3, nc, interpret)
+    if nc > 1:
+        # [NC, CS] -> [CS, NC]: per-column NC-perms become lane-local
+        # once packed; flat row-major order of the [CS, NC] view is the
+        # packed [total/128, 128] layout _pack_middle indexed.
+        t = g.reshape(nc, CS).T.reshape(nc * CH, LANES)
+        t = _lane_pass(t, route.c, interpret)
+        g = t.reshape(CS, nc).T.reshape(nc * CH, LANES)
+        g = _chunk_pass(g, route.i4, route.i5, route.i6, nc, interpret)
+    return g.reshape(total)[:n]
+
+
+def invert_vperm(route: VpermRoute) -> VpermRoute:
+    """The inverse permutation's route from the same routing (no second
+    edge-coloring): run the pipeline backwards with each stage's rows
+    inverted row-wise.  A chunk stage applies (i1, T, i2, T, i3); its
+    inverse applies (inv i3, T, inv i2, T, inv i1) — the same kernel
+    shape — and the middle lane stage inverts row-wise (each packed row
+    is a 128-perm, so argsort per row is its inverse)."""
+
+    def inv_rows(p):
+        return jnp.argsort(p.astype(jnp.int32), axis=1).astype(p.dtype)
+
+    if route.nc == 1:
+        return VpermRoute(
+            n=route.n, nc=1,
+            i1=inv_rows(route.i3), i2=inv_rows(route.i2),
+            i3=inv_rows(route.i1),
+            c=None, i4=None, i5=None, i6=None,
+        )
+    return VpermRoute(
+        n=route.n, nc=route.nc,
+        i1=inv_rows(route.i6), i2=inv_rows(route.i5),
+        i3=inv_rows(route.i4),
+        c=inv_rows(route.c),
+        i4=inv_rows(route.i3), i5=inv_rows(route.i2),
+        i6=inv_rows(route.i1),
+    )
+
+
+def apply_vperm_reference(x: np.ndarray, perm: np.ndarray) -> np.ndarray:
+    """NumPy oracle for tests."""
+    return np.asarray(x)[np.asarray(perm)]

@@ -1,0 +1,86 @@
+"""vperm engine: routed static permutations == numpy oracle.
+
+The vperm pipeline (ops/vperm.py) is the round-4 exchange design:
+chunk-fused micro-Clos pallas passes + XLA transposes + a lane-packed
+middle stage.  These tests run the kernels in interpret mode on CPU
+(the same kernel code lowers on TPU) over every structural case: single
+chunk, padded single chunk, multi-chunk with the middle stage, padded
+multi-chunk, and the argsort-based inverse.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from photon_tpu.ops.vperm import (
+    CS,
+    VpermRoute,
+    apply_vperm,
+    apply_vperm_reference,
+    invert_vperm,
+    route_vperm,
+)
+
+INTERP = jax.default_backend() != "tpu"
+
+
+def _check(n, seed):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n).astype(np.int64)
+    x = rng.standard_normal(n).astype(np.float32)
+    route = route_vperm(perm)
+    got = np.asarray(apply_vperm(jax.numpy.asarray(x), route,
+                                 interpret=INTERP))
+    np.testing.assert_array_equal(got, apply_vperm_reference(x, perm))
+    return route
+
+
+def test_single_chunk_exact():
+    route = _check(CS, seed=0)
+    assert route.nc == 1
+
+
+def test_single_chunk_padded():
+    route = _check(CS - 12345, seed=1)
+    assert route.nc == 1
+
+
+def test_multi_chunk_exact():
+    route = _check(2 * CS, seed=2)
+    assert route.nc == 2
+
+
+def test_multi_chunk_padded_to_pow2():
+    # ceil(n/CS) == 3 pads to NC = 4 so the middle stage lane-packs.
+    route = _check(3 * CS - 777, seed=3)
+    assert route.nc == 4
+
+
+def test_inverse_roundtrip():
+    n = 2 * CS
+    rng = np.random.default_rng(4)
+    perm = rng.permutation(n).astype(np.int64)
+    x = rng.standard_normal(n).astype(np.float32)
+    route = route_vperm(perm)
+    inv = invert_vperm(route)
+    y = apply_vperm(jax.numpy.asarray(x), route, interpret=INTERP)
+    back = np.asarray(apply_vperm(y, inv, interpret=INTERP))
+    np.testing.assert_array_equal(back, x)
+    # And the inverse alone equals the numpy inverse permutation.
+    inv_perm = np.argsort(perm)
+    got = np.asarray(apply_vperm(jax.numpy.asarray(x), inv,
+                                 interpret=INTERP))
+    np.testing.assert_array_equal(got, apply_vperm_reference(x, inv_perm))
+
+
+def test_rejects_non_permutation():
+    with pytest.raises(ValueError):
+        route_vperm(np.array([0, 1, 1, 3], dtype=np.int64))
+
+
+def test_rejects_oversize():
+    from photon_tpu.ops.vperm import MAX_N
+
+    with pytest.raises(ValueError):
+        route_vperm(np.arange(MAX_N + 1, dtype=np.int64))

@@ -34,46 +34,15 @@ window contains no host copies of the payload.
 """
 
 import argparse
-import os
-import time
 
 import numpy as np
 
-import jax
+from probe_common import CHAIN, timed as _time  # noqa: F401 (cpu guard)
 
-# The axon site registration intercepts backend init and dials the TPU
-# tunnel even when JAX_PLATFORMS=cpu is exported (hang observed 2026-07-31
-# when the tunnel was down); the config update is the override that
-# actually sticks, same as tests/conftest.py and bench.py use.
-if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-
-
-CHAIN = 8  # ops chained per timed call (each input = previous output)
-
-
-def _time(fn, *args, reps=5):
-    """Median wall time of fn, with the result fetched host-side.
-
-    The 2026-07-31 window showed bare ``block_until_ready`` timings are
-    NOT decision-grade under the tunneled backend (an E-gather "ran" in
-    0.05 ms — 3x the HBM roofline): repeated identical calls can be
-    served without re-executing.  Every probe therefore CHAINS its op
-    ``CHAIN`` times inside one jit (data dependency per step — nothing
-    can be cached or elided) and ``float()`` forces the scalar home.
-    """
-    out = fn(*args)
-    float(np.asarray(out).ravel()[0])
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        float(np.asarray(out).ravel()[0])
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
 
 
 def probe_gather_baseline(E):

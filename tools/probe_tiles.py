@@ -19,36 +19,15 @@ under the tunneled backend.
 """
 
 import argparse
-import os
-import time
 
 import numpy as np
 
-import jax
+from probe_common import CHAIN, LANES, timed as _time  # noqa: F401
 
-# The axon site registration dials the TPU tunnel even when
-# JAX_PLATFORMS=cpu is exported; the config update is the override that
-# sticks (same guard as tools/probe_permute.py / bench.py).
-if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-
-CHAIN = 8
-LANES = 128
-
-
-def _time(fn, *args, reps=5):
-    out = fn(*args)
-    float(np.asarray(out).ravel()[0])
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        float(np.asarray(out).ravel()[0])
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
 
 
 def copy_kernel(x_ref, o_ref):
@@ -103,7 +82,10 @@ def xla_baseline(E):
     def g(x):
         y = x
         for _ in range(CHAIN):
-            y = y * jnp.float32(1.0000001)
+            # Barrier per step: without it XLA fuses the chain into one
+            # HBM pass (or folds to a single multiply) and /CHAIN
+            # under-reports ~CHAIN-fold (probe_common methodology note).
+            y = jax.lax.optimization_barrier(y * jnp.float32(1.0000001))
         return y.sum()
 
     t = _time(g, x0) / CHAIN

@@ -49,20 +49,23 @@ fi
 # operator's ambient exports cannot contaminate the labeled files.
 BASE="PHOTON_SPARSE_MARGIN= PHOTON_BENCH_DTYPE=float32 PHOTON_BENCH_SKEW=uniform PHOTON_BENCH_FUSED=0"
 
-echo "== probe_permute (UNMEASURED primitive table — run first) =="
-timeout 1200 python -u tools/probe_permute.py > "$OUT/05_probe_permute.txt" 2>&1
+# Third-window (2026-07-31 03:14) banked: the benes headline (0.168
+# steps/s, refuted) and the chained probe_permute table.  Remaining
+# unmeasured items lead; everything below them is re-confirmation.
 
-echo "== probe_tiles (pallas grid-overhead sweep) =="
+echo "== probe_blocklocal (UNMEASURED — decides the block-local kernel) =="
+if [ -f tools/probe_blocklocal.py ]; then
+    timeout 1200 python -u tools/probe_blocklocal.py \
+        > "$OUT/08_probe_blocklocal.txt" 2>&1
+fi
+
+echo "== probe_tiles (pallas grid-overhead sweep — never completed) =="
 timeout 1200 python -u tools/probe_tiles.py > "$OUT/07_probe_tiles.txt" 2>&1
 
-echo "== headline: benes (UNMEASURED static-permutation kernel) =="
-for pass in cold warm; do
-    env $BASE PHOTON_SPARSE_GRAD=benes \
-        timeout 900 python bench.py --headline-only \
-        > "$OUT/06_headline_benes_${pass}.txt" 2>&1
-done
+echo "== probe_permute (chained re-confirmation) =="
+timeout 1200 python -u tools/probe_permute.py > "$OUT/05_probe_permute.txt" 2>&1
 
-echo "== microbench2 (never completed on TPU — run second) =="
+echo "== microbench2 (never completed on TPU) =="
 timeout 900 python -u tools/microbench2.py > "$OUT/01_microbench2.txt" 2>&1
 
 echo "== headline: per kernel (banked 2026-07-30/31 — re-confirmation) =="
