@@ -788,6 +788,7 @@ def main() -> None:
         kernel = "auto:" + select_kernel(
             nnz, d, n, has_fm=batch.fm is not None,
             has_aligned=batch.al is not None,
+            has_xchg=batch.xchg is not None,
         )
     _emit("glm_grad_steps_per_sec", steps_per_sec, "steps/s", {
         "rows": n,

@@ -168,7 +168,7 @@ class GlmObjective:
 
             n, k = batch.ids.shape
             return benes_xu_product(u, batch.al, batch.benes, n, k)
-        if kernel == "pallas" and batch.al_t is not None:
+        if kernel in ("pallas", "xchg") and batch.al_t is not None:
             from photon_tpu.ops.pallas_gather import aligned_segment_grad
 
             return aligned_segment_grad(u, batch.al_t, batch.ids.shape[0])
@@ -176,7 +176,7 @@ class GlmObjective:
 
     def _margins_for_kernel(self, kernel: str, w: Array, batch: Batch) -> Array:
         fwd_kernel = kernel == "benes" or (
-            kernel == "pallas" and batch.al_t is not None
+            kernel in ("pallas", "xchg") and batch.al_t is not None
         )
         if not fwd_kernel:
             # Single home of the normalization algebra for the XLA forward.
@@ -210,6 +210,7 @@ class GlmObjective:
         has_fm = batch.fm is not None
         has_al = batch.al is not None
         has_benes = batch.benes is not None and has_al
+        has_xchg = batch.xchg is not None and has_al
         if not (has_fm or has_al):
             return None
         if dim is None:
@@ -220,12 +221,19 @@ class GlmObjective:
         choice = select_kernel(
             n * k, dim, n,
             has_fm=has_fm, has_aligned=has_al, has_benes=has_benes,
+            has_xchg=has_xchg,
         )
         return None if choice == "autodiff" else choice
 
     def _segment_grad(self, kernel: str, per_row: Array, batch: Batch, dim: int) -> Array:
         """``g[f] = sum_e per_row[row_e] * val_e`` via the selected static
         layout (the reduction both the gradient and Hv share)."""
+        if kernel == "xchg":
+            from photon_tpu.ops.vperm import xchg_segment_grad
+
+            return xchg_segment_grad(
+                per_row, batch.vals, batch.al, batch.xchg, dim
+            )
         if kernel == "benes":
             from photon_tpu.ops.benes import benes_segment_grad
 
@@ -330,7 +338,7 @@ class GlmObjective:
         alongside the aligned one — or plain autodiff.  The benes path
         contains the same pallas_call and routes identically."""
         kernel = self._sparse_kernel(batch, int(w.shape[0]))
-        if kernel in ("pallas", "benes"):
+        if kernel in ("pallas", "benes", "xchg"):
             kernel = "fm" if batch.fm is not None else None
         if kernel is not None:
             _, g = self._fast_data_value_and_grad(w, batch, kernel)

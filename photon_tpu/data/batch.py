@@ -114,6 +114,13 @@ class SparseBatch(NamedTuple):
     # ``attach_feature_major(..., aligned_dim=d)`` when
     # ``PHOTON_SPARSE_GRAD=benes``.  Requires ``al``.
     benes: Optional["object"] = None
+    # Optional vperm routing (ops/vperm.VpermRoute) for the `xchg` kernel:
+    # row-major products ride a 3-pass static permutation into aligned
+    # slot order instead of the per-step E-element XLA gather.  Built by
+    # ``attach_feature_major(..., aligned_dim=d)`` when
+    # ``PHOTON_SPARSE_GRAD`` is ``xchg`` or ``auto``.  Requires ``al``
+    # (and uses ``al_t`` for margins when present).
+    xchg: Optional["object"] = None
 
     @property
     def num_examples(self) -> int:
@@ -276,18 +283,27 @@ def attach_feature_major(
             device_layout,
         )
 
+        from photon_tpu.ops.sparse_grad_select import xchg_route_wanted
+
         ids_np = np.asarray(batch.ids)
         vals_np = np.asarray(batch.vals, np.float32)
         layout = build_aligned_layout(ids_np, vals_np, aligned_dim)
         batch = batch._replace(al=device_layout(layout))
+        want_xchg = xchg_route_wanted(n * k)
         if aligned_forward is None:
-            aligned_forward = (
+            # xchg implies the pallas forward: its whole point is deleting
+            # the E-element gathers, and XLA margins would reintroduce one.
+            aligned_forward = want_xchg or (
                 os.environ.get("PHOTON_SPARSE_MARGIN", "xla") == "pallas"
             )
         if aligned_forward:
             batch = batch._replace(
                 al_t=device_layout(build_row_aligned_layout(ids_np, vals_np))
             )
+        if want_xchg:
+            from photon_tpu.ops.vperm import build_xchg_route
+
+            batch = batch._replace(xchg=build_xchg_route(layout, n, k))
         if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "benes":
             # Explicit opt-in only: the routing (host edge-coloring) is the
             # most expensive layout build in the package; auto mode never

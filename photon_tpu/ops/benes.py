@@ -100,21 +100,10 @@ def build_benes_aux(layout, n: int, k: int, *, a: int | None = None,
     # entry) when real; pad slots and the grid tail take the unused
     # sources (row-major pad entries dropped by the layout's val != 0
     # filter, plus the zero-padded tail) in order — they only ever carry
-    # zeros.
-    perm = np.empty(total, dtype=np.int64)
-    real = slots_src >= 0
-    perm[: n_slots][real] = slots_src[real]
-    used = np.zeros(total, dtype=bool)
-    used[slots_src[real]] = True
-    unused = np.flatnonzero(~used)
-    n_pad_slots = int((~real).sum()) + (total - n_slots)
-    if unused.size != n_pad_slots:
-        raise ValueError(
-            "layout src is not injective into the row-major stream"
-        )
-    perm[: n_slots][~real] = unused[: int((~real).sum())]
-    perm[n_slots:] = unused[int((~real).sum()):]
+    # zeros.  (Construction shared with the xchg route.)
+    from photon_tpu.ops.vperm import full_bijection
 
+    perm = full_bijection(slots_src, n_rowmajor, total)
     to_slots = route_permutation(perm, a, b)
     return BenesAux(
         to_slots=to_slots,

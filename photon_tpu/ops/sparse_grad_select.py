@@ -24,12 +24,15 @@ runs at trace time with concrete inputs (the same eager-probe pattern as
 ops/pallas_sparse.kernel_supported) and costs a few hundred ms once per
 process per shape regime.
 
-Override with ``PHOTON_SPARSE_GRAD=fm|autodiff|pallas|benes|auto``
-(default auto).  The pallas candidate enters auto mode only on a real TPU
-backend (interpret mode on CPU is a test vehicle, orders of magnitude
-slower).  ``benes`` — the static-permutation kernel (ops/benes.py, no
-random E-access in either direction) — is explicit-opt-in only until a
-hardware window measures it.
+Override with ``PHOTON_SPARSE_GRAD=fm|autodiff|pallas|xchg|benes|auto``
+(default auto).  The pallas and xchg candidates enter auto mode only on
+a real TPU backend (interpret mode on CPU is a test vehicle, orders of
+magnitude slower).  ``xchg`` (ops/vperm.py) replaces the per-step
+E-element ``dz[rows]`` gather with a 3-pass static vperm pipeline — the
+round-4 third-window design; it auto-probes when the batch carries a
+route (``xchg_route_wanted``).  ``benes`` — the XLA-staged
+static-permutation kernel (ops/benes.py) — was REFUTED on hardware
+(0.168 steps/s) and stays explicit-opt-in as a research path.
 """
 
 from __future__ import annotations
@@ -70,7 +73,8 @@ def _bucket(n: int) -> int:
     return max(int(n).bit_length(), 1)
 
 
-def _measure(e: int, d: int, n: int, with_pallas: bool) -> str:
+def _measure(e: int, d: int, n: int, with_pallas: bool,
+             with_xchg: bool = False) -> str:
     import jax
     import jax.numpy as jnp
 
@@ -84,13 +88,23 @@ def _measure(e: int, d: int, n: int, with_pallas: bool) -> str:
     ids_j = jnp.asarray(flat_ids)
 
     def t(fn, *args, reps=3):
+        # Chained-salt methodology (tools/probe_common.py): repeated
+        # IDENTICAL calls are not decision-grade under the tunneled
+        # backend (an E-gather "ran" at 3x the HBM roofline in the
+        # round-4 third window) — salt the first argument per rep so no
+        # call can be served from a cache, prepare the salt OUTSIDE the
+        # timed window, and fetch the scalar host-side per rep.
         fj = jax.jit(fn)
-        np.asarray(fj(*args))  # compile + sync through a host copy
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = fj(*args)
-        np.asarray(out)
-        return (time.perf_counter() - t0) / reps
+        float(np.asarray(fj(*args)).ravel()[0])  # compile + sync
+        ts = []
+        for i in range(reps):
+            salted = args[0] + jnp.float32((i + 1) * 1e-12)
+            jax.block_until_ready(salted)
+            t0 = time.perf_counter()
+            out = fj(salted, *args[1:])
+            float(np.asarray(out).ravel()[0])
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
 
     timings = {
         "fm": t(
@@ -145,6 +159,54 @@ def _measure(e: int, d: int, n: int, with_pallas: bool) -> str:
                 "(max abs err %.3g); excluded from auto selection",
                 float(np.abs(g_dev - g_ref).max()),
             )
+        if with_xchg and "pallas" in timings:
+            # Same correctness-gate-then-time discipline; the route build
+            # (host edge-coloring) is the dominant probe cost, paid once
+            # per shape bucket.  per_row here is dz over the probe's rows;
+            # vals enter row-major, so the oracle is the same layout
+            # reference the pallas gate used.
+            try:
+                from photon_tpu.ops.vperm import (
+                    build_xchg_route,
+                    xchg_segment_grad,
+                )
+
+                route = build_xchg_route(layout, n_probe, k)
+                vals2d = jnp.asarray(
+                    np.asarray(vals)[: n_probe * k].reshape(n_probe, k)
+                )
+                g_dev = np.asarray(xchg_segment_grad(
+                    dz_probe, vals2d, al, route, d, interpret=False
+                ))
+                ref = np.zeros(d, np.float64)
+                np.add.at(
+                    ref,
+                    flat_ids[: n_probe * k],
+                    (np.asarray(dz_probe)[:, None]
+                     * np.asarray(vals2d)).reshape(-1).astype(np.float64),
+                )
+                scale = max(float(np.abs(ref).max()), 1.0)
+                if np.allclose(g_dev, ref, rtol=2e-4, atol=1e-4 * scale):
+                    timings["xchg"] = t(
+                        lambda dz: jnp.sum(xchg_segment_grad(
+                            dz, vals2d, al, route, d, interpret=False
+                        )),
+                        dz_probe,
+                    )
+                else:
+                    import logging
+
+                    logging.getLogger("photon_tpu.sparse_grad").warning(
+                        "xchg kernel FAILED the on-device correctness gate "
+                        "(max abs err %.3g); excluded from auto selection",
+                        float(np.abs(g_dev - ref).max()),
+                    )
+            except Exception as exc:  # noqa: BLE001 — probe must not kill
+                import logging
+
+                logging.getLogger("photon_tpu.sparse_grad").warning(
+                    "xchg probe unavailable (%s); excluded", exc
+                )
     return min(timings, key=timings.get)
 
 
@@ -165,10 +227,11 @@ def select_kernel(
     has_fm: bool = True,
     has_aligned: bool = False,
     has_benes: bool = False,
+    has_xchg: bool = False,
 ) -> str:
     """Pick the gradient kernel — ``"fm"``, ``"autodiff"``, ``"pallas"``,
-    or ``"benes"`` — for this problem size on the current backend,
-    restricted to the layouts the batch actually carries."""
+    ``"benes"``, or ``"xchg"`` — for this problem size on the current
+    backend, restricted to the layouts the batch actually carries."""
     mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
     if mode == "autodiff":
         return "autodiff"
@@ -178,10 +241,16 @@ def select_kernel(
         # Forced pallas runs in interpret mode off-TPU (tests / parity
         # checks); it still needs the aligned layout on the batch.
         return "pallas" if has_aligned else ("fm" if has_fm else "autodiff")
+    if mode == "xchg":
+        # The vperm-exchange kernel: row-major products ride a static
+        # 3-pass permutation into slot order, deleting the per-step
+        # E-element dz[rows] gather (measured 493 ms at E=2^25).
+        return "xchg" if has_xchg else (
+            "pallas" if has_aligned else ("fm" if has_fm else "autodiff")
+        )
     if mode == "benes":
-        # Explicit opt-in only (its routing is the costliest layout build);
-        # auto mode never enters it until a hardware measurement justifies
-        # probing it (KERNEL_NOTES.md round-4 second-window plan).
+        # Explicit opt-in only — REFUTED on hardware (0.168 steps/s,
+        # KERNEL_NOTES round-4 third window); kept as a research path.
         return "benes" if has_benes else (
             "pallas" if has_aligned else ("fm" if has_fm else "autodiff")
         )
@@ -195,13 +264,17 @@ def select_kernel(
         return "autodiff"
 
     with_pallas = has_aligned and _pallas_eligible()
-    key = (jax.default_backend(), _bucket(e_total), _bucket(dim), with_pallas)
+    with_xchg = has_xchg and with_pallas
+    key = (
+        jax.default_backend(), _bucket(e_total), _bucket(dim),
+        with_pallas, with_xchg,
+    )
     if key not in _CACHE:
         try:
             scale = max(1, -(-e_total // _probe_cap()))  # ceil: cap probe size
             e = max(e_total // scale, 1 << 10)
             n = max(n_rows // scale, 64)
-            _CACHE[key] = _measure(e, dim, n, with_pallas)
+            _CACHE[key] = _measure(e, dim, n, with_pallas, with_xchg)
         except Exception:  # noqa: BLE001 — a failed probe must not kill training
             # Measured on real TPU hardware (KERNEL_NOTES.md round-4 table):
             # autodiff beats fm 1.881 vs 1.124 steps/s at the headline shape.
@@ -218,6 +291,8 @@ def select_kernel(
             key[0], key[1], key[2], _CACHE[key],
         )
     choice = _CACHE[key]
+    if choice == "xchg" and not has_xchg:
+        choice = "pallas" if has_aligned else "fm"
     if choice == "pallas" and not has_aligned:
         choice = "fm"
     if choice == "fm" and not has_fm:
@@ -234,7 +309,7 @@ def aligned_layout_wanted(e_total: int | None = None) -> bool:
     auto mode is guaranteed to run autodiff, so the build would be pure
     wasted host time."""
     mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
-    if mode in ("pallas", "benes"):
+    if mode in ("pallas", "benes", "xchg"):
         return True
     if mode != "auto":
         return False
@@ -242,6 +317,31 @@ def aligned_layout_wanted(e_total: int | None = None) -> bool:
         return False
     try:
         return _pallas_eligible()
+    except Exception:  # noqa: BLE001 — never block batch build on a probe
+        return False
+
+
+def xchg_route_wanted(e_total: int) -> bool:
+    """Should batch builders pay the vperm route construction (host
+    edge-coloring, the costliest layout build)?  Forced mode always;
+    auto mode only on a TPU backend above a size floor where the
+    per-step gather the route deletes dominates the one-time build
+    (override with PHOTON_XCHG_FLOOR; PHOTON_XCHG=0 disables)."""
+    from photon_tpu.utils.env import env_int
+
+    mode = os.environ.get("PHOTON_SPARSE_GRAD", "auto")
+    if mode == "xchg":
+        return True
+    if mode != "auto" or os.environ.get("PHOTON_XCHG", "1") == "0":
+        return False
+    if e_total < env_int("PHOTON_XCHG_FLOOR", 1 << 23, minimum=1):
+        return False
+    try:
+        if not _pallas_eligible():
+            return False
+        from photon_tpu.native.build import get_lib
+
+        return get_lib() is not None
     except Exception:  # noqa: BLE001 — never block batch build on a probe
         return False
 

@@ -14,14 +14,19 @@ import pytest
 import jax
 
 from photon_tpu.ops.vperm import (
-    CS,
+    CH_SMALL,
+    LANES,
     VpermRoute,
     apply_vperm,
     apply_vperm_reference,
+    full_bijection,
     invert_vperm,
+    pick_geometry,
     route_vperm,
+    route_vperm_full,
 )
 
+CS = CH_SMALL * LANES
 INTERP = jax.default_backend() != "tpu"
 
 
@@ -83,4 +88,57 @@ def test_rejects_oversize():
     from photon_tpu.ops.vperm import MAX_N
 
     with pytest.raises(ValueError):
-        route_vperm(np.arange(MAX_N + 1, dtype=np.int64))
+        pick_geometry(MAX_N + 1)
+
+
+def test_rectangular_bijection_route():
+    # n_in != n_out: a source stream routed into a longer destination
+    # stream with pad destinations (dest_src < 0) carrying zeros — the
+    # xchg shape (row-major entries -> padded layout slots).
+    rng = np.random.default_rng(5)
+    n_in, n_out = CS - 500, CS - 100
+    dest_src = np.full(n_out, -1, np.int64)
+    real_dests = rng.choice(n_out, size=n_in, replace=False)
+    dest_src[real_dests] = rng.permutation(n_in)
+    ch, nc = pick_geometry(max(n_in, n_out))
+    total = nc * ch * LANES
+    perm = full_bijection(dest_src, n_in, total)
+    route = route_vperm_full(perm, n_in, n_out, ch)
+    x = rng.standard_normal(n_in).astype(np.float32)
+    got = np.asarray(apply_vperm(jax.numpy.asarray(x), route,
+                                 interpret=INTERP))
+    want = np.zeros(n_out, np.float32)
+    want[real_dests] = x[dest_src[real_dests]]
+    np.testing.assert_array_equal(got, want)
+    # The inverse carries the destination stream back onto the sources.
+    inv = invert_vperm(route)
+    back = np.asarray(apply_vperm(jax.numpy.asarray(got), inv,
+                                  interpret=INTERP))
+    np.testing.assert_array_equal(back, x)
+
+
+def test_xchg_segment_grad_matches_oracle():
+    from photon_tpu.ops.pallas_gather import (
+        build_aligned_layout,
+        device_layout,
+    )
+    from photon_tpu.ops.vperm import build_xchg_route, xchg_segment_grad
+
+    rng = np.random.default_rng(6)
+    n, k, dim = 4096, 8, 512
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    vals[rng.random((n, k)) < 0.1] = 0.0  # row-major pads
+    layout = build_aligned_layout(ids, vals, dim)
+    route = build_xchg_route(layout, n, k)
+    per_row = rng.standard_normal(n).astype(np.float32)
+
+    got = np.asarray(xchg_segment_grad(
+        jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+        device_layout(layout), route, dim, interpret=INTERP,
+    ))
+    want = np.zeros(dim, np.float64)
+    np.add.at(want, ids.reshape(-1),
+              (per_row[:, None] * vals).reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-5,
+                               atol=2e-4)

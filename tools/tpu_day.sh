@@ -3,15 +3,18 @@
 #
 # Produces, under tools/tpu_day_out/ (in RUN ORDER — unmeasured first,
 # so a mid-window tunnel drop costs only re-confirmations):
-#   00_probe.txt          backend probe (subprocess-guarded, bounded)
-#   05_probe_permute.txt  static-permutation primitive table (UNMEASURED —
-#                         decides the benes kernel design; runs FIRST)
-#   01_microbench2.txt    primitive table (never completed on TPU; second)
-#   02_headline_*.txt     bench headline per kernel (all banked on hardware
-#                         2026-07-30/31 — re-confirmation) + bf16 + zipf +
-#                         fused variants
-#   03_configs.txt        bench configs 1-5 (quality anchors)
-#   04_stream_scale.txt   streaming-ingestion proof
+#   00_probe.txt            backend probe (subprocess-guarded, bounded)
+#   08_probe_blocklocal.txt vperm primitive lowering/timing (FIRST —
+#                           validates the xchg kernel's Mosaic pieces)
+#   09_headline_xchg_*.txt  the UNMEASURED vperm-exchange headline, then
+#   09_headline_auto.txt    auto mode (correctness-gates xchg on-device)
+#   07_probe_tiles.txt      pallas grid-overhead sweep (never completed)
+#   05_probe_permute.txt    chained primitive table (re-confirmation)
+#   01_microbench2.txt      primitive table (never completed on TPU)
+#   02_headline_*.txt       per-kernel headline re-confirmations + bf16 +
+#                           zipf + fused variants
+#   03_configs.txt          bench configs 1-5 (quality anchors)
+#   04_stream_scale.txt     streaming-ingestion proof
 #
 # Every step is individually timeout-bounded so a mid-run tunnel drop
 # cannot hang the pack; partial output is still evidence.  Run from the
@@ -53,11 +56,23 @@ BASE="PHOTON_SPARSE_MARGIN= PHOTON_BENCH_DTYPE=float32 PHOTON_BENCH_SKEW=uniform
 # steps/s, refuted) and the chained probe_permute table.  Remaining
 # unmeasured items lead; everything below them is re-confirmation.
 
-echo "== probe_blocklocal (UNMEASURED — decides the block-local kernel) =="
+echo "== probe_blocklocal (vperm primitive lowering + timing) =="
 if [ -f tools/probe_blocklocal.py ]; then
     timeout 1200 python -u tools/probe_blocklocal.py \
         > "$OUT/08_probe_blocklocal.txt" 2>&1
 fi
+
+echo "== headline: xchg (UNMEASURED vperm-exchange kernel) =="
+for pass in cold warm; do
+    env $BASE PHOTON_SPARSE_GRAD=xchg \
+        timeout 900 python bench.py --headline-only \
+        > "$OUT/09_headline_xchg_${pass}.txt" 2>&1
+done
+# Auto mode with the xchg candidate: the selection probe correctness-
+# gates the Mosaic kernels on-device before timing, so this run also
+# validates xchg against the oracle at probe scale.
+env $BASE timeout 1200 python bench.py --headline-only \
+    > "$OUT/09_headline_auto.txt" 2>&1
 
 echo "== probe_tiles (pallas grid-overhead sweep — never completed) =="
 timeout 1200 python -u tools/probe_tiles.py > "$OUT/07_probe_tiles.txt" 2>&1
