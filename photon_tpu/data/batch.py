@@ -301,9 +301,15 @@ def attach_feature_major(
                 al_t=device_layout(build_row_aligned_layout(ids_np, vals_np))
             )
         if want_xchg:
-            from photon_tpu.ops.vperm import build_xchg_route
+            from photon_tpu.ops.vperm import build_xchg_aux
 
-            batch = batch._replace(xchg=build_xchg_route(layout, n, k))
+            # shards == 1 here, so order[0] is the flat-stream stable
+            # argsort the fm aux already paid for.
+            batch = batch._replace(
+                xchg=build_xchg_aux(
+                    layout, ids_np, aligned_dim, order=order[0]
+                )
+            )
         if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "benes":
             # Explicit opt-in only: the routing (host edge-coloring) is the
             # most expensive layout build in the package; auto mode never
@@ -358,11 +364,12 @@ def pad_batch(batch: Batch, target_n: int) -> Batch:
         widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
         return jnp.pad(a, widths)
 
-    # The feature-major / aligned auxes are row-count- and block-structure-
-    # dependent; padding per-leaf would corrupt them.  Strip them (padded
-    # rows carry only zero-value entries, so an aux rebuilt after padding is
+    # The feature-major / aligned / routing auxes are row-count- and
+    # block-structure-dependent; padding per-leaf would corrupt them (the
+    # vperm index planes most destructively).  Strip them (padded rows
+    # carry only zero-value entries, so an aux rebuilt after padding is
     # equivalent) and let the caller re-attach at the final row count.
-    for aux in ("fm", "al", "al_t"):
+    for aux in ("fm", "al", "al_t", "benes", "xchg"):
         if getattr(batch, aux, None) is not None:
             batch = batch._replace(**{aux: None})
     return jax.tree.map(_pad, batch)

@@ -167,11 +167,12 @@ def _measure(e: int, d: int, n: int, with_pallas: bool,
             # reference the pallas gate used.
             try:
                 from photon_tpu.ops.vperm import (
-                    build_xchg_route,
+                    build_xchg_aux,
                     xchg_segment_grad,
                 )
 
-                route = build_xchg_route(layout, n_probe, k)
+                ids2d = flat_ids[: n_probe * k].reshape(n_probe, k)
+                route = build_xchg_aux(layout, ids2d, d)
                 vals2d = jnp.asarray(
                     np.asarray(vals)[: n_probe * k].reshape(n_probe, k)
                 )

@@ -364,7 +364,33 @@ def apply_vperm_reference(x: np.ndarray, perm: np.ndarray) -> np.ndarray:
     return np.asarray(x)[np.asarray(perm)]
 
 
-# -- the xchg production route: row-major entries -> aligned slots ----------
+# -- the xchg production routes ---------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class XchgAux:
+    """Batch-attached exchange routing for the `xchg` kernel.
+
+    ``route`` permutes the row-major per-entry product stream into the
+    reduce-side order.  Two reduce strategies (PHOTON_XCHG_REDUCE):
+
+    - ``aligned`` — destination is the slab-aligned slot stream; the
+      reduce is ops/pallas_gather.aligned_reduce (``bounds`` is None).
+    - ``cumsum`` — destination is the COMPACT feature-sorted stream
+      (exactly n*k entries: zero NC padding when n*k is a chunk
+      multiple); the reduce is an f32 cumsum + one [d+1] boundary
+      gather (``g[f] = ps[bounds[f+1]] - ps[bounds[f]]``).  Cheaper
+      data movement, at f32 prefix-sum precision — the auto probe's
+      correctness gate arbitrates.
+    """
+
+    route: VpermRoute
+    bounds: object = None  # [dim+1] int32 device array for cumsum mode
+
+
+tree_util.register_dataclass(
+    XchgAux, data_fields=("route", "bounds"), meta_fields=()
+)
+
 
 def build_xchg_route(layout, n: int, k: int) -> VpermRoute:
     """Route the row-major entry stream into aligned-layout slot order.
@@ -385,20 +411,104 @@ def build_xchg_route(layout, n: int, k: int) -> VpermRoute:
     return route_vperm_full(perm, n_rm, n_slots, ch)
 
 
+def build_xchg_sorted_route(ids: np.ndarray, dim: int,
+                            order: np.ndarray | None = None) -> XchgAux:
+    """Route row-major entries into the COMPACT feature-sorted stream.
+
+    ``ids`` is the batch's [n, k] padded id array (pads carry id 0 and
+    val 0 — they land inside feature 0's segment and contribute zero,
+    exactly as in the fm segment-sum).  The destination has the same
+    length as the source, so the permutation is square and the only
+    padding is the chunk-multiple tail.  ``order`` is the stable argsort
+    of the flat id stream when the caller already computed it (the fm
+    aux build does — no second O(E log E) host sort).
+    """
+    flat = ids.reshape(-1).astype(np.int64)
+    if order is None:
+        order = np.argsort(flat, kind="stable")  # dest i <- rm order[i]
+    else:
+        order = np.ascontiguousarray(order, dtype=np.int64)
+    n_rm = flat.size
+    ch, nc = pick_geometry(n_rm)
+    total = nc * ch * LANES
+    perm = np.arange(total, dtype=np.int64)
+    perm[:n_rm] = order
+    if total > n_rm:
+        # Tail destinations must read tail (zero-pad) sources: order is
+        # already a bijection on [0, n_rm), identity on the tail.
+        perm[n_rm:] = np.arange(n_rm, total, dtype=np.int64)
+    route = route_vperm_full(perm, n_rm, n_rm, ch)
+    bounds = np.searchsorted(
+        flat[order], np.arange(dim + 1, dtype=np.int64)
+    ).astype(np.int32)
+    return XchgAux(route=route, bounds=jnp.asarray(bounds))
+
+
+def build_xchg_aux(layout, ids: np.ndarray, dim: int,
+                   order: np.ndarray | None = None) -> XchgAux:
+    """The attach/probe entry point: build the exchange aux for the
+    reduce strategy selected by PHOTON_XCHG_REDUCE (aligned | cumsum).
+    One builder so the auto-selection probe measures exactly the
+    variant production batches carry."""
+    import os
+
+    n, k = ids.shape
+    if os.environ.get("PHOTON_XCHG_REDUCE", "aligned") == "cumsum":
+        return build_xchg_sorted_route(np.asarray(ids), dim, order=order)
+    return XchgAux(route=build_xchg_route(layout, n, k))
+
+
 def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
-                      route: VpermRoute, dim: int,
+                      aux: "XchgAux | VpermRoute", dim: int,
                       interpret: bool | None = None) -> Array:
     """``g[f] = sum_e per_row[row_e] * val_e`` — the xchg backward.
 
     Row-major products (a free broadcast-multiply) ride the vperm into
-    slot order; the existing position-reduce finishes the job.
+    the reduce-side order; the reduce is either the aligned
+    position-reduce or the cumsum + boundary gather (see XchgAux).
     """
     from photon_tpu.ops.pallas_gather import aligned_reduce
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if isinstance(aux, VpermRoute):  # back-compat: bare aligned route
+        aux = XchgAux(route=aux)
     pv_rm = (per_row[:, None] * vals_rowmajor).astype(jnp.float32)
-    slots = apply_vperm(pv_rm.reshape(-1), route, interpret=bool(interpret))
-    return aligned_reduce(
-        slots.reshape(al.lo.shape), al, dim, interpret=interpret
+    moved = apply_vperm(pv_rm.reshape(-1), aux.route,
+                        interpret=bool(interpret))
+    if aux.bounds is None:
+        return aligned_reduce(
+            moved.reshape(al.lo.shape), al, dim, interpret=interpret
+        )
+    hi, lo = _compensated_cumsum(moved)
+    zero = jnp.zeros(1, jnp.float32)
+    hi = jnp.concatenate([zero, hi])
+    lo = jnp.concatenate([zero, lo])
+    bh = jnp.take(hi, aux.bounds, axis=0)
+    bl = jnp.take(lo, aux.bounds, axis=0)
+    # Difference the compensated pair BEFORE collapsing: at production
+    # scale (E ~ 2^25) a plain f32 prefix sum reaches magnitudes where
+    # its ulp exceeds small per-feature gradients, so g[f] would be
+    # rounding noise.  The (hi, lo) double-f32 carries ~48 effective
+    # mantissa bits through the scan at stream cost.
+    return (bh[1:] - bh[:-1]) + (bl[1:] - bl[:-1])
+
+
+def _compensated_cumsum(x: Array) -> tuple[Array, Array]:
+    """Inclusive prefix sum of f32 ``x`` as a (hi, lo) double-f32 pair
+    via an associative two-sum combine (Dekker/Knuth), so the error of
+    the running sum stays bounded by the ~48-bit pair precision instead
+    of growing with the prefix magnitude."""
+
+    def combine(a, b):
+        a_hi, a_lo = a
+        b_hi, b_lo = b
+        s = a_hi + b_hi
+        z = s - a_hi
+        err = (a_hi - (s - z)) + (b_hi - z)
+        return s, err + a_lo + b_lo
+
+    hi, lo = jax.lax.associative_scan(
+        combine, (x, jnp.zeros_like(x))
     )
+    return hi, lo

@@ -117,6 +117,34 @@ def test_rectangular_bijection_route():
     np.testing.assert_array_equal(back, x)
 
 
+def test_cumsum_reduce_precision_under_cancellation(monkeypatch):
+    """The compensated prefix sum must recover small per-feature sums
+    buried under a large-magnitude running prefix — the failure mode of
+    a plain f32 cumsum at production E (review finding, round 4)."""
+    from photon_tpu.ops.pallas_gather import build_aligned_layout
+    from photon_tpu.ops.vperm import build_xchg_sorted_route, xchg_segment_grad
+
+    rng = np.random.default_rng(7)
+    n, k, dim = 2048, 128, 1024
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    # Alternating +/-1000 pairs per row cancel within each feature's
+    # segment up to a tiny signal, while the running prefix sweeps
+    # through magnitudes where the f32 ulp is ~0.03-16.
+    base = np.tile([1000.0, -1000.0], k // 2)
+    vals = (base[None, :] + rng.standard_normal((n, k)) * 1e-3).astype(
+        np.float32
+    )
+    aux = build_xchg_sorted_route(ids, dim)
+    per_row = np.ones(n, np.float32)
+    got = np.asarray(xchg_segment_grad(
+        jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+        None, aux, dim, interpret=INTERP,
+    ))
+    want = np.zeros(dim, np.float64)
+    np.add.at(want, ids.reshape(-1), vals.reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=1e-3)
+
+
 def test_xchg_segment_grad_matches_oracle():
     from photon_tpu.ops.pallas_gather import (
         build_aligned_layout,

@@ -36,13 +36,16 @@ def _random_batch(n, k, d, seed=0, zipf=False):
 
 @pytest.mark.parametrize("loss", ["logistic", "squared"])
 @pytest.mark.parametrize("zipf", [False, True])
-def test_xchg_kernel_matches_autodiff(monkeypatch, loss, zipf):
+@pytest.mark.parametrize("reduce", ["aligned", "cumsum"])
+def test_xchg_kernel_matches_autodiff(monkeypatch, loss, zipf, reduce):
     monkeypatch.setenv("PHOTON_SPARSE_GRAD", "xchg")
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", reduce)
     n, k, d = 256, 6, 48
     batch = _random_batch(n, k, d, seed=80, zipf=zipf)
     fast = attach_feature_major(batch, aligned_dim=d)
     assert fast.al is not None and fast.xchg is not None
     assert fast.al_t is not None  # xchg implies the pallas forward
+    assert (fast.xchg.bounds is not None) == (reduce == "cumsum")
     obj = GlmObjective.create(loss, RegularizationContext("l2", 0.6))
     rng = np.random.default_rng(81)
     w = jnp.asarray(rng.standard_normal(d), jnp.float32) * 0.1

@@ -64,10 +64,15 @@ fi
 
 echo "== headline: xchg (UNMEASURED vperm-exchange kernel) =="
 for pass in cold warm; do
-    env $BASE PHOTON_SPARSE_GRAD=xchg \
+    env $BASE PHOTON_SPARSE_GRAD=xchg PHOTON_XCHG_REDUCE=aligned \
         timeout 900 python bench.py --headline-only \
         > "$OUT/09_headline_xchg_${pass}.txt" 2>&1
 done
+# The cumsum-reduce variant: compact sorted destination (no NC padding
+# at this shape) + prefix-sum reduce instead of the aligned reduce.
+env $BASE PHOTON_SPARSE_GRAD=xchg PHOTON_XCHG_REDUCE=cumsum \
+    timeout 900 python bench.py --headline-only \
+    > "$OUT/09_headline_xchg_cumsum.txt" 2>&1
 # Auto mode with the xchg candidate: the selection probe correctness-
 # gates the Mosaic kernels on-device before timing, so this run also
 # validates xchg against the oracle at probe scale.
