@@ -48,6 +48,12 @@ os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
 # logic itself is tested explicitly with env overrides.
 os.environ.setdefault("PHOTON_SPARSE_GRAD", "fm")
 
+# The vperm route disk cache must NOT serve tests: a stale cached route
+# would mask builder regressions (tests would validate deserialization,
+# not construction).  The cache itself is covered by a dedicated test
+# with an explicit tmp-dir override.
+os.environ.setdefault("PHOTON_ROUTE_CACHE", "0")
+
 # Hermetic fixtures: an operator's ambient PHOTON_REAL_DATA_DIR would
 # silently redirect the a1a/MovieLens anchor tests to real data, whose
 # metrics fall outside the fixture-calibrated bands.  Tests that cover the

@@ -145,6 +145,94 @@ def test_cumsum_reduce_precision_under_cancellation(monkeypatch):
     np.testing.assert_allclose(got, want, atol=2e-2, rtol=1e-3)
 
 
+@pytest.mark.parametrize("zipf", [False, True])
+def test_balanced_route_multi_chunk_matches_oracle(zipf):
+    """The coloring-free balanced exchange at NC > 1 (two chunk passes
+    around one block transpose) must reproduce the oracle gradient."""
+    from photon_tpu.ops.vperm import (
+        BalancedRoute,
+        XchgAux,
+        build_balanced_sorted_route,
+        xchg_segment_grad,
+    )
+
+    rng = np.random.default_rng(8)
+    n, k, dim = 2048 * 3, 128, 4096  # e = 3*CS -> nc = 3
+    if zipf:
+        ranks = rng.zipf(1.2, size=(n, k)).astype(np.int64)
+        ids = np.minimum(ranks - 1, dim - 1).astype(np.int32)
+    else:
+        ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    vals[rng.random((n, k)) < 0.1] = 0.0
+    built = build_balanced_sorted_route(ids, dim)
+    assert built is not None
+    route, bounds = built
+    assert isinstance(route, BalancedRoute) and route.nc > 1
+    per_row = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(xchg_segment_grad(
+        jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+        None, XchgAux(route=route, bounds=bounds), dim, interpret=INTERP,
+    ))
+    want = np.zeros(dim, np.float64)
+    np.add.at(want, ids.reshape(-1),
+              (per_row[:, None] * vals).reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-4,
+                               atol=5e-3)
+
+
+def test_route_cache_round_trip(monkeypatch, tmp_path):
+    """Cached routes must deserialize to the same gradient as freshly
+    built ones, and a vals-zero-pattern change must MISS in aligned
+    mode (the layout drops val==0 entries, so the route differs)."""
+    from photon_tpu.ops.pallas_gather import (
+        build_aligned_layout,
+        device_layout,
+    )
+    from photon_tpu.ops.vperm import build_xchg_aux, xchg_segment_grad
+
+    rng = np.random.default_rng(9)
+    n, k, dim = 1024, 8, 256
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    per_row = rng.standard_normal(n).astype(np.float32)
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", str(tmp_path))
+
+    for mode in ("aligned", "cumsum"):
+        monkeypatch.setenv("PHOTON_XCHG_REDUCE", mode)
+        layout = build_aligned_layout(ids, vals, dim)
+        al = device_layout(layout)
+        fresh = build_xchg_aux(layout, ids, dim)
+        cached = build_xchg_aux(layout, ids, dim)
+        g1 = np.asarray(xchg_segment_grad(
+            jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+            al, fresh, dim, interpret=INTERP,
+        ))
+        g2 = np.asarray(xchg_segment_grad(
+            jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+            al, cached, dim, interpret=INTERP,
+        ))
+        np.testing.assert_array_equal(g1, g2)
+
+    # Aligned-mode key must include the layout: zeroing some vals drops
+    # entries and must rebuild, not hit the stale route.
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "aligned")
+    vals2 = vals.copy()
+    vals2[rng.random((n, k)) < 0.3] = 0.0
+    layout2 = build_aligned_layout(ids, vals2, dim)
+    al2 = device_layout(layout2)
+    aux2 = build_xchg_aux(layout2, ids, dim)
+    g = np.asarray(xchg_segment_grad(
+        jax.numpy.asarray(per_row), jax.numpy.asarray(vals2),
+        al2, aux2, dim, interpret=INTERP,
+    ))
+    want = np.zeros(dim, np.float64)
+    np.add.at(want, ids.reshape(-1),
+              (per_row[:, None] * vals2).reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(g, want.astype(np.float32), rtol=2e-4,
+                               atol=2e-4)
+
+
 def test_xchg_segment_grad_matches_oracle():
     from photon_tpu.ops.pallas_gather import (
         build_aligned_layout,

@@ -132,5 +132,5 @@ echo "== stream-scale =="
 timeout 3600 python bench.py --stream-scale > "$OUT/04_stream_scale.txt" 2>&1
 
 echo "pack complete: $OUT/"
-grep -h '"metric"' "$OUT"/02_headline_*.txt "$OUT/03_configs.txt" \
-    "$OUT/04_stream_scale.txt" 2>/dev/null | tail -20
+grep -h '"metric"' "$OUT"/09_headline_*.txt "$OUT"/02_headline_*.txt \
+    "$OUT/03_configs.txt" "$OUT/04_stream_scale.txt" 2>/dev/null | tail -24
