@@ -760,15 +760,26 @@ def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    import os
+
     if isinstance(aux, VpermRoute):  # back-compat: bare aligned route
         aux = XchgAux(route=aux)
     pv_rm = (per_row[:, None] * vals_rowmajor).astype(jnp.float32)
+    # Optional half-width payload through the exchange: the permutation
+    # passes are pure data movement, so bf16 halves their HBM traffic;
+    # products quantize at ~2^-9 relative and the reduce runs f32 (the
+    # compensated scan below, or the aligned position-reduce's f32
+    # accumulate), so per-feature sums keep ~0.1% worst-case error.
+    # Measured-choice knob like every kernel decision here.
+    if os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16":
+        pv_rm = pv_rm.astype(jnp.bfloat16)
     if isinstance(aux.route, BalancedRoute):
         moved = apply_balanced(pv_rm.reshape(-1), aux.route,
                                interpret=bool(interpret))
     else:
         moved = apply_vperm(pv_rm.reshape(-1), aux.route,
                             interpret=bool(interpret))
+    moved = moved.astype(jnp.float32)
     if aux.bounds is None:
         return aligned_reduce(
             moved.reshape(al.lo.shape), al, dim, interpret=interpret

@@ -181,6 +181,28 @@ def test_balanced_route_multi_chunk_matches_oracle(zipf):
                                atol=5e-3)
 
 
+def test_xchg_bf16_payload_close_to_f32(monkeypatch):
+    """PHOTON_XCHG_DTYPE=bfloat16 rides the exchange at half width; the
+    reduce stays f32, so gradients track the f32 path to bf16 product
+    precision."""
+    from photon_tpu.ops.vperm import build_xchg_sorted_route, xchg_segment_grad
+
+    rng = np.random.default_rng(10)
+    n, k, dim = 2048, 16, 512
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    aux = build_xchg_sorted_route(ids, dim)
+    per_row = rng.standard_normal(n).astype(np.float32)
+    args = (jax.numpy.asarray(per_row), jax.numpy.asarray(vals), None,
+            aux, dim)
+    g32 = np.asarray(xchg_segment_grad(*args, interpret=INTERP))
+    monkeypatch.setenv("PHOTON_XCHG_DTYPE", "bfloat16")
+    g16 = np.asarray(xchg_segment_grad(*args, interpret=INTERP))
+    scale = np.abs(g32).max()
+    np.testing.assert_allclose(g16, g32, atol=2e-2 * scale)
+    assert not np.array_equal(g16, g32)  # the knob actually engaged
+
+
 def test_route_cache_round_trip(monkeypatch, tmp_path):
     """Cached routes must deserialize to the same gradient as freshly
     built ones, and a vals-zero-pattern change must MISS in aligned

@@ -73,6 +73,11 @@ done
 env $BASE PHOTON_SPARSE_GRAD=xchg PHOTON_XCHG_REDUCE=cumsum \
     timeout 900 python bench.py --headline-only \
     > "$OUT/09_headline_xchg_cumsum.txt" 2>&1
+# Half-width exchange payload on the better reduce variant.
+env $BASE PHOTON_SPARSE_GRAD=xchg PHOTON_XCHG_REDUCE=cumsum \
+    PHOTON_XCHG_DTYPE=bfloat16 \
+    timeout 900 python bench.py --headline-only \
+    > "$OUT/09_headline_xchg_cumsum_bf16.txt" 2>&1
 # Auto mode with the xchg candidate: the selection probe correctness-
 # gates the Mosaic kernels on-device before timing, so this run also
 # validates xchg against the oracle at probe scale.
