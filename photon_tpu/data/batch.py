@@ -307,7 +307,8 @@ def attach_feature_major(
             # argsort the fm aux already paid for.
             batch = batch._replace(
                 xchg=build_xchg_aux(
-                    layout, ids_np, aligned_dim, order=order[0]
+                    layout, ids_np, aligned_dim, order=order[0],
+                    vals=vals_np,
                 )
             )
         if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "benes":

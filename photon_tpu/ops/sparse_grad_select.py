@@ -172,10 +172,11 @@ def _measure(e: int, d: int, n: int, with_pallas: bool,
                 )
 
                 ids2d = flat_ids[: n_probe * k].reshape(n_probe, k)
-                route = build_xchg_aux(layout, ids2d, d)
-                vals2d = jnp.asarray(
-                    np.asarray(vals)[: n_probe * k].reshape(n_probe, k)
+                vals2d_np = np.asarray(vals)[: n_probe * k].reshape(
+                    n_probe, k
                 )
+                route = build_xchg_aux(layout, ids2d, d, vals=vals2d_np)
+                vals2d = jnp.asarray(vals2d_np)
                 g_dev = np.asarray(xchg_segment_grad(
                     dz_probe, vals2d, al, route, d, interpret=False
                 ))

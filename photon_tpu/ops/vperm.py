@@ -381,14 +381,21 @@ class XchgAux:
       gather (``g[f] = ps[bounds[f+1]] - ps[bounds[f]]``).  Cheaper
       data movement, at f32 prefix-sum precision — the auto probe's
       correctness gate arbitrates.
+
+    ``vals_dest`` (cumsum mode, when the attach provides vals): the
+    STATIC value stream pre-permuted to the destination order, so each
+    step moves only the dz expansion and the value multiply happens at
+    the destination, fused into the prefix scan — one fewer E-stream
+    read per evaluation.
     """
 
     route: VpermRoute
     bounds: object = None  # [dim+1] int32 device array for cumsum mode
+    vals_dest: object = None  # [total] f32, pre-permuted static values
 
 
 tree_util.register_dataclass(
-    XchgAux, data_fields=("route", "bounds"), meta_fields=()
+    XchgAux, data_fields=("route", "bounds", "vals_dest"), meta_fields=()
 )
 
 
@@ -701,49 +708,71 @@ def _aux_from_npz(z) -> XchgAux:
 
 
 def build_xchg_aux(layout, ids: np.ndarray, dim: int,
-                   order: np.ndarray | None = None) -> XchgAux:
+                   order: np.ndarray | None = None,
+                   vals: np.ndarray | None = None) -> XchgAux:
     """The attach/probe entry point: build the exchange aux for the
     reduce strategy selected by PHOTON_XCHG_REDUCE (aligned | cumsum).
     One builder so the auto-selection probe measures exactly the
-    variant production batches carry; results disk-cache by content
-    hash (PHOTON_ROUTE_CACHE dir, "0" disables)."""
+    variant production batches carry; routes disk-cache by content
+    hash (PHOTON_ROUTE_CACHE dir, "0" disables).  With ``vals``, the
+    cumsum aux also carries the statically pre-permuted value stream
+    (``vals_dest`` — one device pass at attach, never cached: the
+    route itself is vals-independent)."""
     import logging
     import os
+
+    import jax as _jax
 
     n, k = ids.shape
     mode = os.environ.get("PHOTON_XCHG_REDUCE", "aligned")
     path = _route_cache_path(np.asarray(ids), dim, mode, layout)
+    aux = None
     if path is not None and os.path.exists(path):
         try:
             with np.load(path) as z:
-                return _aux_from_npz(z)
+                aux = _aux_from_npz(z)
         except Exception as exc:  # noqa: BLE001 — corrupt cache = rebuild
             logging.getLogger("photon_tpu.vperm").warning(
                 "route cache read failed (%s); rebuilding", exc
             )
-    if mode == "cumsum":
-        # The coloring-free balanced exchange when the data permits it
-        # (any stream whose sorted order mixes source positions);
-        # otherwise the general colored route.
-        built = build_balanced_sorted_route(np.asarray(ids), dim, order)
-        if built is not None:
-            route, bounds = built
-            aux = XchgAux(route=route, bounds=bounds)
+    if aux is None:
+        if mode == "cumsum":
+            # The coloring-free balanced exchange when the data permits
+            # it (any stream whose sorted order mixes source positions);
+            # otherwise the general colored route.
+            built = build_balanced_sorted_route(np.asarray(ids), dim, order)
+            if built is not None:
+                route, bounds = built
+                aux = XchgAux(route=route, bounds=bounds)
+            else:
+                aux = build_xchg_sorted_route(
+                    np.asarray(ids), dim, order=order
+                )
         else:
-            aux = build_xchg_sorted_route(np.asarray(ids), dim, order=order)
-    else:
-        aux = XchgAux(route=build_xchg_route(layout, n, k))
-    if path is not None:
-        try:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            tmp = path + f".tmp{os.getpid()}"
-            with open(tmp, "wb") as f:
-                np.savez(f, **_aux_to_npz(aux))
-            os.replace(tmp, path)
-        except Exception as exc:  # noqa: BLE001 — cache write best-effort
-            logging.getLogger("photon_tpu.vperm").warning(
-                "route cache write failed (%s)", exc
-            )
+            aux = XchgAux(route=build_xchg_route(layout, n, k))
+        if path is not None:
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                tmp = path + f".tmp{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    np.savez(f, **_aux_to_npz(aux))
+                os.replace(tmp, path)
+            except Exception as exc:  # noqa: BLE001 — best-effort
+                logging.getLogger("photon_tpu.vperm").warning(
+                    "route cache write failed (%s)", exc
+                )
+    if aux.bounds is not None and vals is not None:
+        interp = _jax.default_backend() != "tpu"
+        flat = jnp.asarray(
+            np.asarray(vals, np.float32).reshape(-1)
+        )
+        if isinstance(aux.route, BalancedRoute):
+            vd = apply_balanced(flat, aux.route, interpret=interp)
+        else:
+            vd = apply_vperm(flat, aux.route, interpret=interp)
+        if os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16":
+            vd = vd.astype(jnp.bfloat16)
+        aux = dataclasses.replace(aux, vals_dest=vd)
     return aux
 
 
@@ -764,22 +793,34 @@ def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
 
     if isinstance(aux, VpermRoute):  # back-compat: bare aligned route
         aux = XchgAux(route=aux)
-    pv_rm = (per_row[:, None] * vals_rowmajor).astype(jnp.float32)
+    bf16 = os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16"
+    if aux.vals_dest is not None:
+        # The static value stream is pre-permuted (attach time), so each
+        # step moves only the dz expansion; the value multiply happens
+        # at the destination, fused into the reduce read.
+        k = vals_rowmajor.shape[1]
+        stream = jnp.repeat(per_row.astype(jnp.float32), k)
+    else:
+        stream = (per_row[:, None] * vals_rowmajor).astype(
+            jnp.float32
+        ).reshape(-1)
     # Optional half-width payload through the exchange: the permutation
     # passes are pure data movement, so bf16 halves their HBM traffic;
     # products quantize at ~2^-9 relative and the reduce runs f32 (the
     # compensated scan below, or the aligned position-reduce's f32
     # accumulate), so per-feature sums keep ~0.1% worst-case error.
     # Measured-choice knob like every kernel decision here.
-    if os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16":
-        pv_rm = pv_rm.astype(jnp.bfloat16)
+    if bf16:
+        stream = stream.astype(jnp.bfloat16)
     if isinstance(aux.route, BalancedRoute):
-        moved = apply_balanced(pv_rm.reshape(-1), aux.route,
+        moved = apply_balanced(stream, aux.route,
                                interpret=bool(interpret))
     else:
-        moved = apply_vperm(pv_rm.reshape(-1), aux.route,
-                            interpret=bool(interpret))
-    moved = moved.astype(jnp.float32)
+        moved = apply_vperm(stream, aux.route, interpret=bool(interpret))
+    if aux.vals_dest is not None:
+        moved = (moved * aux.vals_dest).astype(jnp.float32)
+    else:
+        moved = moved.astype(jnp.float32)
     if aux.bounds is None:
         return aligned_reduce(
             moved.reshape(al.lo.shape), al, dim, interpret=interpret
