@@ -721,8 +721,6 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
     import logging
     import os
 
-    import jax as _jax
-
     n, k = ids.shape
     mode = os.environ.get("PHOTON_XCHG_REDUCE", "aligned")
     path = _route_cache_path(np.asarray(ids), dim, mode, layout)
@@ -762,7 +760,7 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
                     "route cache write failed (%s)", exc
                 )
     if aux.bounds is not None and vals is not None:
-        interp = _jax.default_backend() != "tpu"
+        interp = jax.default_backend() != "tpu"
         flat = jnp.asarray(
             np.asarray(vals, np.float32).reshape(-1)
         )
@@ -784,6 +782,11 @@ def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
     Row-major products (a free broadcast-multiply) ride the vperm into
     the reduce-side order; the reduce is either the aligned
     position-reduce or the cumsum + boundary gather (see XchgAux).
+
+    Contract: when ``aux.vals_dest`` is set, the values were baked into
+    the aux at attach time and ``vals_rowmajor`` contributes only its
+    shape — it must be the SAME value array the attach saw (true for
+    every production caller: both read the batch's static vals).
     """
     from photon_tpu.ops.pallas_gather import aligned_reduce
 
@@ -818,7 +821,13 @@ def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
     else:
         moved = apply_vperm(stream, aux.route, interpret=bool(interpret))
     if aux.vals_dest is not None:
-        moved = (moved * aux.vals_dest).astype(jnp.float32)
+        # Upcast BOTH operands before multiplying: the exchange is done,
+        # so there is no traffic reason to multiply in bf16, and a bf16
+        # product of two already-quantized operands would round a third
+        # time.
+        moved = moved.astype(jnp.float32) * aux.vals_dest.astype(
+            jnp.float32
+        )
     else:
         moved = moved.astype(jnp.float32)
     if aux.bounds is None:
