@@ -29,10 +29,16 @@ win and is marginal.
 """
 
 import argparse
+import os
+import sys
 
 import numpy as np
 
 from probe_common import CHAIN, LANES, timed as _time  # noqa: F401
+
+# Repo root on the path: probe_scans times the PRODUCTION compensated
+# scan from photon_tpu.ops.vperm, not a copy.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -272,6 +278,83 @@ def probe_sublane_gather(E):
         return None
 
 
+def probe_scans(E):
+    # The cumsum-reduce's per-step scan: plain f32 cumsum vs the
+    # compensated (hi, lo) two-sum associative scan ops/vperm.py uses.
+    rng = np.random.default_rng(5)
+    x0 = jnp.asarray(rng.standard_normal(E).astype(np.float32))
+
+    @jax.jit
+    def plain(x):
+        y = x
+        s = jnp.float32(0)
+        for _ in range(CHAIN):
+            ps = jnp.cumsum(y)
+            s = s + ps[-1]
+            y = jax.lax.optimization_barrier(y + s * 1e-30)
+        return s
+
+    t = _time(plain, x0) / CHAIN
+    print(f"f. plain f32 cumsum      E={E:>10,}  {t*1e3:8.2f} ms  "
+          f"{E/t/1e6:9.1f} Melem/s")
+
+    from photon_tpu.ops.vperm import _compensated_cumsum
+
+    @jax.jit
+    def comp(x):
+        y = x
+        s = jnp.float32(0)
+        for _ in range(CHAIN):
+            hi, lo = _compensated_cumsum(y)
+            s = s + hi[-1] + lo[-1]
+            y = jax.lax.optimization_barrier(y + s * 1e-30)
+        return s
+
+    t = _time(comp, x0) / CHAIN
+    print(f"g. compensated cumsum    E={E:>10,}  {t*1e3:8.2f} ms  "
+          f"{E/t/1e6:9.1f} Melem/s")
+
+
+def probe_inkernel_repeat(E):
+    # Stage-A fusion candidate: expand dz inside the chunk kernel via
+    # jnp.repeat along lanes ([CH, 128/k] -> [CH, 128], k=32).
+    k = 32
+    rng = np.random.default_rng(6)
+    rows = E // LANES
+    n_tiles = rows // CH
+    x = jnp.asarray(rng.random((rows, LANES // k)).astype(np.float32))
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = jnp.repeat(x_ref[...], k, axis=1)
+
+    try:
+        f = _pallas(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+            grid=(n_tiles,),
+            in_specs=[pl.BlockSpec((CH, LANES // k), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((CH, LANES), lambda i: (i, 0)),
+        )
+
+        @jax.jit
+        def g(x):
+            s = jnp.float32(0)
+            y = x
+            for _ in range(CHAIN):
+                s = s + f(y).sum()
+                y = jax.lax.optimization_barrier(y + s * 1e-30)
+            return s
+
+        t = _time(g, x) / CHAIN
+        print(f"h. in-kernel lane repeat E={E:>10,}  {t*1e3:8.2f} ms  "
+              f"{E/t/1e6:9.1f} Melem/s (out elems)")
+        return t
+    except Exception as e:  # noqa: BLE001
+        print(f"h. in-kernel lane repeat UNSUPPORTED: {type(e).__name__}: "
+              f"{str(e)[:110]}")
+        return None
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--entries", type=int, default=1 << 25)
@@ -285,10 +368,12 @@ def main():
     print(f"backend={jax.default_backend()} devices={jax.devices()} E={E:,}")
     for probe in (
         probe_fused_chunk,       # the decision-maker runs first
+        probe_scans,             # the cumsum-reduce's dominant unknown
         probe_middle_sandwich,
         probe_tall_lane_gather,
         probe_vmem_transpose,
         probe_sublane_gather,
+        probe_inkernel_repeat,
     ):
         try:
             probe(E)
