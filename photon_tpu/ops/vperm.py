@@ -477,6 +477,7 @@ class BalancedRoute:
     ch: int
     blk: int
     cs_win: int
+    k_expand: int  # k when the in-kernel dz expansion applies, else 0
     a1: jnp.ndarray
     a2: jnp.ndarray
     a3: jnp.ndarray
@@ -496,7 +497,7 @@ class BalancedRoute:
 tree_util.register_dataclass(
     BalancedRoute,
     data_fields=("a1", "a2", "a3", "b1", "b2", "b3"),
-    meta_fields=("n_in", "nc", "ch", "blk", "cs_win"),
+    meta_fields=("n_in", "nc", "ch", "blk", "cs_win", "k_expand"),
 )
 
 
@@ -524,6 +525,7 @@ def build_balanced_sorted_route(
     None when the data defeats the balance assumption (caller falls back
     to the colored route)."""
     flat = ids.reshape(-1).astype(np.int64)
+    k = int(ids.shape[-1]) if ids.ndim == 2 else 0
     e = flat.size
     if order is None:
         order = np.argsort(flat, kind="stable")
@@ -541,8 +543,16 @@ def build_balanced_sorted_route(
     # Source windows are cs_win RAW rm entries; each physical chunk is
     # one window front-packed plus a pad tail (apply_balanced inserts
     # the tails with one fused XLA pad), so the window partition does
-    # not depend on the block-derived chunk size.
-    cs_win = cs_real
+    # not depend on the block-derived chunk size.  When k divides 128,
+    # round the window to whole rows so chunk boundaries never split a
+    # row — then the in-kernel dz expansion (apply_balanced_dz) can
+    # rebuild the row-major stream from a [ch, 128/k] dz tile and the
+    # per-step E-stream materialization disappears.
+    k_expand = k if (k and LANES % k == 0) else 0
+    if k_expand:
+        cs_win = k * (-(-cs_real // k))
+    else:
+        cs_win = cs_real
     src_win = np.minimum(src_of_rank // cs_win, nc - 1)
     counts = np.bincount(
         src_win * nc + dest_win, minlength=nc * nc
@@ -589,6 +599,7 @@ def build_balanced_sorted_route(
 
     route = BalancedRoute(
         n_in=e, nc=nc, ch=ch, blk=blk_slots, cs_win=cs_win,
+        k_expand=k_expand,
         a1=jnp.asarray(a1), a2=jnp.asarray(a2), a3=jnp.asarray(a3),
         b1=jnp.asarray(b1), b2=jnp.asarray(b2p), b3=jnp.asarray(b3),
     )
@@ -598,6 +609,109 @@ def build_balanced_sorted_route(
     bw = np.minimum(bounds_rank // cs_real, nc - 1)
     bounds = (bw * cs_pad + (bounds_rank - bw * cs_real)).astype(np.int64)
     return route, jnp.asarray(bounds.astype(np.int32))
+
+
+def _chunk_expand_kernel(dz_ref, i1_ref, i2_ref, i3_ref, o_ref):
+    """Stage A with the dz expansion fused: the [ch, 128/k] dz tile
+    broadcasts to the row-major [ch, 128] stream in VMEM (static lane
+    repeat), then the 5-stage micro-Clos runs as usual.  Pad-tail
+    positions carry whatever dz value the repeat lands there — they
+    flow into pad destinations whose vals_dest is zero."""
+    k = LANES // dz_ref.shape[1]
+    y = jnp.repeat(dz_ref[...], k, axis=1)
+    y = jnp.take_along_axis(y, i1_ref[...].astype(jnp.int32), axis=1)
+    y = y.T
+    y = jnp.take_along_axis(y, i2_ref[...].astype(jnp.int32), axis=1)
+    y = y.T
+    o_ref[...] = jnp.take_along_axis(
+        y, i3_ref[...].astype(jnp.int32), axis=1
+    )
+
+
+_EXPAND_SUPPORTED: dict = {}
+
+
+def expand_kernel_supported() -> bool:
+    """Eager Mosaic capability probe for the fused dz-expansion kernel
+    (jnp.repeat along lanes), cached per backend — a lowering failure
+    would otherwise surface only when the optimizer's enclosing jit
+    compiles."""
+    backend = jax.default_backend()
+    if backend not in _EXPAND_SUPPORTED:
+        if backend != "tpu":
+            _EXPAND_SUPPORTED[backend] = True  # interpret mode
+        else:
+            from jax.experimental import pallas as pl
+
+            try:
+                f = pl.pallas_call(
+                    _chunk_expand_kernel,
+                    out_shape=jax.ShapeDtypeStruct((8, LANES), jnp.float32),
+                    grid=(1,),
+                    in_specs=[
+                        pl.BlockSpec((8, 4), lambda i: (i, 0)),
+                        pl.BlockSpec((8, LANES), lambda i: (i, 0)),
+                        pl.BlockSpec((LANES, 8), lambda i: (i, 0)),
+                        pl.BlockSpec((8, LANES), lambda i: (i, 0)),
+                    ],
+                    out_specs=pl.BlockSpec((8, LANES), lambda i: (i, 0)),
+                )
+                jax.block_until_ready(f(
+                    jnp.ones((8, 4), jnp.float32),
+                    jnp.zeros((8, LANES), jnp.int8),
+                    jnp.zeros((LANES, 8), jnp.int16),
+                    jnp.zeros((8, LANES), jnp.int8),
+                ))
+                _EXPAND_SUPPORTED[backend] = True
+            except Exception:  # noqa: BLE001 — fall back to legacy path
+                _EXPAND_SUPPORTED[backend] = False
+    return _EXPAND_SUPPORTED[backend]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def apply_balanced_dz(dz: Array, route: BalancedRoute,
+                      interpret: bool = False) -> Array:
+    """The per-step exchange with the dz expansion fused into stage A:
+    moves a [n] dz vector (4 MB at the bench shape) instead of a
+    materialized E-stream.  Requires ``route.k_expand`` (k | 128 and
+    row-aligned windows)."""
+    from jax.experimental import pallas as pl
+
+    nc, ch, blk, total = route.nc, route.ch, route.blk, route.total
+    cs, cs_win, k = route.cs, route.cs_win, route.k_expand
+    if not k:
+        raise ValueError("route was built without k_expand")
+    rows_win = cs_win // k
+    if dz.shape[0] * k != route.n_in:
+        raise ValueError(f"dz length {dz.shape[0]} != n_in/{k}")
+    if nc * rows_win > dz.shape[0]:
+        dz = jnp.concatenate(
+            [dz, jnp.zeros(nc * rows_win - dz.shape[0], dz.dtype)]
+        )
+    dz2d = jnp.pad(
+        dz.reshape(nc, rows_win), ((0, 0), (0, cs // k - rows_win))
+    ).reshape(nc * ch, LANES // k)
+    g = pl.pallas_call(
+        _chunk_expand_kernel,
+        out_shape=jax.ShapeDtypeStruct((nc * ch, LANES), dz.dtype),
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((ch, LANES // k), lambda i: (i, 0)),
+            pl.BlockSpec((ch, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((LANES, ch), lambda i: (i, 0)),
+            pl.BlockSpec((ch, LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((ch, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(dz2d, route.a1, route.a2, route.a3, )
+    if nc > 1:
+        g = (
+            g.reshape(nc, nc, blk)
+            .transpose(1, 0, 2)
+            .reshape(nc * ch, LANES)
+        )
+        g = _chunk_pass(g, route.b1, route.b2, route.b3, nc, ch, interpret)
+    return g.reshape(total)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -630,7 +744,9 @@ def apply_balanced(x: Array, route: BalancedRoute,
     return g.reshape(total)
 
 
-_ROUTE_CACHE_VERSION = 1
+# Versioned PER MODE so bumping one builder doesn't invalidate the other
+# mode's (expensive) cached routes.
+_ROUTE_CACHE_VERSION = {"aligned": 1, "cumsum": 2}
 
 
 def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout):
@@ -655,7 +771,8 @@ def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout):
     h.update(np.ascontiguousarray(ids).tobytes())
     if mode != "cumsum" and layout is not None:
         h.update(np.ascontiguousarray(layout.src).tobytes())
-    h.update(f"|{dim}|{mode}|v{_ROUTE_CACHE_VERSION}".encode())
+    ver = _ROUTE_CACHE_VERSION.get(mode, _ROUTE_CACHE_VERSION["aligned"])
+    h.update(f"|{dim}|{mode}|v{ver}".encode())
     return os.path.join(root, h.hexdigest()[:32] + ".npz")
 
 
@@ -665,7 +782,7 @@ def _aux_to_npz(aux: XchgAux) -> dict:
     if isinstance(r, BalancedRoute):
         out["kind"] = np.int64(2)
         out["meta"] = np.asarray(
-            [r.n_in, r.nc, r.ch, r.blk, r.cs_win], np.int64
+            [r.n_in, r.nc, r.ch, r.blk, r.cs_win, r.k_expand], np.int64
         )
         for name in ("a1", "a2", "a3", "b1", "b2", "b3"):
             out[name] = np.asarray(getattr(r, name))
@@ -686,9 +803,10 @@ def _aux_to_npz(aux: XchgAux) -> dict:
 def _aux_from_npz(z) -> XchgAux:
     bounds = jnp.asarray(z["bounds"]) if "bounds" in z else None
     if int(z["kind"]) == 2:
-        n_in, nc, ch, blk, cs_win = (int(v) for v in z["meta"])
+        n_in, nc, ch, blk, cs_win, k_expand = (int(v) for v in z["meta"])
         route = BalancedRoute(
             n_in=n_in, nc=nc, ch=ch, blk=blk, cs_win=cs_win,
+            k_expand=k_expand,
             a1=jnp.asarray(z["a1"]), a2=jnp.asarray(z["a2"]),
             a3=jnp.asarray(z["a3"]), b1=jnp.asarray(z["b1"]),
             b2=jnp.asarray(z["b2"]), b3=jnp.asarray(z["b3"]),
@@ -797,29 +915,40 @@ def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
     if isinstance(aux, VpermRoute):  # back-compat: bare aligned route
         aux = XchgAux(route=aux)
     bf16 = os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16"
-    if aux.vals_dest is not None:
-        # The static value stream is pre-permuted (attach time), so each
-        # step moves only the dz expansion; the value multiply happens
-        # at the destination, fused into the reduce read.
-        k = vals_rowmajor.shape[1]
-        stream = jnp.repeat(per_row.astype(jnp.float32), k)
+    balanced = isinstance(aux.route, BalancedRoute)
+    if (balanced and aux.route.k_expand and aux.vals_dest is not None
+            and expand_kernel_supported()):
+        # Fully fused fast path: the [n] dz vector expands INSIDE stage
+        # A (no E-stream materialization at all) and the static values
+        # multiply at the destination.
+        dz = per_row.astype(jnp.bfloat16 if bf16 else jnp.float32)
+        moved = apply_balanced_dz(dz, aux.route, interpret=bool(interpret))
     else:
-        stream = (per_row[:, None] * vals_rowmajor).astype(
-            jnp.float32
-        ).reshape(-1)
-    # Optional half-width payload through the exchange: the permutation
-    # passes are pure data movement, so bf16 halves their HBM traffic;
-    # products quantize at ~2^-9 relative and the reduce runs f32 (the
-    # compensated scan below, or the aligned position-reduce's f32
-    # accumulate), so per-feature sums keep ~0.1% worst-case error.
-    # Measured-choice knob like every kernel decision here.
-    if bf16:
-        stream = stream.astype(jnp.bfloat16)
-    if isinstance(aux.route, BalancedRoute):
-        moved = apply_balanced(stream, aux.route,
-                               interpret=bool(interpret))
-    else:
-        moved = apply_vperm(stream, aux.route, interpret=bool(interpret))
+        if aux.vals_dest is not None:
+            # The static value stream is pre-permuted (attach time), so
+            # each step moves only the dz expansion; the value multiply
+            # happens at the destination, fused into the reduce read.
+            k = vals_rowmajor.shape[1]
+            stream = jnp.repeat(per_row.astype(jnp.float32), k)
+        else:
+            stream = (per_row[:, None] * vals_rowmajor).astype(
+                jnp.float32
+            ).reshape(-1)
+        # Optional half-width payload through the exchange: the
+        # permutation passes are pure data movement, so bf16 halves
+        # their HBM traffic; products quantize at ~2^-9 relative and
+        # the reduce runs f32 (the compensated scan below, or the
+        # aligned position-reduce's f32 accumulate), so per-feature
+        # sums keep ~0.1% worst-case error.  Measured-choice knob like
+        # every kernel decision here.
+        if bf16:
+            stream = stream.astype(jnp.bfloat16)
+        if balanced:
+            moved = apply_balanced(stream, aux.route,
+                                   interpret=bool(interpret))
+        else:
+            moved = apply_vperm(stream, aux.route,
+                                interpret=bool(interpret))
     if aux.vals_dest is not None:
         # Upcast BOTH operands before multiplying: the exchange is done,
         # so there is no traffic reason to multiply in bf16, and a bf16

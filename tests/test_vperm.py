@@ -203,6 +203,38 @@ def test_xchg_bf16_payload_close_to_f32(monkeypatch):
     assert not np.array_equal(g16, g32)  # the knob actually engaged
 
 
+@pytest.mark.parametrize("k", [32, 6])
+def test_fused_dz_expansion_matches_oracle(monkeypatch, k):
+    """The stage-A fused dz expansion (k | 128) must reproduce the
+    oracle; k=6 pins the fallback (k_expand == 0 -> legacy stream)."""
+    from photon_tpu.ops.vperm import build_xchg_aux, xchg_segment_grad
+
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    rng = np.random.default_rng(11)
+    n = (3 * CS) // k  # e spans 3 chunks -> nc > 1
+    dim = 4096
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    vals[rng.random((n, k)) < 0.1] = 0.0
+    aux = build_xchg_aux(None, ids, dim, vals=vals)
+    assert aux.vals_dest is not None
+    from photon_tpu.ops.vperm import BalancedRoute
+
+    assert isinstance(aux.route, BalancedRoute)
+    assert aux.route.k_expand == (k if 128 % k == 0 else 0)
+    per_row = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(xchg_segment_grad(
+        jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+        None, aux, dim, interpret=INTERP,
+    ))
+    want = np.zeros(dim, np.float64)
+    np.add.at(want, ids.reshape(-1),
+              (per_row[:, None] * vals).reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-4,
+                               atol=5e-3)
+
+
 def test_route_cache_round_trip(monkeypatch, tmp_path):
     """Cached routes must deserialize to the same gradient as freshly
     built ones, and a vals-zero-pattern change must MISS in aligned
