@@ -246,17 +246,20 @@ def full_bijection(dest_src: np.ndarray, n_sources: int,
     return perm
 
 
-def _chunk_kernel(x_ref, i1_ref, i2_ref, i3_ref, o_ref):
-    """Fused 5-stage micro-Clos over one [CH, 128] chunk in VMEM."""
-    y = jnp.take_along_axis(
-        x_ref[...], i1_ref[...].astype(jnp.int32), axis=1
-    )
+def _micro_clos_body(y, i1_ref, i2_ref, i3_ref):
+    """The 5-stage micro-Clos array math, shared by every chunk kernel
+    variant (plain, dz-expanding) so the stage sequence can never
+    desynchronize between them."""
+    y = jnp.take_along_axis(y, i1_ref[...].astype(jnp.int32), axis=1)
     y = y.T  # [128, CH] in VMEM
     y = jnp.take_along_axis(y, i2_ref[...].astype(jnp.int32), axis=1)
     y = y.T
-    o_ref[...] = jnp.take_along_axis(
-        y, i3_ref[...].astype(jnp.int32), axis=1
-    )
+    return jnp.take_along_axis(y, i3_ref[...].astype(jnp.int32), axis=1)
+
+
+def _chunk_kernel(x_ref, i1_ref, i2_ref, i3_ref, o_ref):
+    """Fused 5-stage micro-Clos over one [CH, 128] chunk in VMEM."""
+    o_ref[...] = _micro_clos_body(x_ref[...], i1_ref, i2_ref, i3_ref)
 
 
 def _lane_kernel(x_ref, c_ref, o_ref):
@@ -614,42 +617,40 @@ def build_balanced_sorted_route(
 def _chunk_expand_kernel(dz_ref, i1_ref, i2_ref, i3_ref, o_ref):
     """Stage A with the dz expansion fused: the [ch, 128/k] dz tile
     broadcasts to the row-major [ch, 128] stream in VMEM (static lane
-    repeat), then the 5-stage micro-Clos runs as usual.  Pad-tail
+    repeat), then the shared 5-stage micro-Clos body runs.  Pad-tail
     positions carry whatever dz value the repeat lands there — they
     flow into pad destinations whose vals_dest is zero."""
     k = LANES // dz_ref.shape[1]
     y = jnp.repeat(dz_ref[...], k, axis=1)
-    y = jnp.take_along_axis(y, i1_ref[...].astype(jnp.int32), axis=1)
-    y = y.T
-    y = jnp.take_along_axis(y, i2_ref[...].astype(jnp.int32), axis=1)
-    y = y.T
-    o_ref[...] = jnp.take_along_axis(
-        y, i3_ref[...].astype(jnp.int32), axis=1
-    )
+    o_ref[...] = _micro_clos_body(y, i1_ref, i2_ref, i3_ref)
 
 
 _EXPAND_SUPPORTED: dict = {}
 
 
-def expand_kernel_supported() -> bool:
+def expand_kernel_supported(k: int = 32,
+                            dtype=jnp.float32) -> bool:
     """Eager Mosaic capability probe for the fused dz-expansion kernel
-    (jnp.repeat along lanes), cached per backend — a lowering failure
-    would otherwise surface only when the optimizer's enclosing jit
+    (jnp.repeat along lanes), cached per (backend, k, dtype) — the
+    exact configuration that will run, since narrow-lane tiles and
+    bf16 gathers can lower differently.  A lowering failure would
+    otherwise surface only when the optimizer's enclosing jit
     compiles."""
     backend = jax.default_backend()
-    if backend not in _EXPAND_SUPPORTED:
+    key = (backend, int(k), jnp.dtype(dtype).name)
+    if key not in _EXPAND_SUPPORTED:
         if backend != "tpu":
-            _EXPAND_SUPPORTED[backend] = True  # interpret mode
+            _EXPAND_SUPPORTED[key] = True  # interpret mode
         else:
             from jax.experimental import pallas as pl
 
             try:
                 f = pl.pallas_call(
                     _chunk_expand_kernel,
-                    out_shape=jax.ShapeDtypeStruct((8, LANES), jnp.float32),
+                    out_shape=jax.ShapeDtypeStruct((8, LANES), dtype),
                     grid=(1,),
                     in_specs=[
-                        pl.BlockSpec((8, 4), lambda i: (i, 0)),
+                        pl.BlockSpec((8, LANES // k), lambda i: (i, 0)),
                         pl.BlockSpec((8, LANES), lambda i: (i, 0)),
                         pl.BlockSpec((LANES, 8), lambda i: (i, 0)),
                         pl.BlockSpec((8, LANES), lambda i: (i, 0)),
@@ -657,15 +658,22 @@ def expand_kernel_supported() -> bool:
                     out_specs=pl.BlockSpec((8, LANES), lambda i: (i, 0)),
                 )
                 jax.block_until_ready(f(
-                    jnp.ones((8, 4), jnp.float32),
+                    jnp.ones((8, LANES // k), dtype),
                     jnp.zeros((8, LANES), jnp.int8),
                     jnp.zeros((LANES, 8), jnp.int16),
                     jnp.zeros((8, LANES), jnp.int8),
                 ))
-                _EXPAND_SUPPORTED[backend] = True
-            except Exception:  # noqa: BLE001 — fall back to legacy path
-                _EXPAND_SUPPORTED[backend] = False
-    return _EXPAND_SUPPORTED[backend]
+                _EXPAND_SUPPORTED[key] = True
+            except Exception as exc:  # noqa: BLE001 — fall back
+                import logging
+
+                logging.getLogger("photon_tpu.vperm").warning(
+                    "fused dz-expansion kernel unavailable on %s "
+                    "(k=%d, %s): %s — using the streamed exchange path",
+                    backend, k, jnp.dtype(dtype).name, exc,
+                )
+                _EXPAND_SUPPORTED[key] = False
+    return _EXPAND_SUPPORTED[key]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -677,7 +685,7 @@ def apply_balanced_dz(dz: Array, route: BalancedRoute,
     row-aligned windows)."""
     from jax.experimental import pallas as pl
 
-    nc, ch, blk, total = route.nc, route.ch, route.blk, route.total
+    nc, ch = route.nc, route.ch
     cs, cs_win, k = route.cs, route.cs_win, route.k_expand
     if not k:
         raise ValueError("route was built without k_expand")
@@ -703,7 +711,14 @@ def apply_balanced_dz(dz: Array, route: BalancedRoute,
         ],
         out_specs=pl.BlockSpec((ch, LANES), lambda i: (i, 0)),
         interpret=interpret,
-    )(dz2d, route.a1, route.a2, route.a3, )
+    )(dz2d, route.a1, route.a2, route.a3)
+    return _balanced_tail(g, route, interpret)
+
+
+def _balanced_tail(g: Array, route: BalancedRoute,
+                   interpret: bool) -> Array:
+    """Block transpose + stage B, shared by both stage-A variants."""
+    nc, ch, blk, total = route.nc, route.ch, route.blk, route.total
     if nc > 1:
         g = (
             g.reshape(nc, nc, blk)
@@ -732,16 +747,9 @@ def apply_balanced(x: Array, route: BalancedRoute,
         x.reshape(nc, cs_win), ((0, 0), (0, cs - cs_win))
     ).reshape(nc * ch, LANES)
     g = _chunk_pass(g, route.a1, route.a2, route.a3, nc, ch, interpret)
-    if nc > 1:
-        # ...the balanced exchange is one strided XLA transpose...
-        g = (
-            g.reshape(nc, nc, blk)
-            .transpose(1, 0, 2)
-            .reshape(nc * ch, LANES)
-        )
-        # ...and stage B packs each dest chunk into sorted front order.
-        g = _chunk_pass(g, route.b1, route.b2, route.b3, nc, ch, interpret)
-    return g.reshape(total)
+    # The balanced exchange is one strided XLA transpose, then stage B
+    # packs each dest chunk into sorted front order.
+    return _balanced_tail(g, route, interpret)
 
 
 # Versioned PER MODE so bumping one builder doesn't invalidate the other
@@ -917,7 +925,10 @@ def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
     bf16 = os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16"
     balanced = isinstance(aux.route, BalancedRoute)
     if (balanced and aux.route.k_expand and aux.vals_dest is not None
-            and expand_kernel_supported()):
+            and expand_kernel_supported(
+                aux.route.k_expand,
+                jnp.bfloat16 if bf16 else jnp.float32,
+            )):
         # Fully fused fast path: the [n] dz vector expands INSIDE stage
         # A (no E-stream materialization at all) and the static values
         # multiply at the destination.

@@ -203,16 +203,18 @@ def test_xchg_bf16_payload_close_to_f32(monkeypatch):
     assert not np.array_equal(g16, g32)  # the knob actually engaged
 
 
-@pytest.mark.parametrize("k", [32, 6])
-def test_fused_dz_expansion_matches_oracle(monkeypatch, k):
+@pytest.mark.parametrize("k,n_off", [(32, 0), (32, -1), (6, 0)])
+def test_fused_dz_expansion_matches_oracle(monkeypatch, k, n_off):
     """The stage-A fused dz expansion (k | 128) must reproduce the
-    oracle; k=6 pins the fallback (k_expand == 0 -> legacy stream)."""
+    oracle; (32, -1) makes cs_real indivisible by k so the window
+    row-rounding branch engages; k=6 pins the fallback (k_expand == 0
+    -> legacy stream)."""
     from photon_tpu.ops.vperm import build_xchg_aux, xchg_segment_grad
 
     monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
     monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
     rng = np.random.default_rng(11)
-    n = (3 * CS) // k  # e spans 3 chunks -> nc > 1
+    n = (3 * CS) // k + n_off  # e spans 3 chunks -> nc > 1
     dim = 4096
     ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
     vals = rng.standard_normal((n, k)).astype(np.float32)
