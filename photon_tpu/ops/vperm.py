@@ -476,11 +476,13 @@ class BalancedRoute:
     """
 
     n_in: int
+    n_out: int      # real destination-stream length (repack slice)
     nc: int
     ch: int
     blk: int
     cs_win: int
-    k_expand: int  # k when the in-kernel dz expansion applies, else 0
+    ds_win: int     # real dest entries per chunk front
+    k_expand: int   # k when the in-kernel dz expansion applies, else 0
     a1: jnp.ndarray
     a2: jnp.ndarray
     a3: jnp.ndarray
@@ -500,7 +502,9 @@ class BalancedRoute:
 tree_util.register_dataclass(
     BalancedRoute,
     data_fields=("a1", "a2", "a3", "b1", "b2", "b3"),
-    meta_fields=("n_in", "nc", "ch", "blk", "cs_win", "k_expand"),
+    meta_fields=(
+        "n_in", "n_out", "nc", "ch", "blk", "cs_win", "ds_win", "k_expand",
+    ),
 )
 
 
@@ -521,27 +525,32 @@ def _complete_chunk_local(dest_src: np.ndarray, nc: int,
     return out
 
 
-def build_balanced_sorted_route(
-    ids: np.ndarray, dim: int, order: np.ndarray | None = None
-):
-    """(BalancedRoute, bounds) for the rm → feature-sorted exchange, or
-    None when the data defeats the balance assumption (caller falls back
-    to the colored route)."""
-    flat = ids.reshape(-1).astype(np.int64)
-    k = int(ids.shape[-1]) if ids.ndim == 2 else 0
-    e = flat.size
-    if order is None:
-        order = np.argsort(flat, kind="stable")
-    else:
-        order = np.ascontiguousarray(order, dtype=np.int64)
+def _build_balanced_core(dest_src: np.ndarray, n_src_stream: int, k: int):
+    """Factor an exchange into the balanced form, for ANY destination
+    stream that tolerates zero pads between real entries.
 
-    if e > MAX_N:
-        return None  # fallback path raises pick_geometry's clear error
-    nc = min(128, max(1, -(-e // (CH_SMALL * LANES))))
-    cs_real = -(-e // nc)  # dest window j = sorted ranks [j*cs_real, ...)
-    src_of_rank = order
-    ranks = np.arange(e, dtype=np.int64)
-    dest_win = np.minimum(ranks // cs_real, nc - 1)
+    ``dest_src[d]`` = source rm index feeding destination ``d`` (< 0
+    for pad destinations; each source index appears at most once).
+    ``n_src_stream`` is the FULL row-major stream length (n*k) — source
+    windows partition the whole stream, since rm indices of real
+    entries range over all of it.  Returns a :class:`BalancedRoute` or
+    None when the data defeats the balance assumption / geometry limits
+    (caller falls back to the colored route).
+    """
+    n_dest = dest_src.size
+    d_real = np.flatnonzero(dest_src >= 0)
+    src_of = dest_src[d_real]
+    e = d_real.size
+    if max(n_src_stream, n_dest) > MAX_N:
+        return None
+    if e and (src_of.min() < 0 or src_of.max() >= n_src_stream):
+        return None
+    nc = min(
+        128,
+        max(1, -(-max(n_src_stream, n_dest) // (CH_SMALL * LANES))),
+    )
+    ds_win = -(-n_dest // nc)  # dest window j = dests [j*ds_win, ...)
+    dest_win = np.minimum(d_real // ds_win, nc - 1)
 
     # Source windows are cs_win RAW rm entries; each physical chunk is
     # one window front-packed plus a pad tail (apply_balanced inserts
@@ -552,17 +561,20 @@ def build_balanced_sorted_route(
     # rebuild the row-major stream from a [ch, 128/k] dz tile and the
     # per-step E-stream materialization disappears.
     k_expand = k if (k and LANES % k == 0) else 0
+    cs_base = -(-n_src_stream // nc)
     if k_expand:
-        cs_win = k * (-(-cs_real // k))
+        cs_win = k * (-(-cs_base // k))
     else:
-        cs_win = cs_real
-    src_win = np.minimum(src_of_rank // cs_win, nc - 1)
+        cs_win = cs_base
+    src_win = np.minimum(src_of // cs_win, nc - 1)
     counts = np.bincount(
         src_win * nc + dest_win, minlength=nc * nc
     ).reshape(nc, nc)
     blk = int(counts.max())
-    cs_pad = -(-max(nc * blk, cs_win) // (nc * LANES)) * (nc * LANES)
-    if nc > 1 and cs_pad > 2 * cs_real:
+    cs_pad = -(-max(nc * blk, cs_win, ds_win) // (nc * LANES)) * (
+        nc * LANES
+    )
+    if nc > 1 and cs_pad > 2 * max(cs_base, ds_win):
         return None  # pathological source/dest correlation
     ch = cs_pad // LANES
     if ch > 8192:
@@ -573,45 +585,95 @@ def build_balanced_sorted_route(
     total = nc * cs_pad
 
     # Stage-A slot of each entry: source chunk src_win, block dest_win,
-    # position by sorted-rank order within the (src, dest) pair.
-    pair = src_win * nc + dest_win
-    pair_order = np.argsort(pair, kind="stable")
-    sizes = np.bincount(pair, minlength=nc * nc)
-    starts = np.concatenate(([0], np.cumsum(sizes)))[:-1]
-    rank_in_block = np.zeros(e, dtype=np.int64)
-    rank_in_block[pair_order] = ranks - np.repeat(starts, sizes)
-    mid_slot = src_win * cs_pad + dest_win * blk_slots + rank_in_block
+    # position by destination order within the (src, dest) pair.  With
+    # one chunk the transpose and stage B are skipped (apply's nc > 1
+    # guard), so stage A must place entries at their FINAL positions —
+    # mid == final, not the compacted block order (real destinations
+    # can be sparse in the aligned slot stream).
+    seq = np.arange(e, dtype=np.int64)
+    if nc == 1:
+        mid_slot = d_real.astype(np.int64)
+    else:
+        pair = src_win * nc + dest_win
+        pair_order = np.argsort(pair, kind="stable")
+        sizes = np.bincount(pair, minlength=nc * nc)
+        starts = np.concatenate(([0], np.cumsum(sizes)))[:-1]
+        rank_in_block = np.zeros(e, dtype=np.int64)
+        rank_in_block[pair_order] = seq - np.repeat(starts, sizes)
+        mid_slot = (
+            src_win * cs_pad + dest_win * blk_slots + rank_in_block
+        )
 
-    # Stage A within-chunk perms (pads complete chunk-locally).  Source
+    # Stage A within-chunk perms (pads complete chunk-locally against
+    # each chunk's own unused — zero-valued — sources).  Source
     # coordinates are in the PADDED stream: window-local offset is the
     # raw offset (windows front-pack their chunks).
     dest_src_a = np.full(total, -1, np.int64)
-    dest_src_a[mid_slot] = src_win * cs_pad + (src_of_rank % cs_win)
+    dest_src_a[mid_slot] = src_win * cs_pad + (src_of % cs_win)
     rows_a = _complete_chunk_local(dest_src_a, nc, cs_pad)
     a1, a2, a3 = _chunk_stage_arrays(rows_a, ch)
 
-    # Block transpose [nc, nc, blk_slots]: (src, dest, b) -> (dest, src, b).
-    post_t = (dest_win * cs_pad + src_win * blk_slots + rank_in_block)
+    if nc == 1:
+        # Stage B is skipped at apply time; identity planes keep the
+        # dataclass/serialization shape uniform.
+        ident = np.arange(cs_pad, dtype=np.int64)[None, :]
+        b1, b2p, b3 = _chunk_stage_arrays(ident, ch)
+    else:
+        # Block transpose [nc, nc, blk_slots]:
+        # (src, dest, b) -> (dest, src, b).
+        post_t = (
+            dest_win * cs_pad + src_win * blk_slots + rank_in_block
+        )
+        # Stage B: destination d front-packs into dest chunk dest_win.
+        final = dest_win * cs_pad + (d_real - dest_win * ds_win)
+        dest_src_b = np.full(total, -1, np.int64)
+        dest_src_b[final] = post_t
+        rows_b = _complete_chunk_local(dest_src_b, nc, cs_pad)
+        b1, b2p, b3 = _chunk_stage_arrays(rows_b, ch)
 
-    # Stage B: sorted rank r front-packs into dest chunk dest_win.
-    final = dest_win * cs_pad + (ranks - dest_win * cs_real)
-    dest_src_b = np.full(total, -1, np.int64)
-    dest_src_b[final] = post_t
-    rows_b = _complete_chunk_local(dest_src_b, nc, cs_pad)
-    b1, b2p, b3 = _chunk_stage_arrays(rows_b, ch)
-
-    route = BalancedRoute(
-        n_in=e, nc=nc, ch=ch, blk=blk_slots, cs_win=cs_win,
-        k_expand=k_expand,
+    return BalancedRoute(
+        n_in=n_src_stream, n_out=n_dest, nc=nc, ch=ch, blk=blk_slots,
+        cs_win=cs_win, ds_win=ds_win, k_expand=k_expand,
         a1=jnp.asarray(a1), a2=jnp.asarray(a2), a3=jnp.asarray(a3),
         b1=jnp.asarray(b1), b2=jnp.asarray(b2p), b3=jnp.asarray(b3),
     )
+
+
+def build_balanced_sorted_route(
+    ids: np.ndarray, dim: int, order: np.ndarray | None = None
+):
+    """(BalancedRoute, bounds) for the rm → feature-sorted exchange, or
+    None when the data defeats the balance assumption."""
+    flat = ids.reshape(-1).astype(np.int64)
+    k = int(ids.shape[-1]) if ids.ndim == 2 else 0
+    e = flat.size
+    if order is None:
+        order = np.argsort(flat, kind="stable")
+    else:
+        order = np.ascontiguousarray(order, dtype=np.int64)
+    route = _build_balanced_core(order, e, k)
+    if route is None:
+        return None
     bounds_rank = np.searchsorted(
         flat[order], np.arange(dim + 1, dtype=np.int64)
     )
-    bw = np.minimum(bounds_rank // cs_real, nc - 1)
-    bounds = (bw * cs_pad + (bounds_rank - bw * cs_real)).astype(np.int64)
+    bw = np.minimum(bounds_rank // route.ds_win, route.nc - 1)
+    bounds = (bw * route.cs + (bounds_rank - bw * route.ds_win))
     return route, jnp.asarray(bounds.astype(np.int32))
+
+
+def build_balanced_aligned_route(layout, ids: np.ndarray):
+    """BalancedRoute for the rm → aligned-slot exchange (same balanced
+    construction; the destination is the slab slot stream, whose pads
+    carry zeros automatically because chunk-local completion pairs them
+    with the zero-valued unused sources).  The applied stream repacks
+    chunk fronts back into the contiguous slot array
+    (see xchg_segment_grad).  None → colored fallback."""
+    k = int(ids.shape[-1]) if ids.ndim == 2 else 0
+    slots_src = np.ascontiguousarray(
+        layout.src.reshape(-1), dtype=np.int64
+    )
+    return _build_balanced_core(slots_src, int(ids.size), k)
 
 
 def _chunk_expand_kernel(dz_ref, i1_ref, i2_ref, i3_ref, o_ref):
@@ -754,10 +816,11 @@ def apply_balanced(x: Array, route: BalancedRoute,
 
 # Versioned PER MODE so bumping one builder doesn't invalidate the other
 # mode's (expensive) cached routes.
-_ROUTE_CACHE_VERSION = {"aligned": 1, "cumsum": 2}
+_ROUTE_CACHE_VERSION = {"aligned": 2, "cumsum": 3}
 
 
-def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout):
+def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
+                      has_vals: bool):
     """Disk-cache path for a routed exchange, or None when disabled.
 
     Routes are pure functions of their inputs and cost tens of host-
@@ -767,6 +830,9 @@ def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout):
     aligned mode additionally hashes ``layout.src`` (the slot→source
     map), because the aligned layout drops val==0 entries — identical
     ids with different zero patterns yield different routes.
+    ``has_vals`` enters the key because aligned-mode route KIND depends
+    on it (balanced needs the destination value stream) — a vals-less
+    caller must not pin the colored route for later vals-carrying ones.
     """
     import hashlib
     import os
@@ -780,7 +846,10 @@ def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout):
     if mode != "cumsum" and layout is not None:
         h.update(np.ascontiguousarray(layout.src).tobytes())
     ver = _ROUTE_CACHE_VERSION.get(mode, _ROUTE_CACHE_VERSION["aligned"])
-    h.update(f"|{dim}|{mode}|v{ver}".encode())
+    # vals-carrying keys stay in the canonical (unsuffixed) namespace so
+    # the expensive production entries survive this key extension.
+    suffix = "" if has_vals else "|novals"
+    h.update(f"|{dim}|{mode}|v{ver}{suffix}".encode())
     return os.path.join(root, h.hexdigest()[:32] + ".npz")
 
 
@@ -790,7 +859,9 @@ def _aux_to_npz(aux: XchgAux) -> dict:
     if isinstance(r, BalancedRoute):
         out["kind"] = np.int64(2)
         out["meta"] = np.asarray(
-            [r.n_in, r.nc, r.ch, r.blk, r.cs_win, r.k_expand], np.int64
+            [r.n_in, r.n_out, r.nc, r.ch, r.blk, r.cs_win, r.ds_win,
+             r.k_expand],
+            np.int64,
         )
         for name in ("a1", "a2", "a3", "b1", "b2", "b3"):
             out[name] = np.asarray(getattr(r, name))
@@ -811,10 +882,12 @@ def _aux_to_npz(aux: XchgAux) -> dict:
 def _aux_from_npz(z) -> XchgAux:
     bounds = jnp.asarray(z["bounds"]) if "bounds" in z else None
     if int(z["kind"]) == 2:
-        n_in, nc, ch, blk, cs_win, k_expand = (int(v) for v in z["meta"])
+        (n_in, n_out, nc, ch, blk, cs_win, ds_win, k_expand) = (
+            int(v) for v in z["meta"]
+        )
         route = BalancedRoute(
-            n_in=n_in, nc=nc, ch=ch, blk=blk, cs_win=cs_win,
-            k_expand=k_expand,
+            n_in=n_in, n_out=n_out, nc=nc, ch=ch, blk=blk, cs_win=cs_win,
+            ds_win=ds_win, k_expand=k_expand,
             a1=jnp.asarray(z["a1"]), a2=jnp.asarray(z["a2"]),
             a3=jnp.asarray(z["a3"]), b1=jnp.asarray(z["b1"]),
             b2=jnp.asarray(z["b2"]), b3=jnp.asarray(z["b3"]),
@@ -849,7 +922,9 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
 
     n, k = ids.shape
     mode = os.environ.get("PHOTON_XCHG_REDUCE", "aligned")
-    path = _route_cache_path(np.asarray(ids), dim, mode, layout)
+    path = _route_cache_path(
+        np.asarray(ids), dim, mode, layout, vals is not None
+    )
     aux = None
     if path is not None and os.path.exists(path):
         try:
@@ -873,7 +948,18 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
                     np.asarray(ids), dim, order=order
                 )
         else:
-            aux = XchgAux(route=build_xchg_route(layout, n, k))
+            # Aligned destination: the balanced exchange also applies
+            # (slab slot pads pair with zero-valued unused sources), and
+            # needs vals for the destination multiply; otherwise the
+            # general colored route.
+            built = (
+                build_balanced_aligned_route(layout, np.asarray(ids))
+                if vals is not None else None
+            )
+            if built is not None:
+                aux = XchgAux(route=built)
+            else:
+                aux = XchgAux(route=build_xchg_route(layout, n, k))
         if path is not None:
             try:
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -885,7 +971,9 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
                 logging.getLogger("photon_tpu.vperm").warning(
                     "route cache write failed (%s)", exc
                 )
-    if aux.bounds is not None and vals is not None:
+    if vals is not None and (
+        aux.bounds is not None or isinstance(aux.route, BalancedRoute)
+    ):
         interp = jax.default_backend() != "tpu"
         flat = jnp.asarray(
             np.asarray(vals, np.float32).reshape(-1)
@@ -971,6 +1059,14 @@ def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
     else:
         moved = moved.astype(jnp.float32)
     if aux.bounds is None:
+        if balanced:
+            # Repack chunk fronts into the contiguous slot stream (one
+            # XLA copy), then the existing position-reduce finishes.
+            r = aux.route
+            moved = (
+                moved.reshape(r.nc, r.cs)[:, :r.ds_win]
+                .reshape(-1)[: r.n_out]
+            )
         return aligned_reduce(
             moved.reshape(al.lo.shape), al, dim, interpret=interpret
         )

@@ -237,6 +237,42 @@ def test_fused_dz_expansion_matches_oracle(monkeypatch, k, n_off):
                                atol=5e-3)
 
 
+def test_balanced_aligned_route_multi_chunk(monkeypatch):
+    """The balanced exchange into the ALIGNED slot stream (repack +
+    position-reduce) must reproduce the oracle at NC > 1."""
+    from photon_tpu.ops.pallas_gather import (
+        build_aligned_layout,
+        device_layout,
+    )
+    from photon_tpu.ops.vperm import (
+        BalancedRoute,
+        build_xchg_aux,
+        xchg_segment_grad,
+    )
+
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "aligned")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    rng = np.random.default_rng(12)
+    n, k, dim = (3 * CS) // 32, 32, 4096
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    vals[rng.random((n, k)) < 0.1] = 0.0
+    layout = build_aligned_layout(ids, vals, dim)
+    aux = build_xchg_aux(layout, ids, dim, vals=vals)
+    assert isinstance(aux.route, BalancedRoute) and aux.route.nc > 1
+    assert aux.bounds is None and aux.vals_dest is not None
+    per_row = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(xchg_segment_grad(
+        jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+        device_layout(layout), aux, dim, interpret=INTERP,
+    ))
+    want = np.zeros(dim, np.float64)
+    np.add.at(want, ids.reshape(-1),
+              (per_row[:, None] * vals).reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-4,
+                               atol=5e-3)
+
+
 def test_route_cache_round_trip(monkeypatch, tmp_path):
     """Cached routes must deserialize to the same gradient as freshly
     built ones, and a vals-zero-pattern change must MISS in aligned

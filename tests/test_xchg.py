@@ -46,9 +46,10 @@ def test_xchg_kernel_matches_autodiff(monkeypatch, loss, zipf, reduce):
     assert fast.al is not None and fast.xchg is not None
     assert fast.al_t is not None  # xchg implies the pallas forward
     assert (fast.xchg.bounds is not None) == (reduce == "cumsum")
-    # cumsum attaches the pre-permuted static value stream, so these
-    # assertions pin that the vals_dest fast path is what's under test.
-    assert (fast.xchg.vals_dest is not None) == (reduce == "cumsum")
+    # Both reduce modes ride the balanced exchange with the pre-permuted
+    # static value stream at these sizes; pin that the vals_dest fast
+    # path is what's under test.
+    assert fast.xchg.vals_dest is not None
     obj = GlmObjective.create(loss, RegularizationContext("l2", 0.6))
     rng = np.random.default_rng(81)
     w = jnp.asarray(rng.standard_normal(d), jnp.float32) * 0.1
