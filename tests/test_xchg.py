@@ -101,6 +101,28 @@ def test_xchg_route_not_built_in_auto_below_floor(monkeypatch):
     assert fast.xchg is None
 
 
+def test_game_fixed_effect_with_xchg_forced(monkeypatch, tmp_path):
+    """The GAME training driver end-to-end with the xchg kernel forced:
+    the fixed-effect coordinate's attach builds the routes and training
+    converges to finite metrics (route plumbing inside coordinates)."""
+    from photon_tpu.drivers import train_game
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "xchg")
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    out = train_game.run(train_game.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", "synthetic-game:32:4:8:4:1:7",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=4",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=3",
+        "--descent-iterations", "1",
+        "--validation-split", "0.25",
+        "--output-dir", str(tmp_path / "out"),
+    ]))
+    for v in out["best_metrics"].values():
+        assert np.isfinite(v)
+
+
 def test_xchg_lbfgs_training_converges(monkeypatch):
     from photon_tpu.core.optimizers import lbfgs
 
