@@ -1,0 +1,58 @@
+"""Summarize a tools/tpu_day_out/ evidence pack into a markdown table.
+
+Run after a hardware window: parses every bench JSON line and probe
+table in the pack, prints a KERNEL_NOTES-ready markdown summary plus
+the raw probe rows, and flags files that errored or never produced a
+metric (evidence of a mid-window tunnel drop or a lowering failure).
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+
+def main(out_dir="tools/tpu_day_out"):
+    rows = []
+    missing = []
+    for path in sorted(glob.glob(os.path.join(out_dir, "*.txt"))):
+        name = os.path.basename(path)
+        text = open(path, errors="replace").read()
+        metrics = re.findall(r'^\{"metric".*\}$', text, re.M)
+        if metrics:
+            for m in metrics:
+                try:
+                    d = json.loads(m)
+                except json.JSONDecodeError:
+                    continue
+                det = d.get("detail", {})
+                rows.append((
+                    name, d.get("metric"), d.get("value"), d.get("unit"),
+                    det.get("kernel"), det.get("platform"),
+                    det.get("pct_hbm_roofline"),
+                ))
+        elif name.startswith(("02_", "03_", "04_", "06_", "09_")):
+            tail = text.strip().splitlines()[-3:] if text.strip() else []
+            missing.append((name, " | ".join(t[:90] for t in tail)))
+
+    if rows:
+        print("| file | metric | value | unit | kernel | platform | %roof |")
+        print("|---|---|---|---|---|---|---|")
+        for r in rows:
+            print("| " + " | ".join(str(x) for x in r) + " |")
+    for path in sorted(glob.glob(os.path.join(out_dir, "0[578]_*.txt"))):
+        print(f"\n== {os.path.basename(path)} ==")
+        for line in open(path, errors="replace").read().splitlines():
+            if re.match(r"^[a-z]\. ", line) or line.startswith(
+                ("backend=", "pallas ", "xla ")
+            ):
+                print(line)
+    if missing:
+        print("\nNO METRIC (drop / failure?):")
+        for name, tail in missing:
+            print(f"  {name}: {tail}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
