@@ -95,7 +95,8 @@ def _edge_color_native(l: np.ndarray, r: np.ndarray, a: int,
     if rc == -3:
         raise ValueError(
             f"permutation too large for the native router ({l.size:,} "
-            f"edges > INT32_MAX); shard the layout before routing"
+            f"edges > INT32_MAX/2 — head prefix sums reach 2E); shard "
+            f"the layout before routing"
         )
     if rc != 0:
         raise RuntimeError(f"clos_edge_color failed: rc={rc}")

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 
 import numpy as np
 
@@ -60,6 +61,7 @@ from photon_tpu.ops.clos import route_permutation
 Array = jax.Array
 
 LANES = 128
+SUBLANES_PAD = 8             # f32 sublane tile: chunk heights pad to it
 CH_SMALL = 2048              # chunk sublane-rows (1 MB f32 chunks)
 CH_LARGE = 4096              # for domains past 128 small chunks
 MAX_N = 128 * CH_LARGE * LANES   # 2^26: lane stage holds NC <= 128
@@ -395,10 +397,27 @@ class XchgAux:
     route: VpermRoute
     bounds: object = None  # [dim+1] int32 device array for cumsum mode
     vals_dest: object = None  # [total] f32, pre-permuted static values
+    # Fingerprint of the row-major value stream the bake saw — the
+    # strided f32 SAMPLE itself (elementwise-comparable: a collapsed
+    # scalar like an L1 norm is permutation-invariant and would miss
+    # value swaps), carried as a DATA leaf so re-attaching with new
+    # values never changes the treedef (a meta fp would force a full
+    # jit recompile per re-attach).  Lets eager callers that pass
+    # DIFFERENT values be rejected instead of silently reading the
+    # stale baked stream (ADVICE r4).  None until vals are baked.
+    vals_fp: object = None
+
+
+# Sample cap for the fingerprint: bounds the guard's host cost to O(1)
+# per eager call.  The sample is STRIDED across the whole stream (not a
+# prefix) so a re-weighting confined to a later region of a large
+# stream still moves the fingerprint.
+_VALS_FP_SAMPLES = 65536
 
 
 tree_util.register_dataclass(
-    XchgAux, data_fields=("route", "bounds", "vals_dest"), meta_fields=()
+    XchgAux, data_fields=("route", "bounds", "vals_dest", "vals_fp"),
+    meta_fields=(),
 )
 
 
@@ -571,9 +590,12 @@ def _build_balanced_core(dest_src: np.ndarray, n_src_stream: int, k: int):
         src_win * nc + dest_win, minlength=nc * nc
     ).reshape(nc, nc)
     blk = int(counts.max())
-    cs_pad = -(-max(nc * blk, cs_win, ds_win) // (nc * LANES)) * (
-        nc * LANES
-    )
+    # Quantum LANES * lcm(nc, 8): cs_pad/nc (the block stride) must be
+    # whole, and ch = cs_pad/128 must be a multiple of 8 (the f32 sublane
+    # tile) or Mosaic can reject the chunk kernel's block height when nc
+    # is not a power of two (ADVICE r4).  Pads carry zeros.
+    quantum = LANES * math.lcm(nc, SUBLANES_PAD)
+    cs_pad = -(-max(nc * blk, cs_win, ds_win) // quantum) * quantum
     if nc > 1 and cs_pad > 2 * max(cs_base, ds_win):
         return None  # pathological source/dest correlation
     ch = cs_pad // LANES
@@ -819,6 +841,25 @@ def apply_balanced(x: Array, route: BalancedRoute,
 _ROUTE_CACHE_VERSION = {"aligned": 2, "cumsum": 3}
 
 
+@functools.lru_cache(maxsize=1)
+def _default_route_cache_root() -> str:
+    """Resolve the default cache root ONCE per process: back-compat
+    honors an existing CWD cache (pre-round-5 default, and how this
+    host's pre-built production routes are stored); otherwise route
+    files stay out of the working directory (ADVICE r4) under the
+    conventional user cache root.  Memoized so a mid-process chdir
+    cannot flip the location and split the cache across two roots
+    (the PHOTON_ROUTE_CACHE env override is still read per call)."""
+    import os
+
+    legacy = os.path.abspath(".photon_route_cache")
+    if os.path.isdir(legacy):
+        return legacy
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "photon_tpu", "routes"
+    )
+
+
 def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
                       has_vals: bool):
     """Disk-cache path for a routed exchange, or None when disabled.
@@ -837,7 +878,9 @@ def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
     import hashlib
     import os
 
-    root = os.environ.get("PHOTON_ROUTE_CACHE", ".photon_route_cache")
+    root = os.environ.get("PHOTON_ROUTE_CACHE")
+    if root is None:
+        root = _default_route_cache_root()
     if root == "0":
         return None
     h = hashlib.sha256()
@@ -934,7 +977,36 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
             logging.getLogger("photon_tpu.vperm").warning(
                 "route cache read failed (%s); rebuilding", exc
             )
+        if (
+            aux is not None
+            and isinstance(aux.route, BalancedRoute)
+            and aux.route.ch % math.lcm(aux.route.nc, SUBLANES_PAD)
+        ):
+            # Pre-round-5 caches could hold a chunk height indivisible
+            # by the f32 sublane tile (the ADVICE-r4 Mosaic-rejection
+            # geometry); rebuild rather than version-bump so valid
+            # cached routes (nc a multiple of 8 — all production
+            # shapes) survive.
+            logging.getLogger("photon_tpu.vperm").warning(
+                "cached route has a stale chunk geometry (ch=%d, nc=%d);"
+                " rebuilding", aux.route.ch, aux.route.nc,
+            )
+            aux = None
     if aux is None:
+        # Announce BEFORE the build, from the one place every caller
+        # (auto-probe, production attach, tests) funnels through and
+        # only on a real cache miss: the edge-coloring/factoring below
+        # is tens of host-seconds at production size, and an
+        # unexplained first-step stall was the ADVICE-r4 complaint.
+        # WARNING level — on an unconfigured root logger INFO is
+        # dropped by logging's lastResort handler.
+        logging.getLogger("photon_tpu.vperm").warning(
+            "building the xchg exchange route for %d entries (mode=%s) "
+            "— one-time host work, disk-cached for reuse%s",
+            ids.size, mode,
+            "" if path is not None else
+            " (caching DISABLED via PHOTON_ROUTE_CACHE=0)",
+        )
         if mode == "cumsum":
             # The coloring-free balanced exchange when the data permits
             # it (any stream whose sorted order mixes source positions);
@@ -975,17 +1047,39 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
         aux.bounds is not None or isinstance(aux.route, BalancedRoute)
     ):
         interp = jax.default_backend() != "tpu"
-        flat = jnp.asarray(
-            np.asarray(vals, np.float32).reshape(-1)
-        )
+        flat_np = np.asarray(vals, np.float32).reshape(-1)
+        flat = jnp.asarray(flat_np)
         if isinstance(aux.route, BalancedRoute):
             vd = apply_balanced(flat, aux.route, interpret=interp)
         else:
             vd = apply_vperm(flat, aux.route, interpret=interp)
         if os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16":
             vd = vd.astype(jnp.bfloat16)
-        aux = dataclasses.replace(aux, vals_dest=vd)
+        fp = np.ascontiguousarray(
+            flat_np[::_vals_fp_stride(flat_np.size)], np.float32
+        )
+        aux = dataclasses.replace(aux, vals_dest=vd, vals_fp=fp)
     return aux
+
+
+def _vals_fp_stride(size: int) -> int:
+    """Stride that spreads ``_VALS_FP_SAMPLES`` samples over ``size``.
+    Ceil division: with floor, sizes in (cap, ~3*cap) would stride 1-2
+    and a cap-truncated sample would cover only a prefix, leaving a
+    tail re-weighting invisible to the guard."""
+    return max(1, -(-size // _VALS_FP_SAMPLES))
+
+
+def _trace_state_clean() -> bool:
+    """True when no trace is active (fully eager).  Private-API probe,
+    permissive on failure in the SKIP direction (guard disabled, never
+    a spurious error)."""
+    try:
+        from jax._src import core as _core
+
+        return bool(_core.trace_state_clean())
+    except Exception:  # noqa: BLE001
+        return False
 
 
 def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
@@ -1010,6 +1104,41 @@ def xchg_segment_grad(per_row: Array, vals_rowmajor: Array, al,
 
     if isinstance(aux, VpermRoute):  # back-compat: bare aligned route
         aux = XchgAux(route=aux)
+    if (
+        aux.vals_dest is not None
+        and aux.vals_fp is not None
+        and vals_rowmajor is not None
+        and not isinstance(vals_rowmajor, jax.core.Tracer)
+        and not isinstance(aux.vals_fp, jax.core.Tracer)
+        and _trace_state_clean()
+    ):
+        # Fully-eager calls can be checked against the baked stream's
+        # fingerprint; traced production calls read the batch's static
+        # vals by construction.  The trace-state check matters beyond
+        # the isinstance ones: under omnistaging, ops on CONCRETE
+        # closed-over arrays still stage inside an enclosing trace
+        # (e.g. the optimizer's while_loop body), so the slicing below
+        # is only safe in a clean eval state.  The strided sample
+        # bounds the host transfer to O(1) while covering the whole
+        # stream, and comparing it ELEMENTWISE catches swaps and
+        # off-grid-adjacent edits a collapsed norm would miss; loose
+        # rtol because batch_astype may have re-stored vals in bf16
+        # after the attach (bf16 requantization is ~2^-9 relative per
+        # element).
+        flat = jnp.ravel(vals_rowmajor)
+        sample_np = np.asarray(
+            flat[::_vals_fp_stride(int(flat.shape[0]))], np.float32
+        )
+        ref = np.asarray(aux.vals_fp, np.float32)
+        if sample_np.shape != ref.shape or not np.allclose(
+            sample_np, ref, rtol=1e-2, atol=1e-6
+        ):
+            raise ValueError(
+                "xchg aux has values BAKED at attach time (vals_dest), but "
+                "the vals_rowmajor passed here differs from what the attach "
+                "saw; re-attach the aux (build_xchg_aux(..., vals=...)) "
+                "after re-weighting values"
+            )
     bf16 = os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16"
     balanced = isinstance(aux.route, BalancedRoute)
     if (balanced and aux.route.k_expand and aux.vals_dest is not None

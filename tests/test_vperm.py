@@ -350,3 +350,65 @@ def test_xchg_segment_grad_matches_oracle():
               (per_row[:, None] * vals).reshape(-1).astype(np.float64))
     np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-5,
                                atol=2e-4)
+
+
+def test_balanced_nc3_chunk_height_sublane_aligned(monkeypatch):
+    """Non-power-of-two NC (e.g. 3) must still yield a chunk height that
+    is a multiple of 8*nc: Mosaic's f32 sublane tile is 8, and a block
+    height indivisible by it can be rejected at compile on TPU even
+    though interpret mode accepts it (ADVICE r4)."""
+    from photon_tpu.ops.vperm import BalancedRoute, build_xchg_aux
+
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    rng = np.random.default_rng(21)
+    k, dim = 32, 4096
+    n = (3 * CS) // k - 7  # needs 3 chunks -> nc == 3
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    aux = build_xchg_aux(None, ids, dim, vals=vals)
+    assert isinstance(aux.route, BalancedRoute)
+    assert aux.route.nc == 3
+    assert aux.route.ch % (8 * aux.route.nc) == 0
+    # The routed exchange must still reproduce the oracle at the padded
+    # geometry.
+    from photon_tpu.ops.vperm import xchg_segment_grad
+
+    per_row = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(xchg_segment_grad(
+        jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+        None, aux, dim, interpret=INTERP,
+    ))
+    want = np.zeros(dim, np.float64)
+    np.add.at(want, ids.reshape(-1),
+              (per_row[:, None] * vals).reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-4,
+                               atol=5e-3)
+
+
+def test_baked_vals_guard_rejects_stale_stream(monkeypatch):
+    """When the attach baked vals_dest, an eager call passing DIFFERENT
+    values must raise instead of silently using the stale baked stream
+    (ADVICE r4)."""
+    from photon_tpu.ops.vperm import build_xchg_aux, xchg_segment_grad
+
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    rng = np.random.default_rng(22)
+    n, k, dim = 2048, 8, 512
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    aux = build_xchg_aux(None, ids, dim, vals=vals)
+    assert aux.vals_dest is not None and aux.vals_fp is not None
+    per_row = rng.standard_normal(n).astype(np.float32)
+    # Same values: fine.
+    xchg_segment_grad(
+        jax.numpy.asarray(per_row), jax.numpy.asarray(vals),
+        None, aux, dim, interpret=INTERP,
+    )
+    # Re-weighted values: rejected.
+    with pytest.raises(ValueError, match="BAKED"):
+        xchg_segment_grad(
+            jax.numpy.asarray(per_row), jax.numpy.asarray(3.0 * vals),
+            None, aux, dim, interpret=INTERP,
+        )
