@@ -140,7 +140,101 @@ def _build_batch(n: int, k: int, d: int, seed: int = 0):
     ), aligned_dim=d if aligned_layout_wanted(n * k) else None)
 
 
+_BANKED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "TPU_BANKED.json")
+_HEADLINE_METRIC = "glm_grad_steps_per_sec"
+# The round-over-round comparison shape (BASELINE.md row 1); seeds
+# canonical_shape if the bank ever has to start from scratch.
+_CANONICAL_SHAPE = {"rows": 1 << 20, "nnz_per_row": 32, "dim": 1 << 18}
+
+
+def _is_tpu_platform(p) -> bool:
+    """One predicate for BOTH the live and the baseline side: the
+    tunneled chip reports platform \"axon\", recorded baselines say
+    \"tpu-v5e-1chip\" — asymmetric checks here once meant a genuine
+    like-for-like axon comparison got suppressed as cross-platform."""
+    s = str(p or "")
+    return "tpu" in s or s == "axon"
+
+
+def _load_banked() -> dict | None:
+    """The most recent banked TPU hardware table (TPU_BANKED.json), or
+    None.  This is how a BENCH_r0N.json captured during a tunnel outage
+    still carries the operative hardware truth (VERDICT r4 item 4)."""
+    try:
+        with open(_BANKED_PATH) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — absent/corrupt bank = no embed
+        return None
+
+
+def _bank_tpu_result(value: float, detail: dict) -> None:
+    """Write-through bank: a headline run that completed on a LIVE TPU
+    backend records itself into TPU_BANKED.json (atomic replace), so the
+    next outage-window bench emission automatically embeds the newest
+    hardware truth.  The headline slot tracks the best steps/s at the
+    canonical shape in the production configuration (f32, uniform,
+    per-step dispatch — the configuration the round-over-round number
+    is defined on)."""
+    bank = _load_banked()
+    if bank is None:
+        if os.path.exists(_BANKED_PATH):
+            # An existing-but-unreadable bank is hand-curated data: never
+            # clobber it from here — skip banking and say so.
+            print(
+                f"WARNING: {_BANKED_PATH} exists but is unreadable; "
+                "skipping the TPU result bank update to preserve it",
+                file=sys.stderr,
+            )
+            return
+        bank = {"entries": {}, "canonical_shape": dict(_CANONICAL_SHAPE)}
+    kernel = str(detail.get("kernel", "auto"))
+    if kernel.startswith("auto:"):
+        kernel = kernel.split(":", 1)[1]
+    key = "|".join([
+        kernel, str(detail.get("dtype")), str(detail.get("skew")),
+        str(detail.get("dispatch")),
+    ])
+    if detail.get("xchg_reduce"):
+        key += "|" + str(detail["xchg_reduce"])
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    entry = {
+        "value": round(value, 3), "unit": "steps/s", "kernel": kernel,
+        "rows": detail.get("rows"), "nnz_per_row": detail.get("nnz_per_row"),
+        "dim": detail.get("dim"), "dtype": detail.get("dtype"),
+        "skew": detail.get("skew"), "dispatch": detail.get("dispatch"),
+        "measured_utc": stamp, "window": "banked live by bench.py",
+    }
+    if detail.get("xchg_reduce"):
+        entry["xchg_reduce"] = detail["xchg_reduce"]
+    bank.setdefault("entries", {})[key] = entry
+    bank["updated"] = stamp
+    shape = bank.get("canonical_shape") or dict(_CANONICAL_SHAPE)
+    head = bank.get("headline") or {}
+    at_canonical = (
+        detail.get("rows") == shape.get("rows")
+        and detail.get("nnz_per_row") == shape.get("nnz_per_row")
+        and detail.get("dim") == shape.get("dim")
+        and detail.get("dtype") == "float32"
+        and detail.get("skew") == "uniform"
+        and detail.get("dispatch") == "per-step"
+    )
+    if at_canonical and value > float(head.get("value") or 0.0):
+        bank["headline"] = {
+            "metric": _HEADLINE_METRIC, "platform": "tpu", **entry,
+        }
+    try:
+        tmp = _BANKED_PATH + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(bank, f, indent=2)
+        os.replace(tmp, _BANKED_PATH)
+    except Exception:  # noqa: BLE001 — banking is best-effort
+        pass
+
+
 def _emit(metric: str, value: float, unit: str, detail: dict) -> None:
+    live_platform = detail.get("platform") or _PLATFORM_INFO["platform"]
+    on_tpu = _is_tpu_platform(live_platform)
     vs_baseline = 1.0
     base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     if os.path.exists(base_path):
@@ -148,29 +242,42 @@ def _emit(metric: str, value: float, unit: str, detail: dict) -> None:
             with open(base_path) as f:
                 prior = json.load(f)
             if prior.get("metric") == metric and prior.get("value"):
-                vs_baseline = value / float(prior["value"])
-                # A CPU-fallback run uses smaller shapes than the TPU
-                # baseline; raw steps/s ratios would be apples-to-oranges
-                # there, so compare on sparse-entry throughput (nnz/sec —
-                # rows alone would still bias by the differing nnz_per_row)
-                # and say so in the detail.
-                here = (detail.get("rows"), detail.get("nnz_per_row"))
-                prior_shape = (prior.get("rows"), prior.get("nnz_per_row"))
-                if (
-                    None not in here
-                    and None not in prior_shape
-                    and here != prior_shape
-                    and detail.get("rows_per_sec")
-                    and prior.get("rows_per_sec")
-                ):
-                    vs_baseline = (
-                        float(detail["rows_per_sec"]) * here[1]
-                    ) / (float(prior["rows_per_sec"]) * prior_shape[1])
+                # Same-platform comparisons only (VERDICT r4 weak 1): a
+                # CPU-fallback number against the TPU baseline is
+                # apples-to-oranges however it is normalized, and the
+                # headline field must never read as progress when the
+                # hardware was unreachable.
+                prior_tpu = _is_tpu_platform(prior.get("platform"))
+                if prior_tpu != on_tpu:
+                    vs_baseline = None
                     detail["vs_baseline_basis"] = (
-                        f"nnz_per_sec (shapes differ: {here[0]}x{here[1]} "
-                        f"here vs {prior_shape[0]}x{prior_shape[1]} in "
-                        f"baseline)"
+                        f"null: live platform is {live_platform!r} but the "
+                        f"baseline is {prior.get('platform')!r} — "
+                        "cross-platform ratios are suppressed; see "
+                        "detail.last_tpu for the operative hardware numbers"
                     )
+                else:
+                    vs_baseline = value / float(prior["value"])
+                    # Shapes can still differ within a platform; compare on
+                    # sparse-entry throughput (nnz/sec — rows alone would
+                    # bias by the differing nnz_per_row) and say so.
+                    here = (detail.get("rows"), detail.get("nnz_per_row"))
+                    prior_shape = (prior.get("rows"), prior.get("nnz_per_row"))
+                    if (
+                        None not in here
+                        and None not in prior_shape
+                        and here != prior_shape
+                        and detail.get("rows_per_sec")
+                        and prior.get("rows_per_sec")
+                    ):
+                        vs_baseline = (
+                            float(detail["rows_per_sec"]) * here[1]
+                        ) / (float(prior["rows_per_sec"]) * prior_shape[1])
+                        detail["vs_baseline_basis"] = (
+                            f"nnz_per_sec (shapes differ: {here[0]}x{here[1]} "
+                            f"here vs {prior_shape[0]}x{prior_shape[1]} in "
+                            f"baseline)"
+                        )
         except Exception:  # noqa: BLE001 — a corrupt baseline must not kill the bench
             pass
     if _PLATFORM_INFO["platform"] is not None:
@@ -181,11 +288,21 @@ def _emit(metric: str, value: float, unit: str, detail: dict) -> None:
             detail.setdefault("platform", _PLATFORM_INFO["platform"])
         if _PLATFORM_INFO["tpu_error"]:
             detail["tpu_error"] = _PLATFORM_INFO["tpu_error"]
+    if metric in (_HEADLINE_METRIC, "bench_error"):
+        if on_tpu and metric == _HEADLINE_METRIC:
+            _bank_tpu_result(value, detail)
+        elif not on_tpu:
+            banked = _load_banked()
+            if banked is not None:
+                # The record of the round must carry the hardware truth
+                # even when the tunnel is down at capture time: embed the
+                # banked TPU table (values + timestamps + provenance).
+                detail["last_tpu"] = banked
     print(json.dumps({
         "metric": metric,
         "value": round(value, 3),
         "unit": unit,
-        "vs_baseline": round(vs_baseline, 3),
+        "vs_baseline": None if vs_baseline is None else round(vs_baseline, 3),
         "detail": detail,
     }))
 
@@ -796,13 +913,15 @@ def main() -> None:
         "dim": d,
         "dtype": bench_dtype,
         "kernel": kernel,
+        **({"xchg_reduce": os.environ.get("PHOTON_XCHG_REDUCE", "aligned")}
+           if "xchg" in kernel else {}),
         "dispatch": "fused" if fused else "per-step",
         "skew": os.environ.get("PHOTON_BENCH_SKEW", "uniform"),
         "platform": platform,
         "rows_per_sec": round(steps_per_sec * n, 1),
         "effective_gb_per_sec": round(eff_gb_s, 2),
         "pct_hbm_roofline": round(100.0 * eff_gb_s / hbm_gb_s, 2)
-        if platform == "tpu" else None,
+        if _is_tpu_platform(platform) else None,
     })
 
 

@@ -240,9 +240,16 @@ def attach_feature_major(
     With ``aligned_dim`` (the coefficient dimension) the slab-aligned layout
     for the Pallas gradient kernel is ALSO built and attached (``batch.al``),
     making the batch eligible for the third kernel of
-    ops/sparse_grad_select.  Single-block (``shards == 1``) only: the
-    aligned layout stores global rows, so a sharded batch would need one per
-    shard block.
+    ops/sparse_grad_select.  With ``shards > 1`` every row block gets its
+    OWN layout (block-local rows) and the per-block layouts are padded to
+    a common geometry and stacked on a leading shard axis, so sharding
+    the batch on that axis hands each device exactly its block's layout
+    (VERDICT r5 item 2 — the fast kernels must run under the sharded
+    objective; squeeze + dispatch happen in parallel/distributed.py).
+    The same applies to the xchg exchange routes: every shard's route is
+    built with the SHARED balanced-block geometry (max census across
+    shards) or all shards fall back to the colored route together, so
+    the stacked route pytree has one uniform treedef.
 
     ``aligned_forward`` additionally builds the transposed (row-dictionary)
     layout so the Pallas path computes MARGINS through the same kernel
@@ -275,8 +282,6 @@ def attach_feature_major(
             "gradient layout too)"
         )
     if aligned_dim is not None:
-        if shards != 1:
-            raise ValueError("aligned layout requires shards == 1")
         from photon_tpu.ops.pallas_gather import (
             build_aligned_layout,
             build_row_aligned_layout,
@@ -287,8 +292,6 @@ def attach_feature_major(
 
         ids_np = np.asarray(batch.ids)
         vals_np = np.asarray(batch.vals, np.float32)
-        layout = build_aligned_layout(ids_np, vals_np, aligned_dim)
-        batch = batch._replace(al=device_layout(layout))
         want_xchg = xchg_route_wanted(n * k)
         if aligned_forward is None:
             # xchg implies the pallas forward: its whole point is deleting
@@ -296,6 +299,20 @@ def attach_feature_major(
             aligned_forward = want_xchg or (
                 os.environ.get("PHOTON_SPARSE_MARGIN", "xla") == "pallas"
             )
+        if shards != 1:
+            if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "benes":
+                # Before the expensive per-shard build: rejecting after it
+                # would waste the costliest host work in the package.
+                raise ValueError(
+                    "the benes research kernel is single-shard only"
+                )
+            return _attach_aligned_sharded(
+                batch, ids_np, vals_np, aligned_dim, shards,
+                aligned_forward=bool(aligned_forward),
+                want_xchg=want_xchg, order=order,
+            )
+        layout = build_aligned_layout(ids_np, vals_np, aligned_dim)
+        batch = batch._replace(al=device_layout(layout))
         if aligned_forward:
             batch = batch._replace(
                 al_t=device_layout(build_row_aligned_layout(ids_np, vals_np))
@@ -321,6 +338,99 @@ def attach_feature_major(
                 benes=build_benes_aux(layout, n, k)
             )
     return batch
+
+
+def _attach_aligned_sharded(
+    batch: SparseBatch,
+    ids_np: np.ndarray,
+    vals_np: np.ndarray,
+    aligned_dim: int,
+    shards: int,
+    aligned_forward: bool,
+    want_xchg: bool,
+    order: np.ndarray,
+) -> SparseBatch:
+    """Per-shard aligned layouts (+ optional transposed layouts and xchg
+    routes), padded to common geometry and stacked on a leading shard
+    axis (VERDICT r5 item 2).
+
+    Every shard's arrays must stack into ONE pytree with ONE treedef, so:
+
+    - aligned layouts pad to the max (slabs, tiles) across shards
+      (ops/pallas_gather.stack_device_layouts);
+    - xchg balanced routes are built with the SHARED max block census
+      (``blk_override``), or — when any shard's data defeats the
+      balanced form — every shard takes the colored route together
+      (``force_colored``); route meta is asserted uniform before
+      stacking, and on any mismatch the xchg aux is dropped (the batch
+      still carries fm + aligned, so training routes to the next-best
+      kernel instead of failing).
+    """
+    import logging
+
+    from photon_tpu.ops.pallas_gather import (
+        build_aligned_layout,
+        build_row_aligned_layout,
+        common_layout_geometry,
+        pad_aligned_layout,
+        stack_device_layouts,
+    )
+
+    n, k = ids_np.shape
+    ns = n // shards
+    ids_blocks = ids_np.reshape(shards, ns, k)
+    vals_blocks = vals_np.reshape(shards, ns, k)
+    layouts = [
+        build_aligned_layout(ids_blocks[s], vals_blocks[s], aligned_dim)
+        for s in range(shards)
+    ]
+    # Pad FIRST, then build routes against the padded layouts: the
+    # aligned-mode exchange's destination is the slot stream, whose
+    # length must be uniform across shards for the routes to stack.
+    s_tgt, t_tgt = common_layout_geometry(layouts)
+    layouts = [pad_aligned_layout(l, s_tgt, t_tgt) for l in layouts]
+    batch = batch._replace(al=stack_device_layouts(layouts))
+    if aligned_forward:
+        batch = batch._replace(al_t=stack_device_layouts([
+            build_row_aligned_layout(ids_blocks[s], vals_blocks[s])
+            for s in range(shards)
+        ]))
+    if not want_xchg:
+        return batch
+    import jax
+    import os
+
+    from photon_tpu.ops.vperm import balanced_blk_census, build_xchg_aux
+
+    mode = os.environ.get("PHOTON_XCHG_REDUCE", "aligned")
+    e_s = ns * k
+    censuses = []
+    for s in range(shards):
+        if mode == "cumsum":
+            dest_src = order[s]
+        else:
+            dest_src = layouts[s].src.reshape(-1)
+        censuses.append(balanced_blk_census(dest_src, e_s, k))
+    force_colored = any(c is None for c in censuses)
+    blk_override = None if force_colored else max(censuses)
+    auxes = [
+        build_xchg_aux(
+            layouts[s], ids_blocks[s], aligned_dim, order=order[s],
+            vals=vals_blocks[s], blk_override=blk_override,
+            force_colored=force_colored,
+        )
+        for s in range(shards)
+    ]
+    defs = {jax.tree.structure(a) for a in auxes}
+    if len(defs) != 1:
+        logging.getLogger("photon_tpu.batch").warning(
+            "per-shard xchg routes came out with mismatched geometry "
+            "(%d distinct treedefs); dropping the xchg aux — training "
+            "will route to the pallas/fm kernels instead", len(defs),
+        )
+        return batch
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *auxes)
+    return batch._replace(xchg=stacked)
 
 
 def batch_astype(batch: Batch, dtype) -> Batch:

@@ -329,7 +329,11 @@ def run(args: argparse.Namespace) -> dict:
     mesh = common.maybe_mesh()
     if mesh is not None:
         logger.info("mesh: %d devices on axis 'data'", mesh.devices.size)
-        batch = shard_batch(batch, mesh)  # attaches the feature-major layout
+        # Attaches the per-shard feature-major layout — and the per-shard
+        # aligned/xchg layouts when the kernel selector could route to
+        # them (gated inside shard_batch), so the fast kernels run under
+        # the sharded objective too.
+        batch = shard_batch(batch, mesh, aligned_dim=dim)
     else:
         from photon_tpu.data.batch import SparseBatch, attach_feature_major
         from photon_tpu.ops.sparse_grad_select import aligned_layout_wanted

@@ -236,7 +236,13 @@ class FixedEffectDeviceData:
         self.batch = shard_to_batch(shard, label, offset, weight)
         self.unpadded_n = self.batch.num_examples
         if mesh is not None:
-            self.batch = shard_batch(self.batch, mesh, build_fm=build_fm)
+            # Same Pallas/xchg-kernel eligibility as single-device: the
+            # per-shard aligned layouts + routes are built when the
+            # selector could route to them (gated inside shard_batch —
+            # VERDICT r5 item 2).
+            self.batch = shard_batch(
+                self.batch, mesh, build_fm=build_fm, aligned_dim=self.dim
+            )
         elif build_fm and isinstance(self.batch, SparseBatch):
             from photon_tpu.data.batch import attach_feature_major
             from photon_tpu.ops.sparse_grad_select import aligned_layout_wanted

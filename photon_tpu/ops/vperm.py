@@ -544,18 +544,17 @@ def _complete_chunk_local(dest_src: np.ndarray, nc: int,
     return out
 
 
-def _build_balanced_core(dest_src: np.ndarray, n_src_stream: int, k: int):
-    """Factor an exchange into the balanced form, for ANY destination
-    stream that tolerates zero pads between real entries.
-
-    ``dest_src[d]`` = source rm index feeding destination ``d`` (< 0
-    for pad destinations; each source index appears at most once).
-    ``n_src_stream`` is the FULL row-major stream length (n*k) — source
-    windows partition the whole stream, since rm indices of real
-    entries range over all of it.  Returns a :class:`BalancedRoute` or
-    None when the data defeats the balance assumption / geometry limits
-    (caller falls back to the colored route).
-    """
+def _balanced_windows(dest_src: np.ndarray, n_src_stream: int, k: int):
+    """Window partition + per-(src, dest)-window block census of the
+    balanced exchange: ``(nc, cs_win, ds_win, k_expand, d_real, src_of,
+    src_win, dest_win, blk)`` or None when the streams exceed geometry
+    limits.  Split out of :func:`_build_balanced_core` so a SHARDED
+    attach can census every shard's natural ``blk`` first and rebuild
+    all shards with the shared maximum (uniform route geometry is what
+    lets per-shard routes stack into one shard_map pytree).  Everything
+    here except ``blk`` (and the data-dependent index arrays) is a
+    function of (n_src_stream, n_dest, k) alone — identical across
+    equal-shaped shards by construction."""
     n_dest = dest_src.size
     d_real = np.flatnonzero(dest_src >= 0)
     src_of = dest_src[d_real]
@@ -590,6 +589,38 @@ def _build_balanced_core(dest_src: np.ndarray, n_src_stream: int, k: int):
         src_win * nc + dest_win, minlength=nc * nc
     ).reshape(nc, nc)
     blk = int(counts.max())
+    return nc, cs_win, ds_win, k_expand, d_real, src_of, src_win, dest_win, blk
+
+
+def _build_balanced_core(dest_src: np.ndarray, n_src_stream: int, k: int,
+                         blk_override: int | None = None):
+    """Factor an exchange into the balanced form, for ANY destination
+    stream that tolerates zero pads between real entries.
+
+    ``dest_src[d]`` = source rm index feeding destination ``d`` (< 0
+    for pad destinations; each source index appears at most once).
+    ``n_src_stream`` is the FULL row-major stream length (n*k) — source
+    windows partition the whole stream, since rm indices of real
+    entries range over all of it.  ``blk_override`` forces a (>= natural)
+    block capacity so equal-shaped shards share one geometry.  Returns a
+    :class:`BalancedRoute` or None when the data defeats the balance
+    assumption / geometry limits (caller falls back to the colored
+    route).
+    """
+    n_dest = dest_src.size
+    win = _balanced_windows(dest_src, n_src_stream, k)
+    if win is None:
+        return None
+    nc, cs_win, ds_win, k_expand, d_real, src_of, src_win, dest_win, blk = win
+    e = d_real.size
+    cs_base = -(-n_src_stream // nc)
+    if blk_override is not None:
+        if blk_override < blk:
+            raise ValueError(
+                f"blk_override {blk_override} < this shard's natural "
+                f"block census {blk}"
+            )
+        blk = blk_override
     # Quantum LANES * lcm(nc, 8): cs_pad/nc (the block stride) must be
     # whole, and ch = cs_pad/128 must be a multiple of 8 (the f32 sublane
     # tile) or Mosaic can reject the chunk kernel's block height when nc
@@ -662,7 +693,8 @@ def _build_balanced_core(dest_src: np.ndarray, n_src_stream: int, k: int):
 
 
 def build_balanced_sorted_route(
-    ids: np.ndarray, dim: int, order: np.ndarray | None = None
+    ids: np.ndarray, dim: int, order: np.ndarray | None = None,
+    blk_override: int | None = None,
 ):
     """(BalancedRoute, bounds) for the rm → feature-sorted exchange, or
     None when the data defeats the balance assumption."""
@@ -673,7 +705,7 @@ def build_balanced_sorted_route(
         order = np.argsort(flat, kind="stable")
     else:
         order = np.ascontiguousarray(order, dtype=np.int64)
-    route = _build_balanced_core(order, e, k)
+    route = _build_balanced_core(order, e, k, blk_override=blk_override)
     if route is None:
         return None
     bounds_rank = np.searchsorted(
@@ -684,7 +716,8 @@ def build_balanced_sorted_route(
     return route, jnp.asarray(bounds.astype(np.int32))
 
 
-def build_balanced_aligned_route(layout, ids: np.ndarray):
+def build_balanced_aligned_route(layout, ids: np.ndarray,
+                                 blk_override: int | None = None):
     """BalancedRoute for the rm → aligned-slot exchange (same balanced
     construction; the destination is the slab slot stream, whose pads
     carry zeros automatically because chunk-local completion pairs them
@@ -695,7 +728,8 @@ def build_balanced_aligned_route(layout, ids: np.ndarray):
     slots_src = np.ascontiguousarray(
         layout.src.reshape(-1), dtype=np.int64
     )
-    return _build_balanced_core(slots_src, int(ids.size), k)
+    return _build_balanced_core(slots_src, int(ids.size), k,
+                                blk_override=blk_override)
 
 
 def _chunk_expand_kernel(dz_ref, i1_ref, i2_ref, i3_ref, o_ref):
@@ -861,7 +895,8 @@ def _default_route_cache_root() -> str:
 
 
 def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
-                      has_vals: bool):
+                      has_vals: bool, blk_override: int | None = None,
+                      force_colored: bool = False):
     """Disk-cache path for a routed exchange, or None when disabled.
 
     Routes are pure functions of their inputs and cost tens of host-
@@ -892,6 +927,13 @@ def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
     # vals-carrying keys stay in the canonical (unsuffixed) namespace so
     # the expensive production entries survive this key extension.
     suffix = "" if has_vals else "|novals"
+    # Sharded-attach geometry levers change the route CONTENT for the
+    # same ids, so they must enter the key; single-shard builds stay in
+    # the canonical namespace.
+    if blk_override is not None:
+        suffix += f"|blk{blk_override}"
+    if force_colored:
+        suffix += "|colored"
     h.update(f"|{dim}|{mode}|v{ver}{suffix}".encode())
     return os.path.join(root, h.hexdigest()[:32] + ".npz")
 
@@ -949,9 +991,24 @@ def _aux_from_npz(z) -> XchgAux:
     return XchgAux(route=route, bounds=bounds)
 
 
+def balanced_blk_census(dest_src: np.ndarray, n_src_stream: int,
+                        k: int) -> int | None:
+    """This shard's natural per-(src, dest)-window block census, or None
+    when its streams exceed the balanced geometry limits.  A sharded
+    attach runs this over every shard and rebuilds all of them with the
+    shared maximum (``build_xchg_aux(blk_override=...)``) so the routes
+    stack into one uniform-geometry pytree."""
+    win = _balanced_windows(
+        np.ascontiguousarray(dest_src, dtype=np.int64), n_src_stream, k
+    )
+    return None if win is None else win[-1]
+
+
 def build_xchg_aux(layout, ids: np.ndarray, dim: int,
                    order: np.ndarray | None = None,
-                   vals: np.ndarray | None = None) -> XchgAux:
+                   vals: np.ndarray | None = None,
+                   blk_override: int | None = None,
+                   force_colored: bool = False) -> XchgAux:
     """The attach/probe entry point: build the exchange aux for the
     reduce strategy selected by PHOTON_XCHG_REDUCE (aligned | cumsum).
     One builder so the auto-selection probe measures exactly the
@@ -959,14 +1016,20 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
     hash (PHOTON_ROUTE_CACHE dir, "0" disables).  With ``vals``, the
     cumsum aux also carries the statically pre-permuted value stream
     (``vals_dest`` — one device pass at attach, never cached: the
-    route itself is vals-independent)."""
+    route itself is vals-independent).
+
+    ``blk_override`` / ``force_colored`` are the sharded-attach levers
+    (see :func:`balanced_blk_census`): every shard of one batch must
+    come out with the same route KIND and geometry meta, or the stacked
+    aux pytree would have mismatched treedefs."""
     import logging
     import os
 
     n, k = ids.shape
     mode = os.environ.get("PHOTON_XCHG_REDUCE", "aligned")
     path = _route_cache_path(
-        np.asarray(ids), dim, mode, layout, vals is not None
+        np.asarray(ids), dim, mode, layout, vals is not None,
+        blk_override=blk_override, force_colored=force_colored,
     )
     aux = None
     if path is not None and os.path.exists(path):
@@ -1011,7 +1074,9 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
             # The coloring-free balanced exchange when the data permits
             # it (any stream whose sorted order mixes source positions);
             # otherwise the general colored route.
-            built = build_balanced_sorted_route(np.asarray(ids), dim, order)
+            built = None if force_colored else build_balanced_sorted_route(
+                np.asarray(ids), dim, order, blk_override=blk_override
+            )
             if built is not None:
                 route, bounds = built
                 aux = XchgAux(route=route, bounds=bounds)
@@ -1025,8 +1090,10 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
             # needs vals for the destination multiply; otherwise the
             # general colored route.
             built = (
-                build_balanced_aligned_route(layout, np.asarray(ids))
-                if vals is not None else None
+                build_balanced_aligned_route(
+                    layout, np.asarray(ids), blk_override=blk_override
+                )
+                if vals is not None and not force_colored else None
             )
             if built is not None:
                 aux = XchgAux(route=built)

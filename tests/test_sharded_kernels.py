@@ -1,0 +1,198 @@
+"""Sharded fast-kernel equivalence (VERDICT r5 item 2): the pallas and
+xchg gradient kernels must produce the SAME numbers under the sharded
+objective (8-virtual-device mesh, per-shard layouts + psum) as plain
+single-device autodiff.
+
+This is the reference's distributed-vs-local cross-check (SURVEY.md §4)
+applied to the round-4/5 hardware kernels: before this round the fast
+kernels required ``shards == 1`` and silently fell back on any mesh, so
+no kernel win could reach the multi-chip north star.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.core.objective import GlmObjective, RegularizationContext
+from photon_tpu.data.batch import SparseBatch, attach_feature_major
+from photon_tpu.parallel import DistributedGlmObjective, create_mesh, shard_batch
+
+N, K, D = 160, 5, 64  # N not a multiple of 8 after padding? 160 = 8*20
+
+
+def _batch(seed=0, n=N):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, D, size=(n, K)).astype(np.int32)
+    vals = rng.standard_normal((n, K)).astype(np.float32)
+    vals[rng.random((n, K)) < 0.1] = 0.0
+    label = (rng.random(n) < 0.5).astype(np.float32)
+    offset = (rng.standard_normal(n) * 0.1).astype(np.float32)
+    weight = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    return SparseBatch(
+        ids=jnp.asarray(ids), vals=jnp.asarray(vals),
+        label=jnp.asarray(label), offset=jnp.asarray(offset),
+        weight=jnp.asarray(weight),
+    )
+
+
+def _autodiff_reference(obj, w, batch, monkeypatch):
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "autodiff")
+    v, g = obj.value_and_grad(w, batch)
+    return np.asarray(v), np.asarray(g)
+
+
+def _check_sharded(monkeypatch, kernel, reduce_mode=None, loss="logistic",
+                   reg=None, n=N, check_hv=True):
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    if reduce_mode is not None:
+        monkeypatch.setenv("PHOTON_XCHG_REDUCE", reduce_mode)
+    batch = _batch(n=n)
+    obj = GlmObjective.create(
+        loss, reg or RegularizationContext("l2", 0.3)
+    )
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal(D).astype(np.float32) * 0.1)
+    v_ref, g_ref = _autodiff_reference(obj, w, batch, monkeypatch)
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", kernel)
+    mesh = create_mesh()
+    sharded = shard_batch(batch, mesh, aligned_dim=D)
+    assert sharded.al is not None
+    dist = DistributedGlmObjective(obj, mesh)
+    assert dist._sparse_kernel(w, sharded) == kernel
+    v_d, g_d = dist.value_and_grad(w, sharded)
+    np.testing.assert_allclose(v_d, v_ref, rtol=2e-5)
+    scale = max(float(np.abs(g_ref).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(g_d), g_ref, rtol=2e-4, atol=2e-4 * scale
+    )
+    # Hv through the same sharded kernel vs autodiff jvp.
+    if not check_hv:
+        return
+    u = jnp.asarray(rng.standard_normal(D).astype(np.float32))
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "autodiff")
+    hv_ref = np.asarray(obj.hessian_vector(w, u, batch))
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", kernel)
+    hv_d = np.asarray(dist.hessian_vector(w, u, sharded))
+    hs = max(float(np.abs(hv_ref).max()), 1.0)
+    np.testing.assert_allclose(hv_d, hv_ref, rtol=2e-4, atol=2e-4 * hs)
+
+
+def test_sharded_pallas_grad_matches_autodiff(monkeypatch):
+    _check_sharded(monkeypatch, "pallas")
+
+
+def test_sharded_xchg_cumsum_matches_autodiff(monkeypatch):
+    _check_sharded(monkeypatch, "xchg", reduce_mode="cumsum")
+
+
+def test_sharded_xchg_aligned_matches_autodiff(monkeypatch):
+    # Hv covered by the cumsum variant (same exchange machinery); skipped
+    # here to keep the suite under its wall-clock bar.
+    _check_sharded(monkeypatch, "xchg", reduce_mode="aligned",
+                   check_hv=False)
+
+
+def test_sharded_xchg_poisson_unpadded_rows(monkeypatch):
+    """Different loss + a row count that needs zero-weight padding (101
+    rows over 8 shards): the pad rows must contribute exactly nothing
+    through the exchange.  (Hv covered by the logistic cumsum test.)"""
+    _check_sharded(
+        monkeypatch, "xchg", reduce_mode="cumsum", loss="poisson", n=101,
+        check_hv=False,
+    )
+
+
+def test_sharded_pallas_normalized_grad(monkeypatch):
+    """Normalization algebra through the sharded pallas kernel, and the
+    normalized Hv fallback (jvp through the fm layout — pallas_call has
+    no JVP rule)."""
+    from photon_tpu.core.normalization import NormalizationContext
+
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    batch = _batch(seed=7)
+    rng = np.random.default_rng(8)
+    factors = rng.uniform(0.5, 2.0, D).astype(np.float32)
+    shifts = (rng.standard_normal(D) * 0.01).astype(np.float32)
+    norm = NormalizationContext(factors=jnp.asarray(factors),
+                                shifts=jnp.asarray(shifts))
+    obj = GlmObjective.create(
+        "logistic", RegularizationContext("l2", 0.2), normalization=norm
+    )
+    w = jnp.asarray(rng.standard_normal(D).astype(np.float32) * 0.1)
+    v_ref, g_ref = _autodiff_reference(obj, w, batch, monkeypatch)
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    mesh = create_mesh()
+    sharded = shard_batch(batch, mesh, aligned_dim=D)
+    dist = DistributedGlmObjective(obj, mesh)
+    v_d, g_d = dist.value_and_grad(w, sharded)
+    np.testing.assert_allclose(v_d, v_ref, rtol=2e-5)
+    scale = max(float(np.abs(g_ref).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(g_d), g_ref, rtol=2e-4, atol=2e-4 * scale
+    )
+    u = jnp.asarray(rng.standard_normal(D).astype(np.float32))
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "autodiff")
+    hv_ref = np.asarray(obj.hessian_vector(w, u, batch))
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "pallas")
+    hv_d = np.asarray(dist.hessian_vector(w, u, sharded))
+    hs = max(float(np.abs(hv_ref).max()), 1.0)
+    np.testing.assert_allclose(hv_d, hv_ref, rtol=2e-4, atol=2e-4 * hs)
+
+
+def test_sharded_attach_stacks_uniform_geometry(monkeypatch):
+    """The per-shard aux must stack: aligned layouts share one padded
+    geometry; xchg routes share one treedef (shared blk census or a
+    collective colored fallback)."""
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "xchg")
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    # Skewed ids so per-shard block censuses genuinely differ.
+    rng = np.random.default_rng(3)
+    n = 8 * 24
+    ids = (1 + (rng.zipf(1.5, size=(n, K)) - 1) % (D - 1)).astype(np.int32)
+    batch = SparseBatch(
+        ids=jnp.asarray(ids),
+        vals=jnp.asarray(rng.standard_normal((n, K)).astype(np.float32)),
+        label=jnp.asarray((rng.random(n) < 0.5).astype(np.float32)),
+        offset=jnp.zeros(n, jnp.float32),
+        weight=jnp.ones(n, jnp.float32),
+    )
+    out = attach_feature_major(batch, shards=8, aligned_dim=D)
+    assert out.al is not None and out.xchg is not None
+    assert int(out.al.lo.shape[0]) == 8
+    assert int(out.al.dup_map.shape[0]) == 8
+    # One treedef means uniform meta (n_in/n_out/nc/ch/... are static).
+    leaves = jax.tree.leaves(out.xchg)
+    assert all(int(leaf.shape[0]) == 8 for leaf in leaves)
+
+
+def test_sharded_lbfgs_convergence_xchg(monkeypatch):
+    """A full sharded L-BFGS fit with the xchg kernel forced converges to
+    the same optimum as single-device autodiff."""
+    from photon_tpu.core.optimizers import lbfgs
+
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
+    batch = _batch(seed=11)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    w0 = jnp.zeros(D, jnp.float32)
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "autodiff")
+    res_ref = lbfgs(lambda w: obj.value_and_grad(w, batch), w0)
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "xchg")
+    mesh = create_mesh()
+    sharded = shard_batch(batch, mesh, aligned_dim=D)
+    dist = DistributedGlmObjective(obj, mesh)
+    res_d = lbfgs(lambda w: dist.value_and_grad(w, sharded), w0)
+    assert bool(res_d.converged)
+    np.testing.assert_allclose(
+        float(res_d.value), float(res_ref.value), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_d.w), np.asarray(res_ref.w), atol=5e-2
+    )
