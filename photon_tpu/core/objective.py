@@ -210,11 +210,22 @@ class GlmObjective:
         has_fm = batch.fm is not None
         has_al = batch.al is not None
         has_benes = batch.benes is not None and has_al
-        has_xchg = batch.xchg is not None and has_al
-        if not (has_fm or has_al):
+        # The cumsum-reduce xchg variant (bounds set) never touches the
+        # aligned layout at runtime, so a batch can carry the route alone
+        # — the streaming layout cache relies on this (no layout bytes
+        # cached or shipped per chunk).  The aligned-reduce variant still
+        # requires ``al``.
+        has_xchg = batch.xchg is not None and (
+            has_al or getattr(batch.xchg, "bounds", None) is not None
+        )
+        if not (has_fm or has_al or has_xchg):
             return None
         if dim is None:
-            return "fm" if has_fm else "pallas"
+            if has_fm:
+                return "fm"
+            if has_al:
+                return "pallas"
+            return "xchg"  # bounds-only route (streamed cumsum chunks)
         from photon_tpu.ops.sparse_grad_select import select_kernel
 
         n, k = batch.ids.shape
@@ -222,6 +233,13 @@ class GlmObjective:
             n * k, dim, n,
             has_fm=has_fm, has_aligned=has_al, has_benes=has_benes,
             has_xchg=has_xchg,
+            # Whether values were pre-permuted at attach changes the
+            # per-step data movement the probe must time (baked: dz
+            # expansion only; unbaked — streamed chunks: the full product
+            # stream rides the exchange).
+            xchg_baked=(
+                has_xchg and getattr(batch.xchg, "vals_dest", None) is not None
+            ),
         )
         return None if choice == "autodiff" else choice
 

@@ -589,12 +589,21 @@ def reduce_kernel_supported() -> bool:
     backend = jax.default_backend()
     if backend not in _REDUCE_SUPPORTED:
         try:
+            # Probe inputs under ensure_compile_time_eval: the first call
+            # often happens while an enclosing jit is being traced
+            # (kernel selection at trace time), where bare jnp.zeros
+            # would become tracers, the probe would raise, and the except
+            # would cache a spurious "unsupported" for the whole process.
+            # The lower/compile itself stays OUTSIDE the escape hatch
+            # (eval-trace has no rules for pallas primitives).
+            with jax.ensure_compile_time_eval():
+                probe_args = (
+                    jnp.zeros(1, jnp.int32),
+                    jnp.zeros((TILE_SUBLANES, LANES), jnp.float32),
+                    jnp.zeros((TILE_SUBLANES, LANES), jnp.int32),
+                )
             _position_partial_sums.lower(
-                jnp.zeros(1, jnp.int32),
-                jnp.zeros((TILE_SUBLANES, LANES), jnp.float32),
-                jnp.zeros((TILE_SUBLANES, LANES), jnp.int32),
-                n_slabs=1,
-                interpret=False,
+                *probe_args, n_slabs=1, interpret=False
             ).compile()
             _REDUCE_SUPPORTED[backend] = True
         except Exception:  # noqa: BLE001 — any lowering failure means "no"

@@ -67,17 +67,26 @@ def kernel_supported(loss: PointwiseLoss, nnz_capacity: int, dim: int) -> bool:
     key = (loss.name, nnz_capacity, dim)
     if key not in _KERNEL_SUPPORTED:
         try:
-            args = (
-                loss,
-                jnp.zeros(dim, jnp.float32),
-                jnp.zeros((8, nnz_capacity), jnp.int32),
-                jnp.zeros((8, nnz_capacity), jnp.float32),
-                jnp.zeros(8, jnp.float32),
-                jnp.zeros(8, jnp.float32),
-                jnp.ones(8, jnp.float32),
-            )
-            # .lower().compile() exercises the full Mosaic pipeline without
-            # polluting the ambient trace (fused_value_and_grad is jitted).
+            # Probe inputs under ensure_compile_time_eval: the first call
+            # routinely happens while the optimizer's while_loop is being
+            # traced, where bare jnp.zeros would be tracers and the probe
+            # would raise, caching a spurious "unsupported".  The
+            # .lower().compile() itself runs OUTSIDE the escape hatch —
+            # under it, pallas kernel bodies hit eval-trace rules
+            # (program_id has none) — and is ambient-trace-safe on its
+            # own (AOT lowering opens a fresh trace).
+            with jax.ensure_compile_time_eval():
+                args = (
+                    loss,
+                    jnp.zeros(dim, jnp.float32),
+                    jnp.zeros((8, nnz_capacity), jnp.int32),
+                    jnp.zeros((8, nnz_capacity), jnp.float32),
+                    jnp.zeros(8, jnp.float32),
+                    jnp.zeros(8, jnp.float32),
+                    jnp.ones(8, jnp.float32),
+                )
+            # Exercises the full Mosaic pipeline without polluting the
+            # ambient trace (fused_value_and_grad is jitted).
             fused_value_and_grad.lower(*args).compile()
             _KERNEL_SUPPORTED[key] = True
         except Exception:

@@ -74,7 +74,8 @@ def _bucket(n: int) -> int:
 
 
 def _measure(e: int, d: int, n: int, with_pallas: bool,
-             with_xchg: bool = False) -> str:
+             with_xchg: bool = False, xchg_baked: bool = True,
+             with_fm: bool = True) -> str:
     import jax
     import jax.numpy as jnp
 
@@ -107,19 +108,24 @@ def _measure(e: int, d: int, n: int, with_pallas: bool,
         return float(np.median(ts))
 
     timings = {
-        "fm": t(
-            lambda dz, r, v, i: jnp.sum(jax.ops.segment_sum(
-                jnp.take(dz, r, axis=0) * v, i,
-                num_segments=d, indices_are_sorted=True,
-            )),
-            dz, rows, vals, sorted_ids,
-        ),
         "autodiff": t(
             lambda v, i: jnp.sum(jnp.zeros(d, jnp.float32).at[i].add(v)),
             vals, ids_j,
         ),
     }
-    if with_pallas:
+    if with_fm:
+        # Only a candidate when the batch actually carries the fm aux
+        # (streamed fast-kernel chunks attach al/xchg without fm); a
+        # winning-but-unavailable fm verdict would be sanitized to
+        # autodiff by select_kernel, masking a genuinely faster xchg.
+        timings["fm"] = t(
+            lambda dz, r, v, i: jnp.sum(jax.ops.segment_sum(
+                jnp.take(dz, r, axis=0) * v, i,
+                num_segments=d, indices_are_sorted=True,
+            )),
+            dz, rows, vals, sorted_ids,
+        )
+    if with_pallas or with_xchg:
         from photon_tpu.ops.pallas_gather import (
             aligned_grad_reference,
             aligned_segment_grad,
@@ -129,6 +135,9 @@ def _measure(e: int, d: int, n: int, with_pallas: bool,
 
         # Probe on the same entry population, reshaped to the batch's [n, k]
         # padded-COO convention so the layout build is representative.
+        # (The xchg aligned-mode probe also needs this layout; the cumsum
+        # mode only needs the id grid, but the build is cheap at probe
+        # size and keeps one code path.)
         k = max(e // max(n, 1), 1)
         n_probe = e // k
         layout = build_aligned_layout(
@@ -138,6 +147,7 @@ def _measure(e: int, d: int, n: int, with_pallas: bool,
         )
         al = device_layout(layout)
         dz_probe = jnp.asarray(rng.standard_normal(n_probe).astype(np.float32))
+    if with_pallas:
         # Correctness gate BEFORE timing eligibility: the XLA candidates are
         # stock lowerings, but pallas is our Mosaic kernel running on
         # whatever backend is live — validate its full gradient against the
@@ -159,56 +169,64 @@ def _measure(e: int, d: int, n: int, with_pallas: bool,
                 "(max abs err %.3g); excluded from auto selection",
                 float(np.abs(g_dev - g_ref).max()),
             )
-        if with_xchg and "pallas" in timings:
-            # Same correctness-gate-then-time discipline; the route build
-            # (host edge-coloring) is the dominant probe cost, paid once
-            # per shape bucket.  per_row here is dz over the probe's rows;
-            # vals enter row-major, so the oracle is the same layout
-            # reference the pallas gate used.
-            try:
-                from photon_tpu.ops.vperm import (
-                    build_xchg_aux,
-                    xchg_segment_grad,
-                )
+    if with_xchg:
+        # Same correctness-gate-then-time discipline; the route build
+        # (host edge-coloring) is the dominant probe cost, paid once
+        # per shape bucket.  per_row here is dz over the probe's rows;
+        # vals enter row-major, so the oracle is the same layout
+        # reference the pallas gate used.
+        try:
+            from photon_tpu.ops.vperm import (
+                build_xchg_aux,
+                xchg_segment_grad,
+            )
 
-                ids2d = flat_ids[: n_probe * k].reshape(n_probe, k)
-                vals2d_np = np.asarray(vals)[: n_probe * k].reshape(
-                    n_probe, k
+            ids2d = flat_ids[: n_probe * k].reshape(n_probe, k)
+            vals2d_np = np.asarray(vals)[: n_probe * k].reshape(
+                n_probe, k
+            )
+            # xchg_baked mirrors what the production batch carries: a
+            # baked aux moves only the dz expansion per step (values
+            # pre-permuted at attach); an unbaked one (streamed chunks)
+            # exchanges the full product stream — materially different
+            # data movement, so the probe times the matching variant.
+            route = build_xchg_aux(
+                layout, ids2d, d,
+                vals=vals2d_np if xchg_baked else None,
+            )
+            vals2d = jnp.asarray(vals2d_np)
+            g_dev = np.asarray(xchg_segment_grad(
+                dz_probe, vals2d, al, route, d, interpret=False
+            ))
+            ref = np.zeros(d, np.float64)
+            np.add.at(
+                ref,
+                flat_ids[: n_probe * k],
+                (np.asarray(dz_probe)[:, None]
+                 * np.asarray(vals2d)).reshape(-1).astype(np.float64),
+            )
+            scale = max(float(np.abs(ref).max()), 1.0)
+            if np.allclose(g_dev, ref, rtol=2e-4, atol=1e-4 * scale):
+                timings["xchg"] = t(
+                    lambda dz: jnp.sum(xchg_segment_grad(
+                        dz, vals2d, al, route, d, interpret=False
+                    )),
+                    dz_probe,
                 )
-                route = build_xchg_aux(layout, ids2d, d, vals=vals2d_np)
-                vals2d = jnp.asarray(vals2d_np)
-                g_dev = np.asarray(xchg_segment_grad(
-                    dz_probe, vals2d, al, route, d, interpret=False
-                ))
-                ref = np.zeros(d, np.float64)
-                np.add.at(
-                    ref,
-                    flat_ids[: n_probe * k],
-                    (np.asarray(dz_probe)[:, None]
-                     * np.asarray(vals2d)).reshape(-1).astype(np.float64),
-                )
-                scale = max(float(np.abs(ref).max()), 1.0)
-                if np.allclose(g_dev, ref, rtol=2e-4, atol=1e-4 * scale):
-                    timings["xchg"] = t(
-                        lambda dz: jnp.sum(xchg_segment_grad(
-                            dz, vals2d, al, route, d, interpret=False
-                        )),
-                        dz_probe,
-                    )
-                else:
-                    import logging
-
-                    logging.getLogger("photon_tpu.sparse_grad").warning(
-                        "xchg kernel FAILED the on-device correctness gate "
-                        "(max abs err %.3g); excluded from auto selection",
-                        float(np.abs(g_dev - ref).max()),
-                    )
-            except Exception as exc:  # noqa: BLE001 — probe must not kill
+            else:
                 import logging
 
                 logging.getLogger("photon_tpu.sparse_grad").warning(
-                    "xchg probe unavailable (%s); excluded", exc
+                    "xchg kernel FAILED the on-device correctness gate "
+                    "(max abs err %.3g); excluded from auto selection",
+                    float(np.abs(g_dev - ref).max()),
                 )
+        except Exception as exc:  # noqa: BLE001 — probe must not kill
+            import logging
+
+            logging.getLogger("photon_tpu.sparse_grad").warning(
+                "xchg probe unavailable (%s); excluded", exc
+            )
     return min(timings, key=timings.get)
 
 
@@ -230,6 +248,7 @@ def select_kernel(
     has_aligned: bool = False,
     has_benes: bool = False,
     has_xchg: bool = False,
+    xchg_baked: bool = True,
 ) -> str:
     """Pick the gradient kernel — ``"fm"``, ``"autodiff"``, ``"pallas"``,
     ``"benes"``, or ``"xchg"`` — for this problem size on the current
@@ -266,17 +285,47 @@ def select_kernel(
         return "autodiff"
 
     with_pallas = has_aligned and _pallas_eligible()
-    with_xchg = has_xchg and with_pallas
+    # xchg needs Mosaic (its vperm passes are pallas kernels) but NOT the
+    # aligned layout: the cumsum-reduce variant carries only a route +
+    # bounds (streamed chunks attach exactly that), so coupling it to
+    # has_aligned would waste every cumsum layout build in auto mode.
+    with_xchg = has_xchg and _pallas_eligible()
+    if not (has_fm or with_pallas or with_xchg):
+        # Single-candidate set: nothing to measure (e.g. streamed xchg
+        # chunks on a CPU backend, where Mosaic eligibility is off).
+        return "autodiff"
+    # The xchg timing depends on the reduce mode AND on whether values
+    # were pre-permuted at attach (baked: only the dz expansion moves;
+    # unbaked: the full product stream does) — both enter the key so a
+    # streamed unbaked chunk never inherits a baked measurement and a
+    # mid-process PHOTON_XCHG_REDUCE flip never serves the other mode's
+    # verdict.
+    xchg_cfg = (
+        (os.environ.get("PHOTON_XCHG_REDUCE", "aligned"), bool(xchg_baked))
+        if with_xchg else None
+    )
     key = (
         jax.default_backend(), _bucket(e_total), _bucket(dim),
-        with_pallas, with_xchg,
+        with_pallas, with_xchg, xchg_cfg, bool(has_fm),
     )
     if key not in _CACHE:
         try:
             scale = max(1, -(-e_total // _probe_cap()))  # ceil: cap probe size
             e = max(e_total // scale, 1 << 10)
             n = max(n_rows // scale, 64)
-            _CACHE[key] = _measure(e, dim, n, with_pallas, with_xchg)
+            # ensure_compile_time_eval: this selection usually runs while
+            # an ENCLOSING jit (the optimizer's while_loop, a streamed
+            # chunk program) is being traced, and under omnistaging even
+            # jit calls on concrete inputs inline into the outer trace —
+            # the probe's host synchronizations would raise and the
+            # except below would silently pin "autodiff" forever.  The
+            # escape hatch executes the probe eagerly, so the cache holds
+            # a real measurement wherever the first call happens.
+            with jax.ensure_compile_time_eval():
+                _CACHE[key] = _measure(
+                    e, dim, n, with_pallas, with_xchg,
+                    xchg_baked=bool(xchg_baked), with_fm=bool(has_fm),
+                )
         except Exception:  # noqa: BLE001 — a failed probe must not kill training
             # Measured on real TPU hardware (KERNEL_NOTES.md round-4 table):
             # autodiff beats fm 1.881 vs 1.124 steps/s at the headline shape.

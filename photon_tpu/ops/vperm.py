@@ -775,12 +775,20 @@ def expand_kernel_supported(k: int = 32,
                     ],
                     out_specs=pl.BlockSpec((8, LANES), lambda i: (i, 0)),
                 )
-                jax.block_until_ready(f(
-                    jnp.ones((8, LANES // k), dtype),
-                    jnp.zeros((8, LANES), jnp.int8),
-                    jnp.zeros((LANES, 8), jnp.int16),
-                    jnp.zeros((8, LANES), jnp.int8),
-                ))
+                # ensure_compile_time_eval + jit: first call may happen
+                # inside an enclosing jit trace (kernel routing at trace
+                # time); staged probe inputs would raise and cache a
+                # spurious "unsupported" (same rationale as
+                # pallas_gather.reduce_kernel_supported).  The jit wrap
+                # matters: a BARE pallas_call under the escape hatch hits
+                # eval-trace rules (program_id has none).
+                with jax.ensure_compile_time_eval():
+                    jax.block_until_ready(jax.jit(f)(
+                        jnp.ones((8, LANES // k), dtype),
+                        jnp.zeros((8, LANES), jnp.int8),
+                        jnp.zeros((LANES, 8), jnp.int16),
+                        jnp.zeros((8, LANES), jnp.int8),
+                    ))
                 _EXPAND_SUPPORTED[key] = True
             except Exception as exc:  # noqa: BLE001 — fall back
                 import logging
@@ -926,7 +934,11 @@ def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
     ver = _ROUTE_CACHE_VERSION.get(mode, _ROUTE_CACHE_VERSION["aligned"])
     # vals-carrying keys stay in the canonical (unsuffixed) namespace so
     # the expensive production entries survive this key extension.
-    suffix = "" if has_vals else "|novals"
+    # "novals2": round 5 made vals-less aligned builds produce BALANCED
+    # routes (previously colored); the namespace change orphans the old
+    # colored entries instead of silently serving the wrong variant,
+    # while leaving the canonical namespace untouched.
+    suffix = "" if has_vals else "|novals2"
     # Sharded-attach geometry levers change the route CONTENT for the
     # same ids, so they must enter the key; single-shard builds stay in
     # the canonical namespace.
@@ -1086,14 +1098,15 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
                 )
         else:
             # Aligned destination: the balanced exchange also applies
-            # (slab slot pads pair with zero-valued unused sources), and
-            # needs vals for the destination multiply; otherwise the
-            # general colored route.
+            # (slab slot pads pair with zero-valued unused sources —
+            # zero-valued in the PRODUCT stream whether or not values
+            # are baked, so the unbaked variant is equally valid);
+            # otherwise the general colored route.
             built = (
                 build_balanced_aligned_route(
                     layout, np.asarray(ids), blk_override=blk_override
                 )
-                if vals is not None and not force_colored else None
+                if not force_colored else None
             )
             if built is not None:
                 aux = XchgAux(route=built)
@@ -1110,23 +1123,37 @@ def build_xchg_aux(layout, ids: np.ndarray, dim: int,
                 logging.getLogger("photon_tpu.vperm").warning(
                     "route cache write failed (%s)", exc
                 )
-    if vals is not None and (
-        aux.bounds is not None or isinstance(aux.route, BalancedRoute)
-    ):
-        interp = jax.default_backend() != "tpu"
-        flat_np = np.asarray(vals, np.float32).reshape(-1)
-        flat = jnp.asarray(flat_np)
-        if isinstance(aux.route, BalancedRoute):
-            vd = apply_balanced(flat, aux.route, interpret=interp)
-        else:
-            vd = apply_vperm(flat, aux.route, interpret=interp)
-        if os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16":
-            vd = vd.astype(jnp.bfloat16)
-        fp = np.ascontiguousarray(
-            flat_np[::_vals_fp_stride(flat_np.size)], np.float32
-        )
-        aux = dataclasses.replace(aux, vals_dest=vd, vals_fp=fp)
+    if vals is not None:
+        aux = bake_vals_dest(aux, vals)
     return aux
+
+
+def bake_vals_dest(aux: XchgAux, vals: np.ndarray) -> XchgAux:
+    """Pre-permute the STATIC value stream to the destination order and
+    attach it (plus its fingerprint) to the aux — one device pass, so
+    each training step moves only the dz expansion and the value multiply
+    happens at the destination.  Split out of :func:`build_xchg_aux` so
+    callers that load a cached route (e.g. the streaming layout cache)
+    can re-bake against freshly parsed values without rebuilding the
+    route.  No-op for route kinds whose reduce reads row-major values
+    directly (colored aligned)."""
+    import os
+
+    if not (aux.bounds is not None or isinstance(aux.route, BalancedRoute)):
+        return aux
+    interp = jax.default_backend() != "tpu"
+    flat_np = np.asarray(vals, np.float32).reshape(-1)
+    flat = jnp.asarray(flat_np)
+    if isinstance(aux.route, BalancedRoute):
+        vd = apply_balanced(flat, aux.route, interpret=interp)
+    else:
+        vd = apply_vperm(flat, aux.route, interpret=interp)
+    if os.environ.get("PHOTON_XCHG_DTYPE", "float32") == "bfloat16":
+        vd = vd.astype(jnp.bfloat16)
+    fp = np.ascontiguousarray(
+        flat_np[::_vals_fp_stride(flat_np.size)], np.float32
+    )
+    return dataclasses.replace(aux, vals_dest=vd, vals_fp=fp)
 
 
 def _vals_fp_stride(size: int) -> int:

@@ -505,3 +505,45 @@ def test_fast_path_matches_autodiff_across_random_configs():
                 np.asarray(g_fm), np.asarray(g_ref), rtol=2e-4, atol=2e-5,
                 err_msg=f"cfg {n},{k},{d} {loss} l2={l2}",
             )
+
+
+def test_selection_probe_measures_under_enclosing_trace(monkeypatch):
+    """The auto-selection probe usually first fires while an ENCLOSING
+    jit (optimizer while_loop, streamed chunk program) is being traced;
+    under omnistaging its host synchronizations would raise and the
+    blanket except would silently pin "autodiff" forever.  The
+    ensure_compile_time_eval escape hatch must let the real measurement
+    complete there (round-5 fix — the failure was latent in every
+    jitted auto-mode path)."""
+    import jax
+    import jax.numpy as jnp
+
+    import photon_tpu.ops.sparse_grad_select as sg
+
+    saved = dict(sg._CACHE)
+    sg._CACHE.clear()
+    calls = []
+    real = sg._measure
+
+    def spy(*args, **kw):
+        out = real(*args, **kw)
+        calls.append(out)
+        return out
+
+    monkeypatch.setattr(sg, "_measure", spy)
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "auto")
+    monkeypatch.setenv("PHOTON_SPARSE_PROBE_FLOOR", "1")
+    try:
+        def f(x):
+            choice = sg.select_kernel(4096, 512, 256, has_fm=True)
+            assert choice in ("fm", "autodiff")
+            return x * 2.0
+
+        jax.jit(f)(jnp.ones(2))
+        assert calls, (
+            "the probe must have completed a real measurement under the "
+            "trace, not fallen into the except-Exception autodiff pin"
+        )
+    finally:
+        sg._CACHE.clear()
+        sg._CACHE.update(saved)

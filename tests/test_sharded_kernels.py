@@ -172,9 +172,12 @@ def test_sharded_attach_stacks_uniform_geometry(monkeypatch):
 
 def test_sharded_lbfgs_convergence_xchg(monkeypatch):
     """A full sharded L-BFGS fit with the xchg kernel forced converges to
-    the same optimum as single-device autodiff."""
-    from photon_tpu.core.optimizers import lbfgs
+    the same optimum as single-device autodiff.  Iteration cap keeps the
+    interpret-mode run inside the suite's wall-clock bar (converges in
+    ~15 iterations at this shape)."""
+    from photon_tpu.core.optimizers import OptimizerConfig, lbfgs
 
+    cfg = OptimizerConfig(max_iterations=30)
     monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
     monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
     batch = _batch(seed=11)
@@ -182,13 +185,13 @@ def test_sharded_lbfgs_convergence_xchg(monkeypatch):
     w0 = jnp.zeros(D, jnp.float32)
 
     monkeypatch.setenv("PHOTON_SPARSE_GRAD", "autodiff")
-    res_ref = lbfgs(lambda w: obj.value_and_grad(w, batch), w0)
+    res_ref = lbfgs(lambda w: obj.value_and_grad(w, batch), w0, cfg)
 
     monkeypatch.setenv("PHOTON_SPARSE_GRAD", "xchg")
     mesh = create_mesh()
     sharded = shard_batch(batch, mesh, aligned_dim=D)
     dist = DistributedGlmObjective(obj, mesh)
-    res_d = lbfgs(lambda w: dist.value_and_grad(w, sharded), w0)
+    res_d = lbfgs(lambda w: dist.value_and_grad(w, sharded), w0, cfg)
     assert bool(res_d.converged)
     np.testing.assert_allclose(
         float(res_d.value), float(res_ref.value), rtol=1e-4
