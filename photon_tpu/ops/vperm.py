@@ -110,18 +110,39 @@ tree_util.register_dataclass(
 )
 
 
+def route_threads() -> int:
+    """Worker count for per-chunk route colorings (PHOTON_ROUTE_THREADS,
+    default: host cores capped at 8 — the walk is memory-bound past
+    that).  The native edge coloring releases the GIL (ctypes) and is
+    reentrant (stack-local scratch), so chunks color concurrently."""
+    import os
+
+    from photon_tpu.utils.env import env_int
+
+    return env_int(
+        "PHOTON_ROUTE_THREADS", min(os.cpu_count() or 1, 8), minimum=1
+    )
+
+
 def _chunk_stage_arrays(rows: np.ndarray, ch: int):
     """Factor per-chunk CS-perms into the 5-stage micro-Clos planes.
 
     ``rows`` is [NC, CS] int64: row i is the permutation applied within
     chunk i (y_chunk = x_chunk[rows[i]]).  Returns (i1 [NC*CH, 128] int8,
     i2 [NC*128, CH] int16, i3 [NC*CH, 128] int8).
+
+    The per-chunk colorings are independent and GIL-releasing, so they
+    run on a thread pool (:func:`route_threads`) — the measured
+    profile at E=2^23 is ~60% native edge-coloring walk, so on an
+    8-core host the build drops accordingly (tools/probe_route_scaling
+    carries the numbers).
     """
     nc = rows.shape[0]
     i1 = np.empty((nc * ch, LANES), np.int8)
     i2 = np.empty((nc * LANES, ch), np.int16)
     i3 = np.empty((nc * ch, LANES), np.int8)
-    for i in range(nc):
+
+    def one(i: int) -> None:
         r = route_permutation(rows[i], a=ch, b=LANES, device=False)
         # clos stage semantics (apply_clos_grid): lane-gather by p1 on
         # [CH,128], transpose, row-gather by p2 on [128,CH], transpose,
@@ -129,6 +150,19 @@ def _chunk_stage_arrays(rows: np.ndarray, ch: int):
         i1[i * ch:(i + 1) * ch] = r.p1.astype(np.int8)
         i2[i * LANES:(i + 1) * LANES] = r.p2.astype(np.int16)
         i3[i * ch:(i + 1) * ch] = r.p3.astype(np.int8)
+
+    import threading
+
+    from photon_tpu.utils.io_pool import map_ordered
+
+    workers = min(route_threads(), nc)
+    if threading.current_thread().name.startswith("ThreadPoolExecutor"):
+        # Already on a pool thread (e.g. a streamed chunk attach inside
+        # the io_pool): nesting a second pool would oversubscribe cores
+        # on a walk that is cache-pressure-bound — thread at one level.
+        workers = 1
+    # list(): drain, surfacing the first worker exception in order.
+    list(map_ordered(one, range(nc), workers=workers))
     return i1, i2, i3
 
 

@@ -412,3 +412,27 @@ def test_baked_vals_guard_rejects_stale_stream(monkeypatch):
             jax.numpy.asarray(per_row), jax.numpy.asarray(3.0 * vals),
             None, aux, dim, interpret=INTERP,
         )
+
+
+def test_threaded_chunk_colorings_match_serial(monkeypatch):
+    """PHOTON_ROUTE_THREADS > 1 must produce a route with identical
+    applied results to the serial build (the colorings are independent;
+    this pins the thread-pool refactor)."""
+    from photon_tpu.ops.vperm import apply_balanced, build_xchg_aux
+
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    rng = np.random.default_rng(31)
+    n, k, dim = (2 * CS) // 32, 32, 2048
+    ids = rng.integers(0, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    x = jax.numpy.asarray(
+        rng.standard_normal(n * k).astype(np.float32)
+    )
+    monkeypatch.setenv("PHOTON_ROUTE_THREADS", "1")
+    aux_s = build_xchg_aux(None, ids, dim, vals=vals)
+    monkeypatch.setenv("PHOTON_ROUTE_THREADS", "4")
+    aux_t = build_xchg_aux(None, ids, dim, vals=vals)
+    got_s = np.asarray(apply_balanced(x, aux_s.route, interpret=INTERP))
+    got_t = np.asarray(apply_balanced(x, aux_t.route, interpret=INTERP))
+    np.testing.assert_array_equal(got_s, got_t)

@@ -1,0 +1,61 @@
+"""Pre-build the auto-selection probe's exchange routes into the disk
+cache, so a TPU-window auto-mode headline run (bench.py with
+PHOTON_SPARSE_GRAD unset) spends its first trace compiling — not tens
+of host-seconds edge-coloring.
+
+Replicates ops/sparse_grad_select._measure's EXACT probe construction
+(deterministic rng(0) ids at the bench's full probe cap) and calls the
+same build_xchg_aux entry point, which content-hashes the inputs —
+identical inputs on the TPU host therefore hit these cache files.  Run
+from the repo root on the host that will serve the window (the cache
+dir defaults to the same root the window's bench run resolves).
+
+Usage: python tools/precache_probe_routes.py [log2_e] [mode ...]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from photon_tpu.ops.pallas_gather import build_aligned_layout
+    from photon_tpu.ops.vperm import build_xchg_aux
+
+    log2_e = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    modes = sys.argv[2:] or ["aligned", "cumsum"]
+    # Mirror _measure: e entries over d features, k = e // n.
+    e, d = 1 << log2_e, 1 << 18
+    n = 1 << (log2_e - 5)  # bench headline rows scale: k = 32
+    k = max(e // max(n, 1), 1)
+    n_probe = e // k
+    rng = np.random.default_rng(0)
+    flat_ids = rng.integers(0, d, size=e, dtype=np.int32)
+    vals = rng.standard_normal(e).astype(np.float32)
+    ids2d = flat_ids[: n_probe * k].reshape(n_probe, k)
+    vals2d = vals[: n_probe * k].reshape(n_probe, k)
+    print(f"probe shape: e=2^{log2_e} d=2^18 n={n_probe} k={k}")
+    layout = None
+    for mode in modes:
+        os.environ["PHOTON_XCHG_REDUCE"] = mode
+        if mode != "cumsum" and layout is None:
+            t0 = time.perf_counter()
+            layout = build_aligned_layout(ids2d, vals2d, d)
+            print(f"layout build: {time.perf_counter() - t0:.1f}s")
+        t0 = time.perf_counter()
+        build_xchg_aux(
+            layout if mode != "cumsum" else None, ids2d, d, vals=vals2d
+        )
+        print(f"route ({mode}): {time.perf_counter() - t0:.1f}s "
+              "(cached for the next run)")
+
+
+if __name__ == "__main__":
+    main()

