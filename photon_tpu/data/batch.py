@@ -226,6 +226,8 @@ def attach_feature_major(
     shards: int = 1,
     aligned_dim: int | None = None,
     aligned_forward: bool | None = None,
+    geometry_gather=None,
+    global_entries: int | None = None,
 ) -> SparseBatch:
     """Attach the static feature-major layout (:class:`FeatureMajorAux`).
 
@@ -292,14 +294,23 @@ def attach_feature_major(
 
         ids_np = np.asarray(batch.ids)
         vals_np = np.asarray(batch.vals, np.float32)
-        want_xchg = xchg_route_wanted(n * k)
+        # Size floors judge the GLOBAL problem (the kernels run at global
+        # scale): a multi-process assembly passes the allgathered total
+        # so four processes sharing a big batch don't each fall below a
+        # local floor and silently lose the route everywhere.
+        want_xchg = xchg_route_wanted(global_entries or (n * k))
         if aligned_forward is None:
             # xchg implies the pallas forward: its whole point is deleting
             # the E-element gathers, and XLA margins would reintroduce one.
             aligned_forward = want_xchg or (
                 os.environ.get("PHOTON_SPARSE_MARGIN", "xla") == "pallas"
             )
-        if shards != 1:
+        if shards != 1 or geometry_gather is not None:
+            # A geometry gather forces the STACKED form even for one
+            # local shard: a multi-process assembly needs every process's
+            # aux to carry the leading shard axis (and to agree on the
+            # globally-gathered geometry) so the per-process arrays
+            # concatenate into one global sharded pytree.
             if os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "benes":
                 # Before the expensive per-shard build: rejecting after it
                 # would waste the costliest host work in the package.
@@ -310,6 +321,7 @@ def attach_feature_major(
                 batch, ids_np, vals_np, aligned_dim, shards,
                 aligned_forward=bool(aligned_forward),
                 want_xchg=want_xchg, order=order,
+                geometry_gather=geometry_gather,
             )
         layout = build_aligned_layout(ids_np, vals_np, aligned_dim)
         batch = batch._replace(al=device_layout(layout))
@@ -349,6 +361,7 @@ def _attach_aligned_sharded(
     aligned_forward: bool,
     want_xchg: bool,
     order: np.ndarray,
+    geometry_gather=None,
 ) -> SparseBatch:
     """Per-shard aligned layouts (+ optional transposed layouts and xchg
     routes), padded to common geometry and stacked on a leading shard
@@ -365,17 +378,27 @@ def _attach_aligned_sharded(
       stacking, and on any mismatch the xchg aux is dropped (the batch
       still carries fm + aligned, so training routes to the next-best
       kernel instead of failing).
+
+    ``geometry_gather(local [S, 4] int64) -> global [S_total, 4]``
+    widens the geometry agreement beyond this call's shards — the
+    multi-process assembly (data/streaming.make_global_batch) passes a
+    process-allgather so every process pads to ONE global geometry and
+    the per-process stacked leaves concatenate into one sharded global
+    array.  Columns: (n_slabs, n_tiles, al_t n_slabs, al_t n_tiles) for
+    the layout phase; (census|-1, 0, 0, 0) for the route phase.
+    Default: identity (single-process attach).
     """
     import logging
 
     from photon_tpu.ops.pallas_gather import (
         build_aligned_layout,
         build_row_aligned_layout,
-        common_layout_geometry,
         pad_aligned_layout,
         stack_device_layouts,
     )
 
+    if geometry_gather is None:
+        geometry_gather = lambda arr: arr  # noqa: E731 — identity
     n, k = ids_np.shape
     ns = n // shards
     ids_blocks = ids_np.reshape(shards, ns, k)
@@ -384,17 +407,35 @@ def _attach_aligned_sharded(
         build_aligned_layout(ids_blocks[s], vals_blocks[s], aligned_dim)
         for s in range(shards)
     ]
+    layouts_t = (
+        [
+            build_row_aligned_layout(ids_blocks[s], vals_blocks[s])
+            for s in range(shards)
+        ]
+        if aligned_forward else None
+    )
+    geo_local = np.asarray([
+        [
+            layouts[s].n_slabs, layouts[s].n_tiles,
+            layouts_t[s].n_slabs if layouts_t else 0,
+            layouts_t[s].n_tiles if layouts_t else 0,
+        ]
+        for s in range(shards)
+    ], np.int64)
+    from photon_tpu.ops.pallas_gather import common_layout_geometry_arr
+
+    geo = np.asarray(geometry_gather(geo_local), np.int64)
+    s_tgt, t_tgt = common_layout_geometry_arr(geo[:, :2])
     # Pad FIRST, then build routes against the padded layouts: the
     # aligned-mode exchange's destination is the slot stream, whose
     # length must be uniform across shards for the routes to stack.
-    s_tgt, t_tgt = common_layout_geometry(layouts)
     layouts = [pad_aligned_layout(l, s_tgt, t_tgt) for l in layouts]
     batch = batch._replace(al=stack_device_layouts(layouts))
     if aligned_forward:
-        batch = batch._replace(al_t=stack_device_layouts([
-            build_row_aligned_layout(ids_blocks[s], vals_blocks[s])
-            for s in range(shards)
-        ]))
+        st, tt = common_layout_geometry_arr(geo[:, 2:])
+        batch = batch._replace(al_t=stack_device_layouts(
+            [pad_aligned_layout(l, st, tt) for l in layouts_t]
+        ))
     if not want_xchg:
         return batch
     import jax
@@ -411,8 +452,12 @@ def _attach_aligned_sharded(
         else:
             dest_src = layouts[s].src.reshape(-1)
         censuses.append(balanced_blk_census(dest_src, e_s, k))
-    force_colored = any(c is None for c in censuses)
-    blk_override = None if force_colored else max(censuses)
+    census_local = np.asarray([
+        [-1 if c is None else c, 0, 0, 0] for c in censuses
+    ], np.int64)
+    census_all = np.asarray(geometry_gather(census_local), np.int64)[:, 0]
+    force_colored = bool((census_all < 0).any())
+    blk_override = None if force_colored else int(census_all.max())
     auxes = [
         build_xchg_aux(
             layouts[s], ids_blocks[s], aligned_dim, order=order[s],
@@ -422,11 +467,28 @@ def _attach_aligned_sharded(
         for s in range(shards)
     ]
     defs = {jax.tree.structure(a) for a in auxes}
-    if len(defs) != 1:
+    # Route KIND (2=balanced, 1=colored — _aux_to_npz codes) must match
+    # across ALL shards globally, and the drop decision must be agreed
+    # globally too: one process keeping the aux while another drops it
+    # would give the hosts different program pytrees (hang, not
+    # fallback).  Same gather as the geometry negotiation.
+    from photon_tpu.ops.vperm import BalancedRoute
+
+    kind = 2 if isinstance(auxes[0].route, BalancedRoute) else 1
+    verdict_local = np.asarray(
+        [[1 if len(defs) != 1 else 0, kind, 0, 0]], np.int64
+    )
+    verdict = np.asarray(geometry_gather(verdict_local), np.int64)
+    drop = bool(verdict[:, 0].any()) or len(set(
+        verdict[:, 1].tolist()
+    )) != 1
+    if drop:
         logging.getLogger("photon_tpu.batch").warning(
             "per-shard xchg routes came out with mismatched geometry "
-            "(%d distinct treedefs); dropping the xchg aux — training "
-            "will route to the pallas/fm kernels instead", len(defs),
+            "(locally %d distinct treedefs; global kinds %s); dropping "
+            "the xchg aux everywhere — training will route to the "
+            "pallas/fm kernels instead",
+            len(defs), sorted(set(verdict[:, 1].tolist())),
         )
         return batch
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *auxes)

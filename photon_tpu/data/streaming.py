@@ -601,11 +601,21 @@ class LibsvmFileSource:
 # ---------------------------------------------------------------------------
 
 
-def make_global_batch(local_batch: SparseBatch, mesh, axis: str = "data"):
+def make_global_batch(local_batch: SparseBatch, mesh, axis: str = "data",
+                      aligned_dim: Optional[int] = None):
     """Assemble per-process local rows into one globally-sharded batch
     (``jax.make_array_from_process_local_data`` over the mesh's data axis —
     the multi-host path SURVEY.md §7 names).  Single-process meshes reduce
-    to a plain shard placement."""
+    to a plain shard placement.
+
+    With ``aligned_dim`` (and the kernel selector wanting them — same
+    gate as ``shard_batch``), each process builds the aligned/xchg aux
+    for ITS local row blocks, with the padded geometry and balanced
+    block census agreed GLOBALLY via a process allgather — so the
+    per-process stacked aux leaves concatenate into one uniformly-shaped
+    global array and the fast kernels run per shard on every host
+    (VERDICT r5 item 2, multi-process leg).
+    """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def build(leaf):
@@ -616,20 +626,91 @@ def make_global_batch(local_batch: SparseBatch, mesh, axis: str = "data"):
             sharding, np.asarray(leaf)
         )
 
+    def build_tree(aux):
+        return jax.tree.map(build, aux)
+
     core = SparseBatch(*(build(leaf) for leaf in local_batch[:5]))
-    if local_batch.fm is not None:
-        # The aux's leading block axis must match this process's slice of the
-        # data axis (one block per local device) for the per-shard sorted
-        # views to line up with the row sharding — rebuild it at the right
-        # granularity rather than trusting the caller's shard count.
+    local_shards = int(mesh.local_mesh.shape[axis])
+
+    def gather_geometry(local_arr: np.ndarray) -> np.ndarray:
+        if jax.process_count() == 1:
+            return local_arr
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(local_arr, tiled=True)
+
+    wants_aligned = False
+    global_entries = None
+    if aligned_dim is not None and local_batch.ids.ndim == 2:
+        from photon_tpu.ops.sparse_grad_select import aligned_layout_wanted
+
+        # Collective-agreement discipline: every decision that gates a
+        # collective must itself be computed from GLOBALLY-agreed
+        # inputs.  ``aligned_dim`` must be passed uniformly by every
+        # process (caller contract, like the mesh itself); the entry
+        # count is allgathered so the branch below is identical on
+        # every host.
+        shapes = np.asarray(gather_geometry(
+            np.asarray([list(local_batch.ids.shape)], np.int64)
+        ), np.int64)
+        if len({tuple(row) for row in shapes.tolist()}) != 1:
+            # make_array_from_process_local_data requires uniform
+            # per-process contributions for P(axis) row sharding; with
+            # unequal [n, k] SHAPES (entry counts alone could
+            # coincide, e.g. 100x2 vs 50x4) the per-process aux (and
+            # core) leaves would diverge into a cross-host hang.  The
+            # gathered shapes are identical on every host, so every
+            # process raises this SAME error — loud, not a deadlock.
+            raise ValueError(
+                f"make_global_batch requires equal local batch shapes "
+                f"across processes (got {shapes.tolist()}); pad local "
+                "batches first"
+            )
+        global_entries = int(shapes.prod(axis=1).sum())
+        if (
+            jax.process_count() > 1
+            and os.environ.get("PHOTON_SPARSE_GRAD", "auto") == "auto"
+        ):
+            # Mirror DistributedGlmObjective._sparse_kernel's multi-
+            # process auto pin: the objective will run autodiff, so
+            # building (and shipping to HBM) aux it will never touch is
+            # pure waste — AND this pin is what makes every remaining
+            # gate host-uniform: the forced modes that can still reach
+            # the attach resolve aligned_layout_wanted/xchg_route_wanted
+            # from the env alone (no per-host probes or native-lib
+            # loads), so no host can diverge around the geometry
+            # collectives.  PHOTON_SPARSE_GRAD must be set uniformly
+            # across processes (caller contract, like the mesh).
+            wants_aligned = False
+        else:
+            wants_aligned = aligned_layout_wanted(global_entries)
+    rebuilt = False
+    if wants_aligned or (
+        local_batch.fm is not None
+        and int(local_batch.fm.ids.shape[0]) != local_shards
+    ):
+        # Rebuild the aux at the right granularity (one block per local
+        # device) — and, when eligible, with the aligned/xchg layouts.
         from photon_tpu.data.batch import attach_feature_major
 
-        local_shards = int(mesh.local_mesh.shape[axis])
-        if int(local_batch.fm.ids.shape[0]) != local_shards:
-            local_batch = attach_feature_major(
-                local_batch._replace(fm=None), shards=local_shards
-            )
+        local_batch = attach_feature_major(
+            local_batch._replace(fm=None, al=None, al_t=None, xchg=None),
+            shards=local_shards,
+            aligned_dim=aligned_dim if wants_aligned else None,
+            geometry_gather=gather_geometry,
+            global_entries=global_entries,
+        )
+        rebuilt = True
+    if local_batch.fm is not None:
         core = core._replace(
             fm=type(local_batch.fm)(*(build(leaf) for leaf in local_batch.fm))
         )
+    if rebuilt:
+        # Forward ONLY aux this assembly built (stacked, with globally
+        # agreed geometry).  Caller-attached single-block aux cannot be
+        # row-sharded — it is stripped above, exactly as before round 5.
+        for aux_name in ("al", "al_t", "xchg"):
+            aux = getattr(local_batch, aux_name, None)
+            if aux is not None:
+                core = core._replace(**{aux_name: build_tree(aux)})
     return core

@@ -328,14 +328,26 @@ def pad_aligned_layout(
     )
 
 
+def common_layout_geometry_arr(geo: np.ndarray) -> tuple[int, int]:
+    """The (n_slabs, n_tiles) target every row of ``geo`` (columns:
+    per-layout n_slabs, n_tiles) can be padded to under
+    :func:`pad_aligned_layout`'s pad-tile constraint — the array form
+    serves the sharded attach, whose geometry rows may come from a
+    cross-process allgather."""
+    geo = np.asarray(geo, np.int64)
+    s_max = int(geo[:, 0].max())
+    t_max = int((geo[:, 1] + (s_max - geo[:, 0])).max())
+    return s_max, t_max
+
+
 def common_layout_geometry(
     layouts: "list[AlignedLayout]",
 ) -> tuple[int, int]:
     """The (n_slabs, n_tiles) target that every layout in the list can be
     padded to under :func:`pad_aligned_layout`'s pad-tile constraint."""
-    s_max = max(l.n_slabs for l in layouts)
-    t_max = max(l.n_tiles + (s_max - l.n_slabs) for l in layouts)
-    return s_max, t_max
+    return common_layout_geometry_arr(np.asarray(
+        [[l.n_slabs, l.n_tiles] for l in layouts], np.int64
+    ))
 
 
 def stack_device_layouts(layouts: "list[AlignedLayout]") -> AlignedLayoutDev:

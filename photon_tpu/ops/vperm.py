@@ -151,15 +151,13 @@ def _chunk_stage_arrays(rows: np.ndarray, ch: int):
         i2[i * LANES:(i + 1) * LANES] = r.p2.astype(np.int16)
         i3[i * ch:(i + 1) * ch] = r.p3.astype(np.int8)
 
-    import threading
-
-    from photon_tpu.utils.io_pool import map_ordered
+    from photon_tpu.utils.io_pool import in_pool_worker, map_ordered
 
     workers = min(route_threads(), nc)
-    if threading.current_thread().name.startswith("ThreadPoolExecutor"):
-        # Already on a pool thread (e.g. a streamed chunk attach inside
-        # the io_pool): nesting a second pool would oversubscribe cores
-        # on a walk that is cache-pressure-bound — thread at one level.
+    if in_pool_worker():
+        # Already on an io_pool worker (e.g. a streamed chunk attach):
+        # nesting a second pool would oversubscribe cores on a walk
+        # that is cache-pressure-bound — thread at one level.
         workers = 1
     # list(): drain, surfacing the first worker exception in order.
     list(map_ordered(one, range(nc), workers=workers))

@@ -15,12 +15,26 @@ bounded, so memory never scales with the number of files.
 from __future__ import annotations
 
 import os
+import threading
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
 R = TypeVar("R")
+
+_worker_ctx = threading.local()
+
+
+def in_pool_worker() -> bool:
+    """True when the current thread is executing a :func:`map_ordered`
+    call — lets nested pooled work (e.g. route colorings inside a
+    streamed chunk attach) cap itself to one level instead of
+    oversubscribing cores.  Precise by construction (an explicit
+    thread-local set around each pooled call), unlike thread-name
+    sniffing, which both over-matches foreign executors and misses
+    renamed ones."""
+    return bool(getattr(_worker_ctx, "active", False))
 
 
 def io_threads() -> int:
@@ -64,13 +78,20 @@ def map_ordered(
     if window is None:
         window = 2 * workers
     window = max(window, 1)
+    def run_marked(item: T) -> R:
+        _worker_ctx.active = True
+        try:
+            return fn(item)
+        finally:
+            _worker_ctx.active = False
+
     ex = ThreadPoolExecutor(max_workers=workers)
     try:
         futs: deque = deque()
         idx = 0
         while futs or idx < len(items):
             while idx < len(items) and len(futs) < window:
-                futs.append(ex.submit(fn, items[idx]))
+                futs.append(ex.submit(run_marked, items[idx]))
                 idx += 1
             yield futs.popleft().result()
     finally:

@@ -2,11 +2,17 @@
 task: 'measure and bound route-build scaling').
 
 Times ``build_xchg_aux`` (the production exchange-route build) across
-entry counts and breaks the cost into its phases: id argsort, balanced
-block census, stage-A/B micro-colorings (the native edge-coloring walk,
-parallelizable across chunks via PHOTON_ROUTE_THREADS), and middle-pack.
-Prints one JSON line per (E, mode) so the cost model in KERNEL_NOTES.md
-can carry numbers.
+entry counts; prints one JSON line per (E, mode) so the cost model in
+KERNEL_NOTES.md can carry numbers.  The PHASE attribution in that table
+(~60% native edge-coloring, ~20% argsorts at E=2^23) came from cProfile
+— reproduce it with:
+
+    python -c "import cProfile, pstats; \
+      cProfile.run('...build_xchg_aux(...)', 'out'); \
+      pstats.Stats('out').sort_stats('cumulative').print_stats(14)"
+
+(the colorings are the independent per-chunk `_edge_color_native`
+calls, parallelizable via PHOTON_ROUTE_THREADS).
 
 Run: python tools/probe_route_scaling.py [max_log2_e]
 """
