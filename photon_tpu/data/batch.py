@@ -505,21 +505,34 @@ def batch_astype(batch: Batch, dtype) -> Batch:
     labels, offsets, weights, and every reduction stay f32; only the stored
     values shrink).  The reference has no analog: Breeze vectors are f64.
     """
+    import dataclasses
+
     dtype = jnp.dtype(dtype)
     if isinstance(batch, DenseBatch):
         return batch._replace(x=batch.x.astype(dtype))
     out = batch._replace(vals=batch.vals.astype(dtype))
     if out.fm is not None:
         out = out._replace(fm=out.fm._replace(vals=out.fm.vals.astype(dtype)))
-    if out.al is not None or out.al_t is not None:
-        import dataclasses
-
-        for aux in ("al", "al_t"):
-            lay = getattr(out, aux)
-            if lay is not None:
-                out = out._replace(**{
-                    aux: dataclasses.replace(lay, vals=lay.vals.astype(dtype))
-                })
+    for aux in ("al", "al_t"):
+        lay = getattr(out, aux)
+        if lay is not None:
+            out = out._replace(**{
+                aux: dataclasses.replace(lay, vals=lay.vals.astype(dtype))
+            })
+    if out.xchg is not None and getattr(out.xchg, "vals_dest", None) is not None:
+        # The baked destination stream was permuted from the
+        # PRE-conversion values; left untouched, gradients would read
+        # different values than the margins (the objective and its
+        # gradient must see ONE value stream).  Elementwise casts
+        # commute with the static permutation (pads stay zero), so
+        # converting the baked stream in place keeps it exactly equal
+        # to permute(converted vals) — preserving the fused dz-expansion
+        # fast path, working directly on stacked sharded arrays, and
+        # keeping vals_fp valid (its guard's loose rtol exists for this
+        # conversion).
+        out = out._replace(xchg=dataclasses.replace(
+            out.xchg, vals_dest=out.xchg.vals_dest.astype(dtype)
+        ))
     return out
 
 

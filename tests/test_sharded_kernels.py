@@ -199,3 +199,58 @@ def test_sharded_lbfgs_convergence_xchg(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(res_d.w), np.asarray(res_ref.w), atol=5e-2
     )
+
+
+def test_bf16_storage_keeps_xchg_grad_consistent(monkeypatch):
+    """batch_astype(bf16) after an xchg attach must keep the gradient
+    consistent with the (converted) values the margins read: the baked
+    vals_dest converts IN PLACE (elementwise casts commute with the
+    static permutation), so both directions see one value stream and
+    the fused path survives.  Checked sharded AND single-device against
+    autodiff on the SAME converted batch (tight tolerance — same
+    values, different reduction order)."""
+    from photon_tpu.data.batch import batch_astype
+
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    monkeypatch.setenv("PHOTON_XCHG_REDUCE", "cumsum")
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "xchg")
+    batch = _batch(seed=17)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 0.3))
+    rng = np.random.default_rng(18)
+    w = jnp.asarray(rng.standard_normal(D).astype(np.float32) * 0.1)
+
+    fast = attach_feature_major(batch, aligned_dim=D)
+    assert fast.xchg is not None and fast.xchg.vals_dest is not None
+    fast16 = batch_astype(fast, jnp.bfloat16)
+    # The baked stream converts IN PLACE (elementwise casts commute with
+    # the static permutation), so the fused path survives bf16 storage.
+    assert fast16.xchg.vals_dest.dtype == jnp.bfloat16
+    v_x, g_x = obj.value_and_grad(w, fast16)
+
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "autodiff")
+    b16 = batch_astype(batch, jnp.bfloat16)
+    v_a, g_a = obj.value_and_grad(w, b16)
+    np.testing.assert_allclose(float(v_x), float(v_a), rtol=2e-5)
+    scale = max(float(np.abs(np.asarray(g_a)).max()), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(g_x), np.asarray(g_a), rtol=2e-4, atol=2e-4 * scale
+    )
+
+    # Sharded: the STACKED baked stream converts in place the same way —
+    # assert the aux actually survived (shard_batch can drop it on route
+    # mismatch, which would let fallback kernels pass this vacuously)
+    # and that xchg is what dispatches.
+    monkeypatch.setenv("PHOTON_SPARSE_GRAD", "xchg")
+    mesh = create_mesh()
+    sharded16 = batch_astype(
+        shard_batch(batch, mesh, aligned_dim=D), jnp.bfloat16
+    )
+    assert sharded16.xchg is not None
+    assert sharded16.xchg.vals_dest.dtype == jnp.bfloat16
+    dist = DistributedGlmObjective(obj, mesh)
+    assert dist._sparse_kernel(w, sharded16) == "xchg"
+    v_d, g_d = dist.value_and_grad(w, sharded16)
+    np.testing.assert_allclose(float(v_d), float(v_a), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(g_d), np.asarray(g_a), rtol=2e-4, atol=2e-4 * scale
+    )
