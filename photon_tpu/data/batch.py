@@ -285,9 +285,8 @@ def attach_feature_major(
         )
     if aligned_dim is not None:
         from photon_tpu.ops.pallas_gather import (
-            build_aligned_layout,
-            build_row_aligned_layout,
             device_layout,
+            load_or_build_aligned_layout,
         )
 
         from photon_tpu.ops.sparse_grad_select import xchg_route_wanted
@@ -323,12 +322,14 @@ def attach_feature_major(
                 want_xchg=want_xchg, order=order,
                 geometry_gather=geometry_gather,
             )
-        layout = build_aligned_layout(ids_np, vals_np, aligned_dim)
+        layout = load_or_build_aligned_layout(ids_np, vals_np, aligned_dim)
         batch = batch._replace(al=device_layout(layout))
         if aligned_forward:
-            batch = batch._replace(
-                al_t=device_layout(build_row_aligned_layout(ids_np, vals_np))
-            )
+            batch = batch._replace(al_t=device_layout(
+                load_or_build_aligned_layout(
+                    ids_np, vals_np, aligned_dim, transposed=True
+                )
+            ))
         if want_xchg:
             from photon_tpu.ops.vperm import build_xchg_aux
 
@@ -391,8 +392,7 @@ def _attach_aligned_sharded(
     import logging
 
     from photon_tpu.ops.pallas_gather import (
-        build_aligned_layout,
-        build_row_aligned_layout,
+        load_or_build_aligned_layout,
         pad_aligned_layout,
         stack_device_layouts,
     )
@@ -404,12 +404,16 @@ def _attach_aligned_sharded(
     ids_blocks = ids_np.reshape(shards, ns, k)
     vals_blocks = vals_np.reshape(shards, ns, k)
     layouts = [
-        build_aligned_layout(ids_blocks[s], vals_blocks[s], aligned_dim)
+        load_or_build_aligned_layout(
+            ids_blocks[s], vals_blocks[s], aligned_dim
+        )
         for s in range(shards)
     ]
     layouts_t = (
         [
-            build_row_aligned_layout(ids_blocks[s], vals_blocks[s])
+            load_or_build_aligned_layout(
+                ids_blocks[s], vals_blocks[s], aligned_dim, transposed=True
+            )
             for s in range(shards)
         ]
         if aligned_forward else None

@@ -97,22 +97,9 @@ def _pow2(x: int) -> int:
 
 
 def _cache_root() -> Optional[str]:
-    root = os.environ.get("PHOTON_STREAM_LAYOUT_CACHE")
-    if root == "0":
-        return None
-    if root is None:
-        # Follow the route cache: an explicit PHOTON_ROUTE_CACHE override
-        # (including "0" = no disk writes) governs the stream cache too —
-        # this cache is "beside the route cache" by contract.
-        base = os.environ.get("PHOTON_ROUTE_CACHE")
-        if base == "0":
-            return None
-        if base is None:
-            from photon_tpu.ops.vperm import _default_route_cache_root
+    from photon_tpu.utils.caches import resolve_cache_dir
 
-            base = _default_route_cache_root()
-        root = os.path.join(base, "stream")
-    return root
+    return resolve_cache_dir("PHOTON_STREAM_LAYOUT_CACHE", "stream")
 
 
 def _aux_cache_path(file_path: str, dim: int, kernel: str,

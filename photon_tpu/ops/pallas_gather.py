@@ -141,6 +141,90 @@ def build_row_aligned_layout(
     return _build_aligned_from_flat(flat_r, flat_f, flat_v, n, key_role="row")
 
 
+_LAYOUT_CACHE_VERSION = 1
+
+
+def _layout_cache_path(ids: np.ndarray, vals: np.ndarray, dim: int,
+                       transposed: bool):
+    """Disk-cache path for an aligned layout, or None when disabled or
+    below the size floor.  Layouts are pure functions of (ids, vals
+    zero-pattern and values, dim); at production scale the bin-packing
+    build costs tens of host-seconds per evaluation-window run, while a
+    content hash plus npz load costs ~1 s — the same economics as the
+    route cache, which this cache lives beside."""
+    import hashlib
+    import os
+
+    from photon_tpu.utils.env import env_int
+
+    from photon_tpu.utils.caches import resolve_cache_dir
+
+    if ids.size < env_int("PHOTON_LAYOUT_CACHE_FLOOR", 1 << 22, minimum=1):
+        return None  # small layouts rebuild faster than they hash+load
+    root = resolve_cache_dir("PHOTON_LAYOUT_CACHE", "layouts")
+    if root is None:
+        return None
+    h = hashlib.sha256()
+    h.update(repr(ids.shape).encode())
+    h.update(np.ascontiguousarray(ids).tobytes())
+    h.update(np.ascontiguousarray(vals, np.float32).tobytes())
+    # The transposed (row-dictionary) layout ignores ``dim`` — its
+    # dictionary is the row count, already covered by ids.shape — so dim
+    # stays out of that key (a dim sweep over one dataset would
+    # otherwise re-build and re-store byte-identical multi-MB entries).
+    h.update(
+        f"|{0 if transposed else dim}|{int(transposed)}"
+        f"|v{_LAYOUT_CACHE_VERSION}".encode()
+    )
+    return os.path.join(root, "lay_" + h.hexdigest()[:32] + ".npz")
+
+
+def load_or_build_aligned_layout(
+    ids: np.ndarray, vals: np.ndarray, dim: int, transposed: bool = False
+) -> AlignedLayout:
+    """:func:`build_aligned_layout` / :func:`build_row_aligned_layout`
+    behind the content-keyed disk cache."""
+    import logging
+    import os
+
+    ids = np.asarray(ids)
+    vals = np.asarray(vals, np.float32)
+    path = _layout_cache_path(ids, vals, dim, transposed)
+    if path is not None and os.path.exists(path):
+        try:
+            with np.load(path) as z:
+                return AlignedLayout(
+                    lo=z["lo"], vals=z["vals"], rows=z["rows"],
+                    slab_of_tile=z["slab_of_tile"], dup_map=z["dup_map"],
+                    src=z["src"], n_entries=int(z["n_entries"]),
+                )
+        except Exception as exc:  # noqa: BLE001 — corrupt cache = rebuild
+            logging.getLogger("photon_tpu.pallas_gather").warning(
+                "layout cache read failed (%s); rebuilding", exc
+            )
+    layout = (
+        build_row_aligned_layout(ids, vals) if transposed
+        else build_aligned_layout(ids, vals, dim)
+    )
+    if path is not None:
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                np.savez(
+                    f, lo=layout.lo, vals=layout.vals, rows=layout.rows,
+                    slab_of_tile=layout.slab_of_tile,
+                    dup_map=layout.dup_map, src=layout.src,
+                    n_entries=np.int64(layout.n_entries),
+                )
+            os.replace(tmp, path)
+        except Exception as exc:  # noqa: BLE001 — best-effort cache
+            logging.getLogger("photon_tpu.pallas_gather").warning(
+                "layout cache write failed (%s)", exc
+            )
+    return layout
+
+
 def _build_aligned_from_flat(
     flat_key: np.ndarray,
     flat_payload: np.ndarray,

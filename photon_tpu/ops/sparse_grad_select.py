@@ -129,8 +129,8 @@ def _measure(e: int, d: int, n: int, with_pallas: bool,
         from photon_tpu.ops.pallas_gather import (
             aligned_grad_reference,
             aligned_segment_grad,
-            build_aligned_layout,
             device_layout,
+            load_or_build_aligned_layout,
         )
 
         # Probe on the same entry population, reshaped to the batch's [n, k]
@@ -140,7 +140,7 @@ def _measure(e: int, d: int, n: int, with_pallas: bool,
         # size and keeps one code path.)
         k = max(e // max(n, 1), 1)
         n_probe = e // k
-        layout = build_aligned_layout(
+        layout = load_or_build_aligned_layout(
             flat_ids[: n_probe * k].reshape(n_probe, k),
             np.asarray(vals)[: n_probe * k].reshape(n_probe, k),
             d,

@@ -915,23 +915,13 @@ def apply_balanced(x: Array, route: BalancedRoute,
 _ROUTE_CACHE_VERSION = {"aligned": 2, "cumsum": 3}
 
 
-@functools.lru_cache(maxsize=1)
 def _default_route_cache_root() -> str:
-    """Resolve the default cache root ONCE per process: back-compat
-    honors an existing CWD cache (pre-round-5 default, and how this
-    host's pre-built production routes are stored); otherwise route
-    files stay out of the working directory (ADVICE r4) under the
-    conventional user cache root.  Memoized so a mid-process chdir
-    cannot flip the location and split the cache across two roots
-    (the PHOTON_ROUTE_CACHE env override is still read per call)."""
-    import os
+    """Back-compat alias — the shared resolution lives in
+    photon_tpu.utils.caches (one contract for route/layout/stream
+    caches)."""
+    from photon_tpu.utils.caches import default_route_cache_root
 
-    legacy = os.path.abspath(".photon_route_cache")
-    if os.path.isdir(legacy):
-        return legacy
-    return os.path.join(
-        os.path.expanduser("~"), ".cache", "photon_tpu", "routes"
-    )
+    return default_route_cache_root()
 
 
 def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
@@ -953,10 +943,10 @@ def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
     import hashlib
     import os
 
-    root = os.environ.get("PHOTON_ROUTE_CACHE")
+    from photon_tpu.utils.caches import resolve_cache_dir
+
+    root = resolve_cache_dir(None, "")
     if root is None:
-        root = _default_route_cache_root()
-    if root == "0":
         return None
     h = hashlib.sha256()
     h.update(repr(ids.shape).encode())

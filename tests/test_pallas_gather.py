@@ -150,3 +150,65 @@ def test_row_aligned_layout_edge_cases():
         z = np.asarray(aligned_segment_grad(w, al_t, n, interpret=True))
         z_ref = (np.asarray(w)[ids] * vals).sum(axis=1)
         np.testing.assert_allclose(z, z_ref, rtol=2e-5, atol=1e-6)
+
+
+def test_layout_cache_round_trip(monkeypatch, tmp_path):
+    """The content-keyed aligned-layout disk cache must reproduce the
+    built layout exactly (both directions), miss on changed values, and
+    stay inert below the size floor."""
+    import numpy as np
+
+    from photon_tpu.ops.pallas_gather import (
+        AlignedLayout,
+        load_or_build_aligned_layout,
+    )
+
+    monkeypatch.setenv("PHOTON_LAYOUT_CACHE", str(tmp_path))
+    monkeypatch.setenv("PHOTON_LAYOUT_CACHE_FLOOR", "1")
+    rng = np.random.default_rng(5)
+    n, k, dim = 512, 8, 256
+    ids = rng.integers(1, dim, size=(n, k)).astype(np.int32)
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    for transposed in (False, True):
+        first = load_or_build_aligned_layout(ids, vals, dim,
+                                             transposed=transposed)
+        second = load_or_build_aligned_layout(ids, vals, dim,
+                                              transposed=transposed)
+        for field in AlignedLayout.__dataclass_fields__:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(first, field)),
+                np.asarray(getattr(second, field)),
+            )
+    import os
+
+    n_files = len(os.listdir(tmp_path))
+    assert n_files == 2  # one per direction
+    # Different values -> different key (the layout drops val==0 slots).
+    load_or_build_aligned_layout(ids, 2.0 * vals, dim)
+    assert len(os.listdir(tmp_path)) == 3
+    # Floor: small layouts skip the cache entirely.
+    monkeypatch.setenv("PHOTON_LAYOUT_CACHE_FLOOR", str(1 << 22))
+    load_or_build_aligned_layout(ids, 3.0 * vals, dim)
+    assert len(os.listdir(tmp_path)) == 3
+
+
+def test_layout_cache_hit_skips_builder(monkeypatch, tmp_path):
+    """A cache HIT must not invoke the builder — a broken load silently
+    falling back to rebuild would keep every equality test green while
+    the cache is permanently dead."""
+    import numpy as np
+
+    import photon_tpu.ops.pallas_gather as pg
+
+    monkeypatch.setenv("PHOTON_LAYOUT_CACHE", str(tmp_path))
+    monkeypatch.setenv("PHOTON_LAYOUT_CACHE_FLOOR", "1")
+    rng = np.random.default_rng(6)
+    ids = rng.integers(1, 128, size=(256, 4)).astype(np.int32)
+    vals = rng.standard_normal((256, 4)).astype(np.float32)
+    pg.load_or_build_aligned_layout(ids, vals, 128)
+
+    def boom(*a, **k):
+        raise AssertionError("builder invoked on a cache hit")
+
+    monkeypatch.setattr(pg, "build_aligned_layout", boom)
+    pg.load_or_build_aligned_layout(ids, vals, 128)

@@ -26,7 +26,7 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    from photon_tpu.ops.pallas_gather import build_aligned_layout
+    from photon_tpu.ops.pallas_gather import load_or_build_aligned_layout
     from photon_tpu.ops.vperm import build_xchg_aux
 
     log2_e = int(sys.argv[1]) if len(sys.argv) > 1 else 25
@@ -47,7 +47,7 @@ def main() -> None:
         os.environ["PHOTON_XCHG_REDUCE"] = mode
         if mode != "cumsum" and layout is None:
             t0 = time.perf_counter()
-            layout = build_aligned_layout(ids2d, vals2d, d)
+            layout = load_or_build_aligned_layout(ids2d, vals2d, d)
             print(f"layout build: {time.perf_counter() - t0:.1f}s")
         t0 = time.perf_counter()
         build_xchg_aux(
