@@ -322,12 +322,18 @@ def attach_feature_major(
                 want_xchg=want_xchg, order=order,
                 geometry_gather=geometry_gather,
             )
-        layout = load_or_build_aligned_layout(ids_np, vals_np, aligned_dim)
+        from photon_tpu.ops.pallas_gather import layout_content_hash
+
+        base_hash = layout_content_hash(ids_np, vals_np)
+        layout = load_or_build_aligned_layout(
+            ids_np, vals_np, aligned_dim, base_hash=base_hash
+        )
         batch = batch._replace(al=device_layout(layout))
         if aligned_forward:
             batch = batch._replace(al_t=device_layout(
                 load_or_build_aligned_layout(
-                    ids_np, vals_np, aligned_dim, transposed=True
+                    ids_np, vals_np, aligned_dim, transposed=True,
+                    base_hash=base_hash,
                 )
             ))
         if want_xchg:
@@ -403,16 +409,24 @@ def _attach_aligned_sharded(
     ns = n // shards
     ids_blocks = ids_np.reshape(shards, ns, k)
     vals_blocks = vals_np.reshape(shards, ns, k)
+    from photon_tpu.ops.pallas_gather import layout_content_hash
+
+    base_hashes = [
+        layout_content_hash(ids_blocks[s], vals_blocks[s])
+        for s in range(shards)
+    ]
     layouts = [
         load_or_build_aligned_layout(
-            ids_blocks[s], vals_blocks[s], aligned_dim
+            ids_blocks[s], vals_blocks[s], aligned_dim,
+            base_hash=base_hashes[s],
         )
         for s in range(shards)
     ]
     layouts_t = (
         [
             load_or_build_aligned_layout(
-                ids_blocks[s], vals_blocks[s], aligned_dim, transposed=True
+                ids_blocks[s], vals_blocks[s], aligned_dim,
+                transposed=True, base_hash=base_hashes[s],
             )
             for s in range(shards)
         ]

@@ -144,8 +144,23 @@ def build_row_aligned_layout(
 _LAYOUT_CACHE_VERSION = 1
 
 
+def layout_content_hash(ids: np.ndarray, vals: np.ndarray):
+    """Base sha256 over the layout-determining array content (shape +
+    ids + f32 vals).  Computed ONCE per (ids, vals) and ``copy()``-ed
+    per direction by :func:`_layout_cache_path` — at production scale
+    the content hash is the dominant hit-path cost, and the gradient +
+    transposed layouts share it."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(repr(ids.shape).encode())
+    h.update(np.ascontiguousarray(ids).tobytes())
+    h.update(np.ascontiguousarray(vals, np.float32).tobytes())
+    return h
+
+
 def _layout_cache_path(ids: np.ndarray, vals: np.ndarray, dim: int,
-                       transposed: bool):
+                       transposed: bool, base_hash=None):
     """Disk-cache path for an aligned layout, or None when disabled or
     below the size floor.  Layouts are pure functions of (ids, vals
     zero-pattern and values, dim); at production scale the bin-packing
@@ -164,10 +179,7 @@ def _layout_cache_path(ids: np.ndarray, vals: np.ndarray, dim: int,
     root = resolve_cache_dir("PHOTON_LAYOUT_CACHE", "layouts")
     if root is None:
         return None
-    h = hashlib.sha256()
-    h.update(repr(ids.shape).encode())
-    h.update(np.ascontiguousarray(ids).tobytes())
-    h.update(np.ascontiguousarray(vals, np.float32).tobytes())
+    h = (base_hash or layout_content_hash(ids, vals)).copy()
     # The transposed (row-dictionary) layout ignores ``dim`` — its
     # dictionary is the row count, already covered by ids.shape — so dim
     # stays out of that key (a dim sweep over one dataset would
@@ -180,16 +192,19 @@ def _layout_cache_path(ids: np.ndarray, vals: np.ndarray, dim: int,
 
 
 def load_or_build_aligned_layout(
-    ids: np.ndarray, vals: np.ndarray, dim: int, transposed: bool = False
+    ids: np.ndarray, vals: np.ndarray, dim: int, transposed: bool = False,
+    base_hash=None,
 ) -> AlignedLayout:
     """:func:`build_aligned_layout` / :func:`build_row_aligned_layout`
-    behind the content-keyed disk cache."""
+    behind the content-keyed disk cache.  ``base_hash`` (from
+    :func:`layout_content_hash`) lets a caller building BOTH directions
+    pay the content hash once."""
     import logging
     import os
 
     ids = np.asarray(ids)
     vals = np.asarray(vals, np.float32)
-    path = _layout_cache_path(ids, vals, dim, transposed)
+    path = _layout_cache_path(ids, vals, dim, transposed, base_hash)
     if path is not None and os.path.exists(path):
         try:
             with np.load(path) as z:

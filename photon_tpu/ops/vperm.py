@@ -945,7 +945,7 @@ def _route_cache_path(ids: np.ndarray, dim: int, mode: str, layout,
 
     from photon_tpu.utils.caches import resolve_cache_dir
 
-    root = resolve_cache_dir(None, "")
+    root = resolve_cache_dir("PHOTON_ROUTE_CACHE", "")
     if root is None:
         return None
     h = hashlib.sha256()

@@ -33,20 +33,21 @@ def default_route_cache_root() -> str:
     )
 
 
-def resolve_cache_dir(env_name: Optional[str], subdir: str) -> Optional[str]:
+def resolve_cache_dir(env_name: str, subdir: str) -> Optional[str]:
     """The directory a named cache should use, or None when disabled.
 
     ``env_name`` (when set in the environment) overrides; its value
     ``"0"`` disables.  Otherwise the cache follows ``PHOTON_ROUTE_CACHE``
     (same ``"0"`` semantics) into ``<route root>/<subdir>`` — with
-    ``subdir == ""`` meaning the route root itself.
+    ``subdir == ""`` meaning the route root itself (how the route cache
+    resolves its own root: an explicit override and the followed root
+    coincide there).
     """
-    if env_name is not None:
-        root = os.environ.get(env_name)
-        if root == "0":
-            return None
-        if root is not None:
-            return root
+    root = os.environ.get(env_name)
+    if root == "0":
+        return None
+    if root is not None:
+        return root  # explicit override: use as-is
     base = os.environ.get("PHOTON_ROUTE_CACHE")
     if base == "0":
         return None

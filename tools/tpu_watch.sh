@@ -3,11 +3,17 @@
 # Round-4 windows lasted 8-13 minutes and arrived unannounced — an
 # unattended watcher is the only way not to miss one.  Probe is a
 # bounded subprocess (the axon backend init HANGS, not errors, when the
-# tunnel is down).  Exits after one successful pack so the operator (or
-# agent) is notified exactly once per window.
+# tunnel is down).
+#
+# After a pack completes the PREVIOUS pack's outputs are archived to
+# tools/tpu_day_out_<utc-stamp>/ and the watcher keeps watching — a
+# round can catch several windows (round 4 saw three) without the
+# second pack clobbering the first window's evidence.  Pass a second
+# argument "once" for the old exit-after-one-pack behavior.
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${1:-300}"
+MODE="${2:-loop}"
 while true; do
     rm -f "${TMPDIR:-/tmp}/photon_bench_backend_probe.json"
     if timeout 120 python -c "
@@ -16,9 +22,27 @@ assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
 print('tpu up')
 " >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) tunnel up — running pack"
-        bash tools/tpu_day.sh
-        exit 0
+        if bash tools/tpu_day.sh; then
+            echo "$(date -u +%H:%M:%S) pack finished"
+            if [ "$MODE" = "once" ]; then
+                exit 0
+            fi
+            # Archive only COMPLETED packs: an aborted pack (backend
+            # gate failed mid-window) leaves a stub that must not be
+            # stamped as window evidence — the next attempt overwrites
+            # it in place instead.
+            if [ -d tools/tpu_day_out ]; then
+                stamp=$(date -u +%m%d_%H%M%S)
+                mv tools/tpu_day_out "tools/tpu_day_out_${stamp}"
+                echo "$(date -u +%H:%M:%S) archived pack to" \
+                     "tpu_day_out_${stamp}; watching for the next window"
+            fi
+        else
+            echo "$(date -u +%H:%M:%S) pack aborted (backend gate or" \
+                 "mid-run failure); will retry on the next probe"
+        fi
+    else
+        echo "$(date -u +%H:%M:%S) tunnel down; sleeping ${INTERVAL}s"
     fi
-    echo "$(date -u +%H:%M:%S) tunnel down; sleeping ${INTERVAL}s"
     sleep "$INTERVAL"
 done
