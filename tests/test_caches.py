@@ -1,0 +1,40 @@
+"""The shared disk-cache root contract (utils/caches.py): one precedence
+rule for the route, stream-layout, and aligned-layout caches."""
+
+import os
+
+from photon_tpu.utils.caches import resolve_cache_dir
+
+
+def test_explicit_override_wins(monkeypatch):
+    monkeypatch.setenv("PHOTON_LAYOUT_CACHE", "/tmp/somewhere")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "/tmp/elsewhere")
+    assert resolve_cache_dir("PHOTON_LAYOUT_CACHE", "layouts") == "/tmp/somewhere"
+
+
+def test_zero_disables(monkeypatch):
+    monkeypatch.setenv("PHOTON_LAYOUT_CACHE", "0")
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "/tmp/elsewhere")
+    assert resolve_cache_dir("PHOTON_LAYOUT_CACHE", "layouts") is None
+
+
+def test_follows_route_cache(monkeypatch):
+    monkeypatch.delenv("PHOTON_LAYOUT_CACHE", raising=False)
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "/tmp/routes")
+    assert resolve_cache_dir("PHOTON_LAYOUT_CACHE", "layouts") == os.path.join(
+        "/tmp/routes", "layouts"
+    )
+
+
+def test_route_zero_disables_followers(monkeypatch):
+    monkeypatch.delenv("PHOTON_STREAM_LAYOUT_CACHE", raising=False)
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    assert resolve_cache_dir("PHOTON_STREAM_LAYOUT_CACHE", "stream") is None
+
+
+def test_route_cache_resolves_own_root(monkeypatch):
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "/tmp/routes")
+    assert resolve_cache_dir("PHOTON_ROUTE_CACHE", "") == "/tmp/routes"
+    monkeypatch.delenv("PHOTON_ROUTE_CACHE", raising=False)
+    root = resolve_cache_dir("PHOTON_ROUTE_CACHE", "")
+    assert root is not None  # default root (memoized per process)
