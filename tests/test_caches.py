@@ -38,3 +38,35 @@ def test_route_cache_resolves_own_root(monkeypatch):
     monkeypatch.delenv("PHOTON_ROUTE_CACHE", raising=False)
     root = resolve_cache_dir("PHOTON_ROUTE_CACHE", "")
     assert root is not None  # default root (memoized per process)
+
+
+def test_override_wins_even_when_route_cache_disabled(monkeypatch):
+    """Precedence order regression guard: a follower's explicit override
+    must win even with PHOTON_ROUTE_CACHE=0 (the suite's own global
+    default) — checking the route sentinel first would wrongly disable
+    an explicitly enabled cache."""
+    monkeypatch.setenv("PHOTON_ROUTE_CACHE", "0")
+    monkeypatch.setenv("PHOTON_LAYOUT_CACHE", "/tmp/explicit")
+    assert resolve_cache_dir("PHOTON_LAYOUT_CACHE", "layouts") == "/tmp/explicit"
+
+
+def test_default_root_location(monkeypatch, tmp_path):
+    """The default root must honor an existing CWD legacy cache, else
+    fall under ~/.cache (the ADVICE-r4 no-CWD-pollution contract) —
+    'is not None' alone would let a wrong location regress silently."""
+    from photon_tpu.utils import caches
+
+    monkeypatch.delenv("PHOTON_ROUTE_CACHE", raising=False)
+    caches.default_route_cache_root.cache_clear()
+    monkeypatch.chdir(tmp_path)  # no legacy dir here
+    try:
+        assert caches.default_route_cache_root() == os.path.join(
+            os.path.expanduser("~"), ".cache", "photon_tpu", "routes"
+        )
+        caches.default_route_cache_root.cache_clear()
+        os.makedirs(tmp_path / ".photon_route_cache")
+        assert caches.default_route_cache_root() == str(
+            tmp_path / ".photon_route_cache"
+        )
+    finally:
+        caches.default_route_cache_root.cache_clear()
