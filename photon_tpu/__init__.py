@@ -26,6 +26,8 @@ split — see SURVEY.md §1):
 - :mod:`photon_tpu.evaluation` — evaluators (AUC, RMSE, …) ≙ evaluation
 - :mod:`photon_tpu.drivers`    — CLI train/score drivers ≙ photon-client
 - :mod:`photon_tpu.ops`        — Pallas TPU kernels for hot ops
+- :mod:`photon_tpu.telemetry`  — metrics registry, tracing spans, run
+                                 reports ≙ driver logs / Spark UI
 """
 
 __version__ = "0.1.0"

@@ -115,6 +115,31 @@ class OptimizationStatesTracker:
         (SURVEY.md §5 tracing)."""
         return [[float(v), float(g)] for _, v, g in self]
 
+    def record_to(self, registry, **labels) -> None:
+        """Push this run's summary into a telemetry metrics registry
+        (photon_tpu.telemetry; duck-typed so the optimizer layer stays
+        import-free of it): solve counts, iteration totals, a stop-reason
+        breakdown, solve-seconds distribution, and final value/|grad|."""
+        labels = {k: str(v) for k, v in labels.items()}
+        registry.counter("optimizer.solves", **labels).inc()
+        registry.counter("optimizer.iterations", **labels).inc(self.iterations)
+        if self.converged:
+            registry.counter("optimizer.converged_solves", **labels).inc()
+        registry.counter(
+            "optimizer.stop_reason", reason=self.convergence_reason, **labels
+        ).inc()
+        if self.wall_time_s is not None:
+            registry.histogram("optimizer.solve_seconds", **labels).observe(
+                self.wall_time_s
+            )
+        if len(self.values):
+            registry.gauge("optimizer.final_value", **labels).set(
+                float(self.values[-1])
+            )
+            registry.gauge("optimizer.final_grad_norm", **labels).set(
+                float(self.grad_norms[-1])
+            )
+
     def summary(self) -> str:
         lines = [
             f"iterations={self.iterations} converged={self.converged} "

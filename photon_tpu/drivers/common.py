@@ -10,10 +10,14 @@ IO paths) plus ``--backend=tpu|cpu``.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
+import time
 from typing import Optional
 
 import numpy as np
+
+from photon_tpu.telemetry import NULL_SESSION, TelemetrySession, telemetry_enabled
 
 
 def select_backend(backend: str) -> None:
@@ -43,6 +47,18 @@ def _enable_compilation_cache() -> None:
     )
 
 
+def add_telemetry_arg(parser: argparse.ArgumentParser) -> None:
+    """The one definition of ``--telemetry`` (drivers that skip
+    add_common_args — index_features — reuse it, so flag/default/gate
+    text cannot diverge)."""
+    parser.add_argument("--telemetry", action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="write structured telemetry (metrics registry "
+                        "snapshot, tracing spans, run report) under "
+                        "<output-dir>/telemetry/; PHOTON_TELEMETRY=off "
+                        "disables process-wide")
+
+
 def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--backend", choices=("tpu", "cpu"), default="tpu",
                         help="compute platform (tpu uses the environment's "
@@ -51,6 +67,7 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--log-file", default=None)
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the train phase")
+    add_telemetry_arg(parser)
 
 
 def add_distributed_args(parser: argparse.ArgumentParser) -> None:
@@ -111,6 +128,42 @@ def maybe_init_distributed(args: argparse.Namespace) -> bool:
     return True
 
 
+def init_telemetry(args: argparse.Namespace, driver: str, logger) -> TelemetrySession:
+    """One telemetry session per driver run, attached to the logger so
+    every ``timed()`` phase becomes a span."""
+    session = TelemetrySession(
+        driver, enabled=telemetry_enabled(getattr(args, "telemetry", None))
+    )
+    session.attach(logger)
+    return session
+
+
+@contextlib.contextmanager
+def telemetry_run(args: argparse.Namespace, driver: str, logger):
+    """Run-report bracket around a driver body: yields the session, then
+    finalizes it into ``<output-dir>/telemetry/`` — with status "error" and
+    the exception recorded when the body raises (failed runs leave a report
+    saying where they died, the observability the reference gets from
+    trawling driver logs).  Bodies of multi-process drivers set
+    ``session.write = (process_index == 0)`` once they know their rank;
+    until then the operator-declared ``--process-id`` gates writing, so a
+    failure before that point (bad input path on every rank) cannot have N
+    processes concurrently writing the same run_report.json."""
+    session = init_telemetry(args, driver, logger)
+    if getattr(args, "coordinator", None) is not None:
+        session.write = (getattr(args, "process_id", None) or 0) == 0
+    try:
+        yield session
+    except BaseException as e:
+        session.finalize(
+            getattr(args, "output_dir", None), status="error",
+            error=f"{type(e).__name__}: {e}",
+        )
+        raise
+    else:
+        session.finalize(getattr(args, "output_dir", None))
+
+
 def add_data_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--input", required=True,
                         help="training data: a LIBSVM file path, or "
@@ -135,7 +188,7 @@ from photon_tpu.core.losses import BINARY_TASKS  # noqa: E402  (single source)
 
 
 def stream_score_parts(input_spec, load_chunk, score_chunk, scores_path,
-                       logger, on_chunk=None) -> int:
+                       logger, on_chunk=None, telemetry=None) -> int:
     """Shared file-at-a-time scoring skeleton for the ``--stream`` modes of
     both scoring drivers (legacy ``score`` and ``score_game``): list the
     part files FIRST (no spurious empty scores.txt on a bad glob), skip
@@ -151,32 +204,52 @@ def stream_score_parts(input_spec, load_chunk, score_chunk, scores_path,
         narrow_avro_dir,
     )
 
+    t = telemetry or NULL_SESSION
     files = _input_files(narrow_avro_dir(input_spec))
     n = 0
-    with open(scores_path, "w") as out_f:
+    t0 = time.monotonic()
+    with open(scores_path, "w") as out_f, \
+            t.span("stream-score", files=len(files)):
         for path in files:
-            with logger.timed(f"score-{os.path.basename(path)}"):
+            # span=False: one retained Span per part file would grow the
+            # run report unboundedly on exactly the beyond-host-memory
+            # datasets --stream exists for; the stream.* histograms carry
+            # the per-chunk timing distribution instead, and the single
+            # stream-score span above carries the loop's wall-clock.
+            with logger.timed(f"score-{os.path.basename(path)}", span=False):
+                chunk_t0 = time.monotonic()
                 try:
                     chunk = load_chunk(path)
                 except NoRecordsError:
                     # Part layouts routinely contain empty parts; only a
                     # zero-row TOTAL is an error (below).
                     logger.info("skipping empty part %s", path)
+                    t.counter("stream.chunks_skipped_empty").inc()
                     continue
                 if getattr(chunk, "num_examples", None) == 0:
                     # Loaders that return a 0-row batch instead of raising
                     # (the LIBSVM path) get the same skip-empty contract as
                     # Avro's NoRecordsError (ADVICE r3).
                     logger.info("skipping empty part %s", path)
+                    t.counter("stream.chunks_skipped_empty").inc()
                     continue
                 raw, out, real_n = score_chunk(chunk)
                 np.savetxt(out_f, out, fmt="%.8g")
                 if on_chunk is not None:
                     on_chunk(chunk, raw)
                 n += real_n
+                t.counter("stream.chunks_scored").inc()
+                t.counter("stream.rows_scored").inc(real_n)
+                t.histogram("stream.chunk_rows").observe(real_n)
+                t.histogram("stream.chunk_seconds").observe(
+                    time.monotonic() - chunk_t0
+                )
                 del chunk, raw, out
     if n == 0:
         raise NoRecordsError(f"no rows in {input_spec!r}")
+    wall = time.monotonic() - t0
+    if wall > 0:
+        t.gauge("stream.rows_per_second").set(n / wall)
     return n
 
 
@@ -305,7 +378,7 @@ def scores_on(batch, model) -> np.ndarray:
 
 def select_and_save_sweep(
     sweep: list, evaluators, has_validation: bool, index_map, args, logger,
-    extra_summary: Optional[dict] = None,
+    extra_summary: Optional[dict] = None, telemetry=None,
 ) -> dict:
     """Shared tail of the GLM training drivers: pick the best lambda (by
     primary evaluator, falling back to final objective value), save model
@@ -314,6 +387,7 @@ def select_and_save_sweep(
 
     from photon_tpu.data.model_io import save_glm_model
 
+    t = telemetry or NULL_SESSION
     primary = evaluators.primary
     if has_validation:
         best = sweep[0]
@@ -353,6 +427,10 @@ def select_and_save_sweep(
         with open(os.path.join(args.output_dir, "training_summary.json"), "w") as f:
             json.dump(summary_payload, f, indent=1)
         write_diagnostic_reports(sweep, best, args.output_dir)
+    t.counter("train.sweep_entries").inc(len(sweep))
+    t.gauge("train.best_lambda").set(best["lambda"])
+    for name, value in (best.get("metrics") or {}).items():
+        t.gauge("train.best_metric", metric=name).set(value)
     logger.info("best lambda=%g -> %s/best_model.%s",
                 best["lambda"], args.output_dir, ext)
     return summary_payload

@@ -39,16 +39,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mmap additionally writes the native off-heap store "
                    "(PalDB equivalent)")
     p.add_argument("--log-file", default=None)
+    common.add_telemetry_arg(p)
     return p
 
 
 def run(args: argparse.Namespace) -> dict:
-    from photon_tpu.data import avro_codec
-    from photon_tpu.data.game_io import _input_files
-    from photon_tpu.data.index_map import INTERCEPT_KEY, IndexMap, feature_key
     from photon_tpu.utils import PhotonLogger
 
     logger = PhotonLogger("photon_tpu.index_features", args.log_file)
+    with common.telemetry_run(args, "index_features", logger) as session:
+        return _run(args, logger, session)
+
+
+def _run(args: argparse.Namespace, logger, session) -> dict:
+    from photon_tpu.data import avro_codec
+    from photon_tpu.data.game_io import _input_files
+    from photon_tpu.data.index_map import INTERCEPT_KEY, IndexMap, feature_key
+
     os.makedirs(args.output_dir, exist_ok=True)
     bags = dict(tok.split("=", 1) for tok in args.feature_bags.split(","))
 
@@ -67,6 +74,7 @@ def run(args: argparse.Namespace) -> dict:
                         if key != INTERCEPT_KEY:  # implicit on read
                             seen.setdefault(key, None)
 
+    session.counter("index.records_scanned").inc(n_records)
     summary = {"num_records": n_records, "shards": {}}
     with logger.timed("write"):
         for shard, seen in key_order.items():
@@ -87,6 +95,7 @@ def run(args: argparse.Namespace) -> dict:
                 ).close()
                 entry["mmap"] = store_path
             summary["shards"][shard] = entry
+            session.gauge("index.num_features", shard=shard).set(len(imap))
             logger.info("shard %s: %d features", shard, len(imap))
     with open(os.path.join(args.output_dir, "indexing_summary.json"), "w") as f:
         json.dump(summary, f, indent=1)

@@ -37,11 +37,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(args: argparse.Namespace) -> dict:
     common.select_backend(args.backend)
-    from photon_tpu.data.index_map import IndexMap
-    from photon_tpu.data.model_io import load_glm_model
     from photon_tpu.utils import PhotonLogger
 
     logger = PhotonLogger("photon_tpu.score", args.log_file)
+    with common.telemetry_run(args, "score", logger) as session:
+        return _run(args, logger, session)
+
+
+def _run(args: argparse.Namespace, logger, session) -> dict:
+    from photon_tpu.data.index_map import IndexMap
+    from photon_tpu.data.model_io import load_glm_model
+
     os.makedirs(args.output_dir, exist_ok=True)
 
     imap_path = args.index_map or os.path.join(
@@ -109,7 +115,7 @@ def run(args: argparse.Namespace) -> dict:
         n = common.stream_score_parts(
             args.input, load_chunk,
             lambda b: (*score_chunk(b), b.num_examples),
-            scores_path, logger, on_chunk,
+            scores_path, logger, on_chunk, telemetry=session,
         )
         raw_scores = labels = weights = None
         if evaluators is not None:
@@ -128,10 +134,14 @@ def run(args: argparse.Namespace) -> dict:
 
     metrics = {}
     if evaluators is not None:
-        metrics = evaluators.evaluate(raw_scores, labels, weights)
+        with logger.timed("evaluate"):
+            metrics = evaluators.evaluate(raw_scores, labels, weights)
         logger.info("metrics %s", metrics)
         with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
             json.dump(metrics, f, indent=1)
+    session.gauge("score.num_scored").set(n)
+    for name, value in metrics.items():
+        session.gauge("score.metric", metric=name).set(value)
     return {"num_scored": n, "metrics": metrics, "streamed": bool(args.stream)}
 
 

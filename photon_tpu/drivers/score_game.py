@@ -49,14 +49,19 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _evaluate_and_dump(args, logger, scores, label, weight, id_columns) -> dict:
+def _evaluate_and_dump(args, logger, scores, label, weight, id_columns,
+                       session=None) -> dict:
     """Shared evaluator + metrics.json tail of both scoring paths."""
     from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
 
     evaluators = MultiEvaluator(
         [get_evaluator(s) for s in args.evaluators.split(",")]
     )
-    metrics = evaluators.evaluate(scores, label, weight, id_columns)
+    with logger.timed("evaluate"):
+        metrics = evaluators.evaluate(scores, label, weight, id_columns)
+    if session is not None:
+        for name, value in metrics.items():
+            session.gauge("score.metric", metric=name).set(value)
     logger.info("metrics %s", metrics)
     with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
         json.dump(metrics, f, indent=1)
@@ -107,7 +112,7 @@ def _pad_pow2_rows(chunk):
     ), n
 
 
-def _run_streaming(args, model, index_maps, logger) -> dict:
+def _run_streaming(args, model, index_maps, logger, session) -> dict:
     """File-at-a-time scoring: each part file becomes a chunk dataset indexed
     through the model's maps, is scored, and its features are dropped before
     the next file loads — the scoring analog of the legacy GLM driver's
@@ -153,7 +158,9 @@ def _run_streaming(args, model, index_maps, logger) -> dict:
     n = common.stream_score_parts(
         args.input, load_chunk, score_chunk,
         os.path.join(args.output_dir, "scores.txt"), logger, on_chunk,
+        telemetry=session,
     )
+    session.gauge("score.num_scored").set(n)
 
     metrics = {}
     if args.evaluators:
@@ -163,16 +170,23 @@ def _run_streaming(args, model, index_maps, logger) -> dict:
             np.concatenate(label_chunks),
             np.concatenate(weight_chunks),
             {c: np.concatenate(v) for c, v in ids_chunks.items()},
+            session=session,
         )
     return {"num_scored": n, "metrics": metrics, "streamed": True}
 
 
 def run(args: argparse.Namespace) -> dict:
     common.select_backend(args.backend)
-    from photon_tpu.game.model_io import load_game_model
     from photon_tpu.utils import PhotonLogger
 
     logger = PhotonLogger("photon_tpu.score_game", args.log_file)
+    with common.telemetry_run(args, "score_game", logger) as session:
+        return _run(args, logger, session)
+
+
+def _run(args: argparse.Namespace, logger, session) -> dict:
+    from photon_tpu.game.model_io import load_game_model
+
     os.makedirs(args.output_dir, exist_ok=True)
 
     with logger.timed("load-model"):
@@ -183,13 +197,14 @@ def run(args: argparse.Namespace) -> dict:
         )
 
     if args.stream:
-        return _run_streaming(args, model, index_maps, logger)
+        return _run_streaming(args, model, index_maps, logger, session)
 
     with logger.timed("load-data"):
         # Index scoring features through the model's training-time maps —
         # unseen features drop, matching the reference's fixed-index scoring.
         data, _ = _load_game_data(args.input, args, index_maps=index_maps)
         logger.info("scoring %d examples", data.num_examples)
+        session.gauge("score.num_scored").set(data.num_examples)
 
     with logger.timed("score"):
         raw_scores = model.score(data)
@@ -209,7 +224,7 @@ def run(args: argparse.Namespace) -> dict:
     if args.evaluators:
         metrics = _evaluate_and_dump(
             args, logger, raw_scores, data.label, data.weight,
-            dict(data.id_columns),
+            dict(data.id_columns), session=session,
         )
     return {"num_scored": int(data.num_examples), "metrics": metrics}
 

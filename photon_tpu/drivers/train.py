@@ -78,7 +78,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _run_streaming(args: argparse.Namespace) -> dict:
+def _run_streaming(args: argparse.Namespace, logger, session) -> dict:
     """Host-streamed lambda sweep (data beyond device memory; lbfgs)."""
     import glob as globmod
 
@@ -99,9 +99,7 @@ def _run_streaming(args: argparse.Namespace) -> dict:
         default_evaluators_for_task,
     )
     from photon_tpu.models.glm import Coefficients, model_for_task
-    from photon_tpu.utils import PhotonLogger
 
-    logger = PhotonLogger("photon_tpu.train", args.log_file)
     os.makedirs(args.output_dir, exist_ok=True)
     if args.normalization != "none":
         raise ValueError("--stream does not support --normalization")
@@ -131,6 +129,11 @@ def _run_streaming(args: argparse.Namespace) -> dict:
         len(source.files), len(files), source.num_examples, source.dim,
         source.capacity,
     )
+    # Multi-process: all ranks record metrics, only rank 0 writes artifacts.
+    session.write = jax.process_index() == 0
+    session.gauge("train.num_examples").set(source.num_examples)
+    session.gauge("train.num_features").set(source.dim)
+    session.gauge("train.stream_files").set(len(source.files))
     if args.data_validation != "off":
         # Streamed data must get the same validation as resident data
         # (ADVICE r1: the streaming path skipped it entirely): one extra
@@ -235,6 +238,7 @@ def _run_streaming(args: argparse.Namespace) -> dict:
         if args.sweep_warm_start:
             w_start = result.w
         tracker = OptimizationStatesTracker(result, wall)
+        tracker.record_to(session.registry, optimizer="lbfgs", lam=f"{lam:g}")
         logger.info("lambda=%g %s", lam, tracker.summary().splitlines()[0])
         model = model_for_task(args.task, Coefficients(result.w))
         metrics = {}
@@ -262,6 +266,7 @@ def _run_streaming(args: argparse.Namespace) -> dict:
     return common.select_and_save_sweep(
         sweep, evaluators, val_batch is not None, index_map, args, logger,
         extra_summary={"optimizer": "lbfgs", "streaming": True},
+        telemetry=session,
     )
 
 
@@ -269,17 +274,28 @@ def run(args: argparse.Namespace) -> dict:
     distributed = common.maybe_init_distributed(args)
     if not distributed:
         common.select_backend(args.backend)
-    if getattr(args, "stream", False):
-        return _run_streaming(args)
-    if distributed:
-        # The resident-data path has no work to split across processes —
-        # every rank would redundantly load the full dataset and race on
-        # the output files.  Multi-process GLM training is the streaming
-        # path's job (per-process file shards + cross-process gradient sum).
-        raise ValueError(
-            "--coordinator requires --stream for this driver (the resident-"
-            "data path is single-process; use --stream for multi-process)"
-        )
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.train", args.log_file)
+    with common.telemetry_run(args, "train", logger) as session:
+        if getattr(args, "stream", False):
+            return _run_streaming(args, logger, session)
+        if distributed:
+            # The resident-data path has no work to split across processes —
+            # every rank would redundantly load the full dataset and race on
+            # the output files.  Multi-process GLM training is the streaming
+            # path's job (per-process file shards + cross-process gradient
+            # sum).
+            raise ValueError(
+                "--coordinator requires --stream for this driver (the "
+                "resident-data path is single-process; use --stream for "
+                "multi-process)"
+            )
+        return _run_resident(args, logger, session)
+
+
+def _run_resident(args: argparse.Namespace, logger, session) -> dict:
+    """Device-resident lambda sweep (the default path)."""
     # Imports after backend pinning (device init happens on first jax use).
     import jax
 
@@ -294,10 +310,8 @@ def run(args: argparse.Namespace) -> dict:
     )
     from photon_tpu.models.glm import Coefficients, model_for_task
     from photon_tpu.parallel import DistributedGlmObjective, shard_batch
-    from photon_tpu.utils import PhotonLogger
     from photon_tpu.utils.logging import maybe_profile
 
-    logger = PhotonLogger("photon_tpu.train", args.log_file)
     os.makedirs(args.output_dir, exist_ok=True)
 
     with logger.timed("load-data"):
@@ -310,6 +324,8 @@ def run(args: argparse.Namespace) -> dict:
             avro_field=args.avro_feature_field, index_map=index_map,
         )
         logger.info("train: %d examples, %d features", batch.num_examples, dim)
+        session.gauge("train.num_examples").set(batch.num_examples)
+        session.gauge("train.num_features").set(dim)
 
     if args.data_validation != "off":
         from photon_tpu.data.validation import apply_validation, validate_batch
@@ -396,6 +412,7 @@ def run(args: argparse.Namespace) -> dict:
             # original-space conversion below works on copies).
             w_start = coefficients.means
         tracker = OptimizationStatesTracker(result, wall)
+        tracker.record_to(session.registry, optimizer=optimizer, lam=f"{lam:g}")
         logger.info("lambda=%g %s", lam, tracker.summary().splitlines()[0])
 
         # Store the model in the original feature space (variances too —
@@ -430,7 +447,7 @@ def run(args: argparse.Namespace) -> dict:
 
     return common.select_and_save_sweep(
         sweep, evaluators, val_batch is not None, index_map, args, logger,
-        extra_summary={"optimizer": optimizer},
+        extra_summary={"optimizer": optimizer}, telemetry=session,
     )
 
 

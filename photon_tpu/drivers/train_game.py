@@ -326,6 +326,14 @@ def parse_bags_and_id_columns(args) -> tuple[dict, list]:
 
 def run(args: argparse.Namespace) -> dict:
     common.maybe_init_distributed(args) or common.select_backend(args.backend)
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.train_game", args.log_file)
+    with common.telemetry_run(args, "train_game", logger) as session:
+        return _run(args, logger, session)
+
+
+def _run(args: argparse.Namespace, logger, session) -> dict:
     from photon_tpu.evaluation.evaluators import (
         MultiEvaluator,
         default_evaluators_for_task,
@@ -334,10 +342,8 @@ def run(args: argparse.Namespace) -> dict:
     from photon_tpu.game.data import split_game_dataset
     from photon_tpu.game.estimator import GameEstimator, GameOptimizationConfiguration
     from photon_tpu.game.model_io import load_game_model, save_game_model
-    from photon_tpu.utils import PhotonLogger
     from photon_tpu.utils.logging import maybe_profile
 
-    logger = PhotonLogger("photon_tpu.train_game", args.log_file)
     os.makedirs(args.output_dir, exist_ok=True)
     specs = _coordinate_specs(args)
 
@@ -376,6 +382,9 @@ def run(args: argparse.Namespace) -> dict:
             "train: %d examples, shards %s", data.num_examples,
             {n: s.dim for n, s in data.shards.items()},
         )
+        session.gauge("train.num_examples").set(data.num_examples)
+        for shard_name, shard in data.shards.items():
+            session.gauge("train.shard_dim", shard=shard_name).set(shard.dim)
 
     if args.data_validation != "off":
         from photon_tpu.data.validation import (
@@ -410,6 +419,7 @@ def run(args: argparse.Namespace) -> dict:
         evaluators=evaluators if val_data is not None else None,
         mesh=mesh,
         logger=logger,
+        telemetry=session,
     )
 
     import jax as _jax
@@ -418,6 +428,7 @@ def run(args: argparse.Namespace) -> dict:
     # summaries (the reference's driver-writes semantics; every rank still
     # participates in the collectives inside fit).
     is_primary = _jax.process_index() == 0
+    session.write = is_primary
 
     results = []
     checkpoint_fn = None
@@ -540,6 +551,8 @@ def run(args: argparse.Namespace) -> dict:
                     name=label,
                 ))
     best = estimator.select_best(results)
+    for name, value in best.metrics.items():
+        session.gauge("train.best_metric", metric=name).set(value)
     if not is_primary:
         return {"rank": _jax.process_index(), "best": best.configuration.name}
 

@@ -22,6 +22,7 @@ import numpy as np
 from photon_tpu.evaluation.evaluators import MultiEvaluator
 from photon_tpu.game.data import GameDataset
 from photon_tpu.game.model import GameModel
+from photon_tpu.telemetry import NULL_SESSION
 from photon_tpu.utils.logging import PhotonLogger
 
 
@@ -39,6 +40,26 @@ class DescentResult:
         return self.best_model is self.last_model
 
 
+def _record_coordinate_info(telemetry, name: str, info) -> None:
+    """Record a coordinate's convergence info into the telemetry registry.
+
+    Fixed-effect coordinates return an OptimizationStatesTracker (which
+    knows how to record itself); random-effect coordinates return a stats
+    dict over their per-entity vmapped solves."""
+    if hasattr(info, "record_to"):
+        info.record_to(telemetry.registry, coordinate=name)
+    elif isinstance(info, dict) and "entities" in info:
+        telemetry.counter("re_solver.entities", coordinate=name).inc(
+            info["entities"]
+        )
+        telemetry.counter("re_solver.converged_entities", coordinate=name).inc(
+            info.get("converged", 0)
+        )
+        telemetry.gauge("re_solver.iterations_max", coordinate=name).set(
+            info.get("iterations_max", 0)
+        )
+
+
 class CoordinateDescent:
     """Cycles coordinate training with residual (offset) passing.
 
@@ -54,6 +75,7 @@ class CoordinateDescent:
         validation_data: Optional[GameDataset] = None,
         evaluators: Optional[MultiEvaluator] = None,
         logger: Optional[PhotonLogger] = None,
+        telemetry=None,
     ):
         if not coordinates:
             raise ValueError("CoordinateDescent needs at least one coordinate")
@@ -63,6 +85,7 @@ class CoordinateDescent:
         self.validation_data = validation_data
         self.evaluators = evaluators
         self.logger = logger or PhotonLogger("photon_tpu.game")
+        self.telemetry = telemetry or NULL_SESSION
 
     def _evaluate(self, model: GameModel) -> Dict[str, float]:
         if self.validation_data is None or self.evaluators is None:
@@ -111,35 +134,47 @@ class CoordinateDescent:
         best_metrics: Dict[str, float] = {}
         history = []
 
+        telemetry = self.telemetry
         for it in range(num_iterations):
             coord_logs = {}
-            for name, coord in self.coordinates.items():
-                if name in locked:
-                    continue
-                offsets = base_offset.copy()
-                for other, s in scores.items():
-                    if other != name:
-                        offsets += s
-                with self.logger.timed(f"iter{it}-{name}"):
-                    model, info = coord.train(
-                        offsets.astype(np.float32), initial_model=models.get(name)
+            with telemetry.span("descent.iteration", iteration=it) as iter_span:
+                for name, coord in self.coordinates.items():
+                    if name in locked:
+                        continue
+                    offsets = base_offset.copy()
+                    for other, s in scores.items():
+                        if other != name:
+                            offsets += s
+                    with self.logger.timed(f"iter{it}-{name}"):
+                        model, info = coord.train(
+                            offsets.astype(np.float32), initial_model=models.get(name)
+                        )
+                    models[name] = model
+                    scores[name] = np.asarray(coord.score(model), np.float64)
+                    telemetry.counter(
+                        "descent.coordinate_updates", coordinate=name
+                    ).inc()
+                    _record_coordinate_info(telemetry, name, info)
+                    summary = (
+                        info.summary().splitlines()[0]
+                        if hasattr(info, "summary")
+                        else str(info)
                     )
-                models[name] = model
-                scores[name] = np.asarray(coord.score(model), np.float64)
-                summary = (
-                    info.summary().splitlines()[0]
-                    if hasattr(info, "summary")
-                    else str(info)
-                )
-                coord_logs[name] = summary
-                self.logger.info("iter %d coordinate %s: %s", it, name, summary)
+                    coord_logs[name] = summary
+                    self.logger.info("iter %d coordinate %s: %s", it, name, summary)
 
-            game_model = GameModel(dict(models), self.task_type)
-            if checkpoint_fn is not None:
-                checkpoint_fn(it, game_model)
-            metrics = self._evaluate(game_model)
-            if metrics:
-                self.logger.info("iter %d validation %s", it, metrics)
+                game_model = GameModel(dict(models), self.task_type)
+                if checkpoint_fn is not None:
+                    with telemetry.span("descent.checkpoint", iteration=it):
+                        checkpoint_fn(it, game_model)
+                with telemetry.span("descent.validate", iteration=it):
+                    metrics = self._evaluate(game_model)
+                if metrics:
+                    self.logger.info("iter %d validation %s", it, metrics)
+                    iter_span.set_attribute("metrics", metrics)
+                    for k, v in metrics.items():
+                        telemetry.gauge("descent.validation_metric", metric=k).set(v)
+            telemetry.counter("descent.iterations").inc()
             history.append(
                 {"iteration": it, "metrics": metrics, "coordinates": coord_logs}
             )

@@ -24,6 +24,7 @@ from photon_tpu.game.coordinate import CoordinateConfig, build_coordinate
 from photon_tpu.game.data import GameDataset
 from photon_tpu.game.descent import CoordinateDescent, DescentResult
 from photon_tpu.game.model import GameModel
+from photon_tpu.telemetry import NULL_SESSION
 from photon_tpu.utils.logging import PhotonLogger
 
 
@@ -67,6 +68,7 @@ class GameEstimator:
         mesh=None,
         normalization: Optional[Dict[str, NormalizationContext]] = None,
         logger: Optional[PhotonLogger] = None,
+        telemetry=None,
     ):
         """``normalization`` is keyed by feature-shard name and applies to
         fixed-effect coordinates on that shard (the reference normalizes the
@@ -84,6 +86,7 @@ class GameEstimator:
             )
         self.normalization = normalization or {}
         self.logger = logger or PhotonLogger("photon_tpu.game")
+        self.telemetry = telemetry or NULL_SESSION
         # Device-resident data shared across sweep configurations: building
         # the bucketed random-effect datasets (the reference's shuffle) and
         # uploading feature blocks happens once per distinct data config.
@@ -144,7 +147,8 @@ class GameEstimator:
         results = []
         for i, config in enumerate(configurations):
             label = config.name or f"config-{i}"
-            with self.logger.timed(f"fit-{label}"):
+            with self.telemetry.span("estimator.fit", configuration=label), \
+                    self.logger.timed(f"fit-{label}"):
                 descent = CoordinateDescent(
                     self._build_coordinates(config),
                     self.task_type,
@@ -152,12 +156,14 @@ class GameEstimator:
                     self.validation_data,
                     self.evaluators,
                     logger=self.logger,
+                    telemetry=self.telemetry,
                 ).run(
                     config.descent_iterations,
                     initial_model=initial_model,
                     locked_coordinates=locked_coordinates,
                     checkpoint_fn=checkpoint_fn,
                 )
+            self.telemetry.counter("estimator.configurations").inc()
             results.append(
                 GameResult(
                     model=descent.best_model,

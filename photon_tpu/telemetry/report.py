@@ -1,0 +1,203 @@
+"""Run reports: environment capture, JSON artifact, markdown rendering.
+
+Every driver run finalizes its :class:`~photon_tpu.telemetry.TelemetrySession`
+into ``<output-dir>/telemetry/``:
+
+- ``run_report.json`` — status, duration, captured environment, the metrics
+  registry snapshot, and the full span tree (the machine-readable record of
+  the run; the reference's scattered driver logs, made structural).
+- ``spans.jsonl`` — one span per line for trace tooling.
+
+``python -m photon_tpu.telemetry.report <run_report.json>`` renders the
+report as markdown (status header, environment, phase breakdown, metrics
+tables) — the human-readable view, kept out of the hot path.
+
+Telemetry artifacts live beside — never inside — ``training_summary.json``:
+summaries stay byte-identical across identical runs (the determinism
+contract tests/test_legacy_avro_determinism.py pins), while telemetry holds
+all the wall-clock data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import sys
+from typing import Optional
+
+
+def capture_environment() -> dict:
+    """Host/process facts worth pinning to a run.
+
+    JAX facts are captured only when jax is ALREADY imported — telemetry
+    must never be the thing that initializes a backend (the indexing driver
+    runs jax-free; multi-process ranks init on their own schedule).
+    """
+    env = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "photon_env": {
+            k: v for k, v in sorted(os.environ.items())
+            if k.startswith("PHOTON_")
+        },
+    }
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        jax_info: dict = {"version": getattr(jax_mod, "__version__", None)}
+        # Device facts ONLY from an already-initialized backend:
+        # default_backend()/device_count() would otherwise trigger backend
+        # init from inside telemetry — slow at best, a hang on a TPU-tunnel
+        # platform at worst, and wrong for drivers that never touch devices.
+        try:
+            from jax._src import xla_bridge
+
+            initialized = bool(getattr(xla_bridge, "_backends", None))
+        except Exception:
+            initialized = False
+        if initialized:
+            try:
+                jax_info["backend"] = jax_mod.default_backend()
+                jax_info["device_count"] = jax_mod.device_count()
+                jax_info["process_index"] = jax_mod.process_index()
+                jax_info["process_count"] = jax_mod.process_count()
+            except Exception as e:  # never let capture kill a report
+                jax_info["error"] = f"{type(e).__name__}: {e}"
+        else:
+            jax_info["backend"] = "uninitialized"
+        env["jax"] = jax_info
+    return env
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _fmt_labels(labels: dict) -> str:
+    return ", ".join(f"{k}={v}" for k, v in sorted(labels.items())) or "—"
+
+
+def render_markdown(report: dict) -> str:
+    """Human-readable view of a run report dict."""
+    lines = [
+        f"# Run report: {report.get('driver', '?')}",
+        "",
+        f"- **run id**: {report.get('run_id', '?')}",
+        f"- **status**: {report.get('status', '?')}"
+        + (f" — {report['error']}" if report.get("error") else ""),
+        f"- **duration**: {_fmt(report.get('duration_s'))} s",
+    ]
+    env = report.get("environment", {})
+    if env:
+        lines += ["", "## Environment", ""]
+        for key in ("python", "platform", "pid"):
+            if key in env:
+                lines.append(f"- **{key}**: {env[key]}")
+        jax_info = env.get("jax")
+        if jax_info:
+            lines.append(
+                "- **jax**: "
+                + ", ".join(f"{k}={v}" for k, v in jax_info.items())
+            )
+        if env.get("photon_env"):
+            lines.append(
+                "- **PHOTON_ env**: "
+                + ", ".join(f"{k}={v}" for k, v in env["photon_env"].items())
+            )
+
+    totals = report.get("phase_totals") or {}
+    if totals:
+        lines += ["", "## Wall-clock by phase", "",
+                  "| phase | total (s) |", "|---|---|"]
+        for name, secs in sorted(totals.items(), key=lambda kv: -kv[1]):
+            lines.append(f"| {name} | {secs:.3f} |")
+
+    metrics = report.get("metrics") or {}
+    counters = metrics.get("counters") or []
+    gauges = metrics.get("gauges") or []
+    if counters or gauges:
+        lines += ["", "## Metrics", "",
+                  "| metric | labels | value |", "|---|---|---|"]
+        for entry in counters + gauges:
+            lines.append(
+                f"| {entry['name']} | {_fmt_labels(entry['labels'])} "
+                f"| {_fmt(entry['value'])} |"
+            )
+    histograms = metrics.get("histograms") or []
+    if histograms:
+        lines += ["", "## Distributions", "",
+                  "| metric | labels | count | mean | p50 | p99 | max |",
+                  "|---|---|---|---|---|---|---|"]
+        for entry in histograms:
+            lines.append(
+                f"| {entry['name']} | {_fmt_labels(entry['labels'])} "
+                f"| {entry['count']} | {_fmt(entry['mean'])} "
+                f"| {_fmt(entry['p50'])} | {_fmt(entry['p99'])} "
+                f"| {_fmt(entry['max'])} |"
+            )
+
+    spans = report.get("spans") or []
+    if spans:
+        lines += ["", f"## Spans ({len(spans)})", ""]
+        # Children finish before parents, so rebuild the tree for display.
+        by_parent: dict = {}
+        for sp in spans:
+            by_parent.setdefault(sp.get("parent_id"), []).append(sp)
+
+        def walk(parent_id, depth):
+            for sp in sorted(
+                by_parent.get(parent_id, []), key=lambda s: s["start_time"]
+            ):
+                flag = "" if sp.get("status") == "ok" else " **[error]**"
+                lines.append(
+                    f"{'  ' * depth}- {sp['name']}: "
+                    f"{_fmt(sp.get('duration_s'))} s{flag}"
+                )
+                walk(sp["span_id"], depth + 1)
+
+        walk(None, 0)
+    return "\n".join(lines) + "\n"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon_tpu.telemetry.report",
+        description="Render a telemetry run_report.json as markdown.",
+    )
+    p.add_argument("report", help="path to run_report.json (or a driver "
+                   "output dir containing telemetry/run_report.json)")
+    p.add_argument("-o", "--output", default=None,
+                   help="write markdown here instead of stdout")
+    return p
+
+
+def resolve_report_path(path: str) -> str:
+    if os.path.isdir(path):
+        nested = os.path.join(path, "telemetry", "run_report.json")
+        return nested if os.path.exists(nested) else os.path.join(
+            path, "run_report.json"
+        )
+    return path
+
+
+def main(argv: Optional[list] = None) -> None:
+    args = build_parser().parse_args(argv)
+    with open(resolve_report_path(args.report)) as f:
+        report = json.load(f)
+    text = render_markdown(report)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()

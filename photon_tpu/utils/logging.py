@@ -51,6 +51,10 @@ class PhotonLogger:
             )
             self._logger.addHandler(fh)
         self.phase_times: dict[str, float] = {}
+        # Set by TelemetrySession.attach(): when present, every timed()
+        # phase also opens a tracing span, so phase logs and the run
+        # report's span tree come from the one instrumentation point.
+        self.tracer = None
 
     def info(self, msg: str, *args) -> None:
         self._logger.info(msg, *args)
@@ -62,13 +66,21 @@ class PhotonLogger:
         self._logger.error(msg, *args)
 
     @contextlib.contextmanager
-    def timed(self, phase: str) -> Iterator[None]:
+    def timed(self, phase: str, span: bool = True) -> Iterator[None]:
         """Log + record wall-clock of a driver phase (the reference's
-        ``Timed { }``)."""
+        ``Timed { }``).  ``span=False`` keeps the log + phase_times entry
+        but skips the tracing span — for unbounded-cardinality phases
+        (one per part file in a beyond-host-memory stream) where retaining
+        a Span each would grow the run report without bound."""
         t0 = time.monotonic()
         self.info("phase %s: start", phase)
+        span_ctx = (
+            self.tracer.span(phase) if span and self.tracer is not None
+            else contextlib.nullcontext()
+        )
         try:
-            yield
+            with span_ctx:
+                yield
         finally:
             dt = time.monotonic() - t0
             self.phase_times[phase] = self.phase_times.get(phase, 0.0) + dt

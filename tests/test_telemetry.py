@@ -1,0 +1,515 @@
+"""Telemetry subsystem: registry, spans, run reports, driver integration."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from photon_tpu.telemetry import (
+    NULL_SESSION,
+    MetricsRegistry,
+    TelemetrySession,
+    Tracer,
+    telemetry_enabled,
+)
+from photon_tpu.telemetry.report import (
+    render_markdown,
+    resolve_report_path,
+)
+from photon_tpu.telemetry import report as report_cli
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(2.5)
+    assert reg.counter("c").value == 3.5
+    with pytest.raises(ValueError):
+        reg.counter("c").inc(-1)
+
+    assert reg.gauge("g").value is None
+    reg.gauge("g").set(7)
+    reg.gauge("g").set(5)
+    assert reg.gauge("g").value == 5.0
+
+    h = reg.histogram("h")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4 and s["sum"] == 10.0
+    assert s["min"] == 1.0 and s["max"] == 4.0 and s["mean"] == 2.5
+
+
+def test_labels_create_distinct_series_and_kind_conflicts_raise():
+    reg = MetricsRegistry()
+    reg.counter("solves", lam="0.1").inc()
+    reg.counter("solves", lam="1").inc(2)
+    assert reg.counter("solves", lam="0.1").value == 1
+    assert reg.counter("solves", lam="1").value == 2
+    # Same (name, labels) under a different kind is a registration bug.
+    with pytest.raises(TypeError):
+        reg.gauge("solves", lam="0.1")
+    # Label VALUES are stringified, so 1 and "1" are the same series.
+    reg.counter("solves", lam=1).inc()
+    assert reg.counter("solves", lam="1").value == 3
+
+
+def test_histogram_reservoir_bounded_and_percentiles_sane():
+    reg = MetricsRegistry()
+    h = reg.histogram("big")
+    n = 10_000
+    for i in range(n):
+        h.observe(float(i))
+    assert h.count == n and h.sum == sum(range(n))
+    assert len(h._kept) <= 256 + 1
+    # Kept samples sweep the sequence evenly -> percentiles land close.
+    assert abs(h.percentile(50) - n / 2) < n * 0.05
+    assert h.percentile(0) == 0.0
+    assert h.summary()["p99"] > n * 0.9
+
+
+def test_snapshot_is_sorted_and_json_ready():
+    reg = MetricsRegistry()
+    reg.counter("b").inc()
+    reg.counter("a", x="2").inc()
+    reg.counter("a", x="1").inc()
+    reg.gauge("g").set(1.5)
+    reg.histogram("h").observe(3)
+    snap = reg.snapshot()
+    names = [(e["name"], e["labels"]) for e in snap["counters"]]
+    assert names == [("a", {"x": "1"}), ("a", {"x": "2"}), ("b", {})]
+    json.dumps(snap)  # must serialize
+
+
+def test_prometheus_exposition():
+    reg = MetricsRegistry()
+    reg.counter("optimizer.solves", lam="0.1").inc(3)
+    reg.gauge("train.best_lambda").set(0.1)
+    reg.gauge("unset")  # never set -> omitted
+    reg.histogram("solve_seconds").observe(2.0)
+    text = reg.to_prometheus()
+    assert 'optimizer_solves{lam="0.1"} 3' in text
+    assert "train_best_lambda 0.1" in text
+    assert "unset" not in text
+    assert 'solve_seconds{quantile="0.5"} 2' in text
+    assert "solve_seconds_count 1" in text
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+
+    def work():
+        for _ in range(1000):
+            reg.counter("n").inc()
+            reg.histogram("h").observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("n").value == 4000
+    assert reg.histogram("h").count == 4000
+
+
+# ----------------------------------------------------------------- tracing
+
+
+def test_span_nesting_and_attributes():
+    tracer = Tracer()
+    with tracer.span("outer", kind="test") as outer:
+        with tracer.span("inner") as inner:
+            assert tracer.current_span() is inner
+            inner.set_attribute("rows", 10)
+        assert tracer.current_span() is outer
+    assert tracer.current_span() is None
+    spans = tracer.export()
+    # Children finish first.
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["outer"]["parent_id"] is None
+    assert by_name["inner"]["attributes"]["rows"] == 10
+    assert by_name["outer"]["attributes"]["kind"] == "test"
+    assert all(s["duration_s"] >= 0 for s in spans)
+
+
+def test_span_error_status_recorded_and_reraised():
+    tracer = Tracer()
+    with pytest.raises(RuntimeError, match="boom"):
+        with tracer.span("failing"):
+            raise RuntimeError("boom")
+    (span,) = tracer.export()
+    assert span["status"] == "error"
+    assert "RuntimeError: boom" in span["error"]
+    assert span["duration_s"] is not None
+
+
+def test_spans_on_worker_threads_are_roots():
+    tracer = Tracer()
+
+    def work():
+        with tracer.span("worker"):
+            pass
+
+    with tracer.span("main"):
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+    worker = next(s for s in tracer.export() if s["name"] == "worker")
+    assert worker["parent_id"] is None  # not a child of "main"
+    assert worker["thread"] != "MainThread"
+
+
+def test_phase_totals_and_jsonl(tmp_path):
+    tracer = Tracer()
+    for _ in range(3):
+        with tracer.span("phase-a"):
+            pass
+    with tracer.span("phase-b"):
+        pass
+    totals = tracer.phase_totals()
+    assert set(totals) == {"phase-a", "phase-b"}
+    path = str(tmp_path / "spans.jsonl")
+    tracer.write_jsonl(path)
+    lines = [json.loads(ln) for ln in open(path)]
+    assert len(lines) == 4
+
+
+# ----------------------------------------------------------------- session
+
+
+def test_disabled_session_is_a_full_noop(tmp_path):
+    session = TelemetrySession("test", enabled=False)
+    session.counter("c").inc()
+    session.gauge("g").set(1)
+    session.histogram("h").observe(1)
+    with session.span("phase") as sp:
+        sp.set_attribute("k", "v")
+    assert session.finalize(str(tmp_path)) is None
+    assert not os.path.exists(str(tmp_path / "telemetry"))
+    # The shared NULL_SESSION behaves identically (library default arg).
+    with NULL_SESSION.span("x") as sp:
+        sp.set_attribute("a", 1)
+
+
+def test_session_finalize_writes_artifacts(tmp_path):
+    session = TelemetrySession("unittest")
+    session.counter("rows").inc(5)
+    with session.span("load"):
+        pass
+    report = session.finalize(str(tmp_path), extra={"note": "hi"})
+    assert report["status"] == "success"
+    assert report["driver"] == "unittest"
+    assert report["extra"] == {"note": "hi"}
+    tdir = tmp_path / "telemetry"
+    with open(tdir / "run_report.json") as f:
+        persisted = json.load(f)
+    assert persisted["metrics"]["counters"][0]["value"] == 5
+    assert [s["name"] for s in persisted["spans"]] == ["load"]
+    assert (tdir / "spans.jsonl").exists()
+    # Finalize is idempotent: the error path after a success write is a no-op.
+    again = session.finalize(str(tmp_path), status="error", error="nope")
+    assert again["status"] == "success"
+
+
+def test_finalize_survives_non_json_attributes(tmp_path):
+    """Telemetry must never crash the run it observes: non-JSON span
+    attributes (numpy scalars etc.) degrade to strings at write time."""
+    session = TelemetrySession("hardening")
+    with session.span("phase") as sp:
+        sp.set_attribute("np_scalar", np.float32(1.5))
+        sp.set_attribute("array", np.arange(3))
+    report = session.finalize(str(tmp_path))
+    assert report["status"] == "success"
+    persisted = json.load(open(tmp_path / "telemetry" / "run_report.json"))
+    assert persisted["spans"][0]["attributes"]["np_scalar"] == "1.5"
+
+
+def test_finalize_never_raises_on_unwritable_dir(tmp_path):
+    """A telemetry write failure must not crash an otherwise-successful
+    run — and on the driver error path must not replace the real
+    exception with a telemetry traceback."""
+    # Output dir nested under a regular FILE: makedirs fails regardless of
+    # uid (chmod-based denial is a no-op when the suite runs as root).
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    target = blocker / "out"
+    session = TelemetrySession("hardening")
+    session.counter("c").inc()
+    report = session.finalize(str(target))  # must not raise
+    assert report is not None and report["status"] == "success"
+    assert not os.path.exists(str(target))
+
+
+def test_session_write_gate_skips_files(tmp_path):
+    session = TelemetrySession("rank1")
+    session.write = False  # non-primary rank
+    report = session.finalize(str(tmp_path))
+    assert report is not None  # report still built...
+    assert not os.path.exists(str(tmp_path / "telemetry"))  # ...nothing written
+
+
+def test_env_var_gate(monkeypatch):
+    assert telemetry_enabled(None) is True
+    assert telemetry_enabled(False) is False
+    monkeypatch.setenv("PHOTON_TELEMETRY", "off")
+    assert telemetry_enabled(None) is False
+    assert telemetry_enabled(True) is False  # env wins over the flag
+    monkeypatch.setenv("PHOTON_TELEMETRY", "on")
+    assert telemetry_enabled(None) is True
+
+
+def test_logger_timed_feeds_tracer():
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.test_telemetry")
+    session = TelemetrySession("logger-test")
+    session.attach(logger)
+    with logger.timed("outer-phase"):
+        with logger.timed("inner-phase"):
+            pass
+    assert "outer-phase" in logger.phase_times  # legacy dict still fed
+    spans = {s["name"]: s for s in session.tracer.export()}
+    assert spans["inner-phase"]["parent_id"] == spans["outer-phase"]["span_id"]
+
+
+# ----------------------------------------------------- optimizer recording
+
+
+def test_tracker_record_to():
+    from photon_tpu.core.optimizers import OptimizationStatesTracker
+    from photon_tpu.core.optimizers.base import OptimizerResult
+
+    result = OptimizerResult(
+        w=np.zeros(3, np.float32),
+        value=np.float32(1.5),
+        grad_norm=np.float32(0.01),
+        iterations=np.int32(4),
+        converged=np.bool_(True),
+        reason=np.int32(2),  # FUNCTION_VALUES_TOLERANCE
+        history_value=np.array([3.0, 2.0, 1.8, 1.6, 1.5, 0.0], np.float32),
+        history_grad_norm=np.array([1.0, 0.5, 0.1, 0.05, 0.01, 0.0], np.float32),
+        history_valid=np.array([1, 1, 1, 1, 1, 0], bool),
+    )
+    tracker = OptimizationStatesTracker(result, wall_time_s=0.25)
+    reg = MetricsRegistry()
+    tracker.record_to(reg, lam=0.5)
+    assert reg.counter("optimizer.solves", lam="0.5").value == 1
+    assert reg.counter("optimizer.iterations", lam="0.5").value == 4
+    assert reg.counter("optimizer.converged_solves", lam="0.5").value == 1
+    assert reg.counter(
+        "optimizer.stop_reason", lam="0.5",
+        reason="FUNCTION_VALUES_TOLERANCE",
+    ).value == 1
+    assert reg.histogram("optimizer.solve_seconds", lam="0.5").count == 1
+    assert reg.gauge("optimizer.final_value", lam="0.5").value == pytest.approx(1.5)
+
+
+# ----------------------------------------------------------------- reports
+
+
+def test_render_markdown_and_cli(tmp_path, capsys):
+    session = TelemetrySession("render-test")
+    session.counter("rows", kind="train").inc(7)
+    session.histogram("seconds").observe(0.5)
+    with session.span("load"):
+        with session.span("parse"):
+            pass
+    session.finalize(str(tmp_path))
+    text = render_markdown(
+        json.load(open(tmp_path / "telemetry" / "run_report.json"))
+    )
+    assert "# Run report: render-test" in text
+    assert "| rows | kind=train | 7 |" in text
+    assert "- load:" in text and "  - parse:" in text  # tree indentation
+
+    # CLI: a driver output dir resolves to its nested run_report.json.
+    assert resolve_report_path(str(tmp_path)).endswith(
+        os.path.join("telemetry", "run_report.json")
+    )
+    out_md = str(tmp_path / "report.md")
+    report_cli.main([str(tmp_path), "-o", out_md])
+    assert "# Run report: render-test" in open(out_md).read()
+    report_cli.main([str(tmp_path)])
+    assert "# Run report: render-test" in capsys.readouterr().out
+
+
+# ------------------------------------------------------ driver integration
+
+
+@pytest.fixture(scope="module")
+def trained_run(tmp_path_factory):
+    from photon_tpu.drivers import train as train_driver
+
+    out = str(tmp_path_factory.mktemp("telem_train") / "out")
+    summary = train_driver.run(train_driver.build_parser().parse_args([
+        "--input", "synthetic:logistic_regression:200:8:0",
+        "--validation-input", "synthetic:logistic_regression:100:8:1:0",
+        "--reg-weights", "0.5,2.0", "--max-iterations", "10",
+        "--output-dir", out, "--backend", "cpu",
+    ]))
+    return out, summary
+
+
+def test_train_driver_writes_run_report(trained_run):
+    out, _ = trained_run
+    with open(os.path.join(out, "telemetry", "run_report.json")) as f:
+        report = json.load(f)
+    assert report["status"] == "success" and report["error"] is None
+    assert report["driver"] == "train"
+    counters = {
+        (e["name"], tuple(sorted(e["labels"].items()))): e["value"]
+        for e in report["metrics"]["counters"]
+    }
+    # One solve per lambda, recorded by the optimizer tracker.
+    assert counters[("optimizer.solves", (("lam", "0.5"), ("optimizer", "lbfgs")))] == 1
+    assert counters[("optimizer.solves", (("lam", "2"), ("optimizer", "lbfgs")))] == 1
+    assert counters[("train.sweep_entries", ())] == 2
+    span_names = {s["name"] for s in report["spans"]}
+    assert {"load-data", "train-lambda-0.5", "train-lambda-2.0",
+            "save-models"} <= span_names
+    assert report["environment"]["jax"]["backend"] == "cpu"
+    # Spans mirror the logger's phase-times dict.
+    assert set(report["phase_totals"]) == span_names
+
+
+def test_train_summary_stays_telemetry_free(trained_run):
+    """training_summary.json must stay byte-stable across identical runs
+    (the determinism contract) — all wall-clock telemetry lives in the
+    separate telemetry/ artifacts."""
+    out, summary = trained_run
+    assert "telemetry" not in summary
+    with open(os.path.join(out, "training_summary.json")) as f:
+        assert "run_id" not in json.load(f)
+
+
+def test_no_telemetry_flag_writes_nothing(tmp_path):
+    from photon_tpu.drivers import train as train_driver
+
+    out = str(tmp_path / "out")
+    train_driver.run(train_driver.build_parser().parse_args([
+        "--input", "synthetic:logistic_regression:100:6:0",
+        "--reg-weights", "1.0", "--max-iterations", "5",
+        "--output-dir", out, "--backend", "cpu", "--no-telemetry",
+    ]))
+    assert os.path.exists(os.path.join(out, "best_model.avro"))
+    assert not os.path.exists(os.path.join(out, "telemetry"))
+
+
+def test_failed_run_leaves_error_report(tmp_path):
+    from photon_tpu.drivers import train as train_driver
+
+    out = str(tmp_path / "out")
+    with pytest.raises(FileNotFoundError):
+        train_driver.run(train_driver.build_parser().parse_args([
+            "--input", str(tmp_path / "does-not-exist.libsvm"),
+            "--output-dir", out, "--backend", "cpu",
+        ]))
+    with open(os.path.join(out, "telemetry", "run_report.json")) as f:
+        report = json.load(f)
+    assert report["status"] == "error"
+    assert "FileNotFoundError" in report["error"]
+
+
+def test_multiprocess_prebody_failure_writes_rank0_only(tmp_path):
+    """A distributed run that dies before the driver body learns its rank
+    from jax.process_index() (bad input path on every rank) must not have
+    N processes writing the same run_report.json: telemetry_run gates the
+    error-path write on the operator-declared --process-id."""
+    import argparse
+
+    from photon_tpu.drivers.common import telemetry_run
+    from photon_tpu.utils import PhotonLogger
+
+    def attempt(outdir, **distributed):
+        args = argparse.Namespace(
+            telemetry=True, output_dir=str(outdir), **distributed
+        )
+        logger = PhotonLogger("photon_tpu.test_telemetry")
+        with pytest.raises(RuntimeError):
+            with telemetry_run(args, "train", logger):
+                raise RuntimeError("pre-body failure")
+        return os.path.exists(
+            os.path.join(str(outdir), "telemetry", "run_report.json")
+        )
+
+    assert attempt(tmp_path / "rank1", coordinator="h:1", process_id=1,
+                   num_processes=2) is False
+    assert attempt(tmp_path / "rank0", coordinator="h:1", process_id=0,
+                   num_processes=2) is True
+    assert attempt(tmp_path / "single") is True  # no --coordinator: write
+
+
+def test_stream_score_parts_keeps_one_span(tmp_path):
+    """Streamed scoring exists for beyond-host-memory part layouts, so it
+    must not retain one Span per part file: the loop gets a single
+    stream-score span (per-chunk timing lives in the bounded stream.*
+    histograms), while the per-file phase logs/phase_times stay."""
+    from types import SimpleNamespace
+
+    from photon_tpu.drivers.common import stream_score_parts
+    from photon_tpu.utils import PhotonLogger
+
+    parts = tmp_path / "parts"
+    parts.mkdir()
+    for i in range(3):
+        (parts / f"part-{i:05d}").write_text("x\n")
+
+    logger = PhotonLogger("photon_tpu.test_telemetry")
+    session = TelemetrySession("stream-test")
+    session.attach(logger)
+    chunk = SimpleNamespace(num_examples=2)
+    n = stream_score_parts(
+        str(parts),
+        lambda path: chunk,
+        lambda c: (np.zeros(2), np.zeros(2), c.num_examples),
+        str(tmp_path / "scores.txt"),
+        logger, telemetry=session,
+    )
+    assert n == 6
+    names = [s["name"] for s in session.tracer.export()]
+    assert names == ["stream-score"]  # one span total, not one per file
+    assert session.registry.histogram("stream.chunk_seconds").count == 3
+    # The per-file phase timing still reaches the legacy phase_times dict.
+    assert sum(1 for k in logger.phase_times if k.startswith("score-")) == 3
+
+
+def test_game_driver_telemetry(tmp_path):
+    from photon_tpu.drivers import train_game
+
+    out = str(tmp_path / "out")
+    train_game.run(train_game.build_parser().parse_args([
+        "--input", "synthetic-game:12:4:6:3:1:5",
+        "--validation-split", "0.25",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=5",
+        "--coordinate", "per0:type=random,shard=re0,entity=re0,max_iters=3",
+        "--descent-iterations", "2",
+        "--output-dir", out, "--backend", "cpu",
+    ]))
+    with open(os.path.join(out, "telemetry", "run_report.json")) as f:
+        report = json.load(f)
+    assert report["status"] == "success"
+    counters = {
+        (e["name"], tuple(sorted(e["labels"].items()))): e["value"]
+        for e in report["metrics"]["counters"]
+    }
+    assert counters[("descent.iterations", ())] == 2
+    assert counters[("descent.coordinate_updates", (("coordinate", "fixed"),))] == 2
+    assert counters[("estimator.configurations", ())] == 1
+    # Fixed effect records through the tracker, random through entity stats.
+    assert counters[("optimizer.solves", (("coordinate", "fixed"),))] == 2
+    assert counters[("re_solver.entities", (("coordinate", "per0"),))] > 0
+    span_names = [s["name"] for s in report["spans"]]
+    assert span_names.count("descent.iteration") == 2
+    assert "estimator.fit" in span_names
+    # The descent iteration span carries the validation metrics.
+    iter_spans = [s for s in report["spans"] if s["name"] == "descent.iteration"]
+    assert any("metrics" in s.get("attributes", {}) for s in iter_spans)
+    gauges = {e["name"] for e in report["metrics"]["gauges"]}
+    assert "descent.validation_metric" in gauges
