@@ -30,6 +30,16 @@ import numpy as np
 # CPU-fallback run is visibly not a TPU number.
 _PLATFORM_INFO = {"platform": None, "tpu_error": None}
 
+# Error signatures of a jaxlib whose CPU backend cannot run cross-process
+# collectives at all — a platform limitation, not a failure.  The ONE copy:
+# _run_stream_workers re-raises on these so the signature survives the
+# bench_error detail truncation, and tests/test_multiprocess.py +
+# tests/test_streaming.py import this tuple to skip-with-reason.
+MP_UNSUPPORTED_MARKERS = (
+    "Multiprocess computations aren't implemented",
+    "multiprocess computations are not supported",
+)
+
 
 def _acquire_backend(timeout_s: float | None = None) -> None:
     """Resolve a usable JAX backend WITHOUT ever hanging or crashing the bench.
@@ -449,6 +459,88 @@ def _bench_config(num: int) -> None:
     })
 
 
+def _bench_descent() -> None:
+    """GAME coordinate-descent residual micro-bench (``--mode descent``).
+
+    Runs the SAME synthetic multi-coordinate GAME fit twice — once under the
+    seed's host float64 residual path (``PHOTON_RESIDUALS=host``) and once
+    under the device-resident residual engine (``game/residuals.py``) — and
+    emits one JSON line whose value is the device path's descent
+    iterations/sec, with the host path's number and the speedup in detail.
+    Each mode is timed on its SECOND fit: the first pays compilation and the
+    estimator's one-time device-data upload, which both modes share.
+    """
+    import jax
+
+    from photon_tpu.core.objective import RegularizationContext
+    from photon_tpu.core.optimizers import OptimizerConfig
+    from photon_tpu.core.problem import ProblemConfig
+    from photon_tpu.data.synthetic import make_game_dataset
+    from photon_tpu.game.coordinate import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_tpu.game.estimator import (
+        GameEstimator,
+        GameOptimizationConfiguration,
+    )
+
+    platform = jax.devices()[0].platform
+    big = platform != "cpu"
+    # Residual traffic scales with rows x coordinates x iterations; solver
+    # work is capped (few inner iterations) so the residual path — the thing
+    # under test — is a visible slice of the wall clock.  ~200k rows x 4
+    # coordinates on CPU: below that, solve noise swamps the residual delta.
+    n_entities, rows_mean = (20_000, 50) if big else (8000, 25)
+    iters = 3
+    data, _ = make_game_dataset(
+        n_entities, rows_mean, 32, 8, seed=0, n_random_coords=3
+    )
+
+    def _problem(lam: float, max_iters: int) -> ProblemConfig:
+        return ProblemConfig(
+            regularization=RegularizationContext("l2", lam),
+            optimizer_config=OptimizerConfig(max_iterations=max_iters),
+        )
+
+    config = GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", _problem(0.01, 5)),
+            "re0": RandomEffectCoordinateConfig("re0", "re0", _problem(1.0, 4)),
+            "re1": RandomEffectCoordinateConfig("re1", "re1", _problem(1.0, 4)),
+            "re2": RandomEffectCoordinateConfig("re2", "re2", _problem(1.0, 4)),
+        },
+        descent_iterations=iters,
+    )
+
+    walls = {}
+    reps = 3
+    for mode in ("host", "device"):
+        estimator = GameEstimator(
+            "logistic_regression", data, residual_mode=mode
+        )
+        estimator.fit([config])  # warm-up: compile + device-data upload
+        best = float("inf")
+        for _ in range(reps):  # best-of-reps: shared-CPU noise rejection
+            t0 = time.perf_counter()
+            estimator.fit([config])
+            best = min(best, time.perf_counter() - t0)
+        walls[mode] = best
+
+    _emit("game_descent_iters_per_sec", iters / walls["device"], "iters/s", {
+        "rows": data.num_examples,
+        "entities": n_entities,
+        "coordinates": 4,
+        "descent_iterations": iters,
+        "device_fit_seconds": round(walls["device"], 4),
+        "host_fit_seconds": round(walls["host"], 4),
+        "host_iters_per_sec": round(iters / walls["host"], 3),
+        "speedup_vs_host": round(walls["host"] / walls["device"], 3),
+        "rows_per_sec": round(iters * data.num_examples / walls["device"], 1),
+        "platform": platform,
+    })
+
+
 def _generate_stream_files(
     out_dir: str, total_rows: int, n_files: int, k: int, d: int, seed: int = 0
 ) -> list:
@@ -703,6 +795,15 @@ def _run_stream_workers(nproc: int, data_dir: str, d: int, log_dir: str) -> dict
                 tail = open(
                     os.path.join(log_dir, f"worker_{nproc}_{pid}.log")
                 ).read()[-2000:]
+                # Surface the platform-limitation signature up front: the
+                # emitted bench_error detail is truncated, and consumers
+                # (tests, the BENCH parser) must still be able to tell "this
+                # jaxlib cannot do multi-process CPU" from a real failure.
+                for marker in MP_UNSUPPORTED_MARKERS:
+                    if marker in tail:
+                        raise RuntimeError(
+                            f"{marker} on this jaxlib's CPU backend"
+                        )
                 raise RuntimeError(
                     f"stream worker {pid}/{nproc} failed:\n{tail}"
                 )
@@ -800,6 +901,15 @@ def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--config":
         _bench_config(int(sys.argv[2]))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--mode":
+        mode = sys.argv[2] if len(sys.argv) > 2 else ""
+        if mode != "descent":
+            # An unknown mode must not silently fall through to the full
+            # (minutes-long) default run; the raise reaches the top-level
+            # handler and emits a bench_error JSON line.
+            raise ValueError(f"unknown bench mode {mode!r}; valid: descent")
+        _bench_descent()
+        return
     if len(sys.argv) <= 1 or sys.argv[1] != "--headline-only":
         # Default run: all five SURVEY.md §6 configs first (one JSON line
         # each; a failing config emits its own error line and never blocks
@@ -824,6 +934,22 @@ def main() -> None:
                 _bench_config(num)
             except Exception as ex:  # noqa: BLE001 — config isolation
                 _emit(f"config{num}_error", 0.0, "error", {
+                    "error": f"{type(ex).__name__}: {ex}"[:500],
+                })
+        # The GAME residual-engine micro-bench rides the full run (its JSON
+        # line lands next to the headline), same budget guard + isolation
+        # as the numbered configs.
+        elapsed = time.perf_counter() - t_start
+        if elapsed > budget_s:
+            _emit("game_descent_skipped", 0.0, "skipped", {
+                "reason": f"bench budget exhausted after {elapsed:.0f}s; "
+                          "run `bench.py --mode descent` individually",
+            })
+        else:
+            try:
+                _bench_descent()
+            except Exception as ex:  # noqa: BLE001 — config isolation
+                _emit("game_descent_error", 0.0, "error", {
                     "error": f"{type(ex).__name__}: {ex}"[:500],
                 })
     import jax

@@ -72,6 +72,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="one per coordinate, in update order; or a single "
                    "@configs.json")
     p.add_argument("--descent-iterations", type=int, default=1)
+    p.add_argument("--residuals", default=None,
+                   choices=("auto", "device", "host"),
+                   help="residual passing between coordinates: 'device' "
+                   "keeps per-coordinate score vectors in a device-resident "
+                   "table (default via auto), 'host' restores the float64 "
+                   "numpy accumulate (escape hatch; also the automatic "
+                   "fallback under multi-process runs).  Overrides "
+                   "PHOTON_RESIDUALS")
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"),
                    help="storage dtype for FEATURE VALUES in every shard "
@@ -420,6 +428,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         mesh=mesh,
         logger=logger,
         telemetry=session,
+        residual_mode=args.residuals,
     )
 
     import jax as _jax

@@ -55,12 +55,107 @@ from photon_tpu.game.data import (
 from photon_tpu.game.model import (
     FixedEffectModel,
     RandomEffectModel,
+    _shard_feats,
     shard_to_batch,
 )
 from photon_tpu.models.glm import Coefficients, model_for_task
-from photon_tpu.parallel.mesh import DATA_AXIS, shard_batch, to_host
+from photon_tpu.parallel.mesh import (
+    DATA_AXIS,
+    put_replicated,
+    shard_batch,
+    to_host,
+)
 
 Array = jax.Array
+
+
+@jax.jit
+def _gather_rows(offsets: Array, row_index: Array) -> Array:
+    """Device row gather: the fixed effect's downsample selection applied to
+    a device-resident offsets vector."""
+    return offsets[row_index]
+
+
+@jax.jit
+def _gather_bucket_offsets(offsets: Array, row_index: Array, mask: Array) -> Array:
+    """Per-bucket offset gather on device: ``offsets[row_index] * mask``
+    against the pre-uploaded ``[E, R]`` row-index/mask buffers — replaces the
+    host fancy-index + fresh upload the seed paid per bucket per iteration."""
+    return offsets[row_index] * mask
+
+
+def _bucket_offsets(device_data, i: int, bucket, offsets) -> Array:
+    """Training offsets for bucket ``i``: a jitted device gather when the
+    residual engine hands a device vector, the seed's host fancy-index +
+    upload when given a numpy vector (``PHOTON_RESIDUALS=host``)."""
+    if isinstance(offsets, jax.Array):
+        row_index, row_mask = device_data.gather_buffers(i)
+        return _gather_bucket_offsets(offsets, row_index, row_mask)
+    return jnp.asarray(
+        offsets[bucket.row_index] * (bucket.row_weight > 0), jnp.float32
+    )
+
+
+def _scoring_feats(coord) -> tuple:
+    """The coordinate's training-shard features as device arrays, uploaded
+    once and cached on the coordinate's shared ``device_data`` (which the
+    estimator reuses across sweep configurations, unlike the coordinate
+    objects themselves), replicated over the mesh: the residual engine
+    re-scores every coordinate every outer iteration, and the seed's
+    ``model.score(data)`` re-uploaded the shard each time.
+
+    This cache is a SECOND device copy of the shard's features (the training
+    copies live row-selected/bucketed in the batch structures and cannot
+    serve full-row-order scoring), replicated over the mesh — a deliberate
+    memory-for-transfers trade.  ``_score_cache_bytes`` makes the residency
+    visible (the descent loop exports it as the
+    ``residuals.scoring_cache_bytes`` gauge); ``PHOTON_RESIDUALS=host``
+    never pays it."""
+    holder = coord.device_data
+    if holder._score_feats is None:
+        feats, dense = _shard_feats(coord.data.shard(coord.config.shard_name))
+        dev_feats = put_replicated(feats, coord.mesh)
+        holder._score_feats = (dev_feats, dense)
+        holder._score_cache_bytes += sum(
+            leaf.nbytes for leaf in jax.tree.leaves(dev_feats)
+        )
+    return holder._score_feats
+
+
+def _random_score_device(coord, model) -> Array:
+    """Device-resident training-data margins for a random-effect model:
+    gather-join against the cached per-row entity index (the common case —
+    the model was trained on this coordinate's vocabulary); a warm-start
+    model with a different vocabulary joins by key on host once.  A model
+    whose feature-shard/entity-column layout differs from the coordinate's
+    config scores through its own host path — the device caches hold the
+    coordinate's shard, not the model's."""
+    if (model.shard_name != coord.config.shard_name
+            or model.entity_column != coord.config.entity_column):
+        return model.score(coord.data)
+    feats, dense = _scoring_feats(coord)
+    holder = coord.device_data
+    # Identity first: a model trained by this coordinate carries the
+    # dataset's own keys object, so the O(num_entities) host compare runs
+    # only for foreign models (warm starts loaded from disk).
+    if model.keys is coord.dataset.keys or np.array_equal(
+        np.asarray(model.keys), coord.dataset.keys
+    ):
+        if holder._score_entity_idx is None:
+            holder._score_entity_idx = put_replicated(
+                jnp.asarray(coord.dataset.entity_idx_per_row), coord.mesh
+            )
+            holder._score_cache_bytes += holder._score_entity_idx.nbytes
+        entity_idx = holder._score_entity_idx
+    else:
+        entity_idx = put_replicated(
+            jnp.asarray(entity_index_for(
+                coord.data.id_columns[coord.config.entity_column],
+                np.asarray(model.keys),
+            )),
+            coord.mesh,
+        )
+    return model.margins_device(entity_idx, feats, dense)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -235,6 +330,11 @@ class FixedEffectDeviceData:
             weight = corrected
         self.batch = shard_to_batch(shard, label, offset, weight)
         self.unpadded_n = self.batch.num_examples
+        self._train_rows_dev: Optional[Array] = None
+        # Device scoring cache (residual engine): full-row-order shard
+        # features + residency accounting, filled by _scoring_feats.
+        self._score_feats: Optional[tuple] = None
+        self._score_cache_bytes: int = 0
         if mesh is not None:
             # Same Pallas/xchg-kernel eligibility as single-device: the
             # per-shard aligned layouts + routes are built when the
@@ -257,10 +357,20 @@ class FixedEffectDeviceData:
                 aligned_dim=self.dim if aligned_layout_wanted(e_total) else None,
             )
 
-    def offsets_to_device(self, offsets: np.ndarray) -> Array:
-        if self.train_rows is not None:
-            offsets = offsets[self.train_rows]
-        dev = jnp.asarray(offsets, jnp.float32)
+    def offsets_to_device(self, offsets) -> Array:
+        """Training offsets ready for the batch: accepts the residual
+        engine's device vector (row selection stays a device gather) or a
+        host numpy vector (the seed's upload path)."""
+        if isinstance(offsets, jax.Array):
+            dev = offsets
+            if self.train_rows is not None:
+                if self._train_rows_dev is None:
+                    self._train_rows_dev = jnp.asarray(self.train_rows)
+                dev = _gather_rows(dev, self._train_rows_dev)
+        else:
+            if self.train_rows is not None:
+                offsets = offsets[self.train_rows]
+            dev = jnp.asarray(offsets, jnp.float32)
         if self.mesh is None:
             return dev
         padded = jnp.pad(dev, (0, self.batch.num_examples - self.unpadded_n))
@@ -309,6 +419,12 @@ class RandomEffectDeviceData:
             self.random_matrix = build_random_projection(
                 self.dim, config.projected_dim, seed=config.seed
             )
+        # Device scoring cache (residual engine): full-row-order shard
+        # features + per-row entity index + residency accounting, filled by
+        # _scoring_feats / _random_score_device.
+        self._score_feats: Optional[tuple] = None
+        self._score_entity_idx: Optional[Array] = None
+        self._score_cache_bytes: int = 0
         # Device-resident static parts: features / label / weight / entity idx.
         self.device_buckets = []
         for bucket in self.buckets:
@@ -368,6 +484,20 @@ class RandomEffectDeviceData:
         if self.row_split:
             return jax.device_put(leaf, NamedSharding(self.mesh, P()))
         return jax.device_put(leaf, self._sharding(leaf.ndim))
+
+    def gather_buffers(self, i: int) -> tuple[Array, Array]:
+        """Bucket ``i``'s device-resident ``row_index``/mask gather buffers
+        for the residual engine, uploaded on first use (host-mode runs —
+        including the automatic multi-process fallback — never pay for
+        them) and cached for every later iteration."""
+        dev = self.device_buckets[i]
+        if "row_index" not in dev:
+            bucket = self.buckets[i]
+            dev["row_index"] = self._place(jnp.asarray(bucket.row_index))
+            dev["row_mask"] = self._place(
+                jnp.asarray(bucket.row_weight > 0, jnp.float32)
+            )
+        return dev["row_index"], dev["row_mask"]
 
     def batch_for(self, i: int, offsets_b: Array):
         dev = self.device_buckets[i]
@@ -454,6 +584,17 @@ class FixedEffectCoordinate:
     def score(self, model: FixedEffectModel) -> np.ndarray:
         return model.score(self.data)
 
+    def score_device(self, model: FixedEffectModel) -> Array:
+        """Training-data margins as a device array (the residual engine's
+        scoring path); shard features upload once and stay cached.  A model
+        trained on a different feature shard (foreign warm start) scores
+        through its own host path — the cache holds this coordinate's
+        shard."""
+        if model.shard_name != self.config.shard_name:
+            return model.score(self.data)
+        feats, dense = _scoring_feats(self)
+        return model.margins_device(feats, dense)
+
 
 class RandomEffectCoordinate:
     """Per-entity batched GLM fits (reference: RandomEffectCoordinate).
@@ -525,10 +666,13 @@ class RandomEffectCoordinate:
             RandomProjectionMatrix,
         )
 
+        # Per-bucket convergence results stay on device until all bucket
+        # solves have been DISPATCHED: the stats collection below is the one
+        # host sync of the whole train() call, so bucket i+1's solve is
+        # enqueued while bucket i still runs.
+        pending = []
         for i, bucket in enumerate(self.device_data.buckets):
-            offsets_b = jnp.asarray(
-                offsets[bucket.row_index] * (bucket.row_weight > 0), jnp.float32
-            )
+            offsets_b = _bucket_offsets(self.device_data, i, bucket, offsets)
             batch = self.device_data.batch_for(i, offsets_b)
             dev = self.device_data.device_buckets[i]
             entity_idx = dev["entity_index"]
@@ -580,13 +724,17 @@ class RandomEffectCoordinate:
                     var_table = var_table.at[entity_idx].set(
                         proj.lift_variance(variances)
                     )
-            real = bucket.entity_index < num_entities
+            pending.append(
+                (bucket.entity_index < num_entities, result.converged,
+                 result.iterations)
+            )
+        for real, converged, iterations in pending:
             stats["entities"] += int(real.sum())
-            stats["converged"] += int(to_host(result.converged)[real].sum())
+            stats["converged"] += int(to_host(converged)[real].sum())
             if real.any():
                 stats["iterations_max"] = max(
                     stats["iterations_max"],
-                    int(to_host(result.iterations)[real].max()),
+                    int(to_host(iterations)[real].max()),
                 )
         model = RandomEffectModel(
             table=table[:num_entities],
@@ -600,6 +748,11 @@ class RandomEffectCoordinate:
 
     def score(self, model: RandomEffectModel) -> np.ndarray:
         return model.score(self.data)
+
+    def score_device(self, model: RandomEffectModel) -> Array:
+        """Training-data margins as a device array (the residual engine's
+        scoring path)."""
+        return _random_score_device(self, model)
 
 
 class FactoredRandomEffectCoordinate:
@@ -759,10 +912,9 @@ class FactoredRandomEffectCoordinate:
             stats.update({"entities": 0, "converged": 0, "iterations_max": 0})
             for i, bucket in enumerate(self.device_data.buckets):
                 dev = self.device_data.device_buckets[i]
-                offsets_b = self.device_data._place(jnp.asarray(
-                    offsets[bucket.row_index] * (bucket.row_weight > 0),
-                    jnp.float32,
-                ))
+                offsets_b = self.device_data._place(
+                    _bucket_offsets(self.device_data, i, bucket, offsets)
+                )
                 feats = self._project_bucket(dev, latent)
                 batch = DenseBatch(feats, dev["label"], offsets_b, dev["weight"])
                 entity_idx = dev["entity_index"]
@@ -794,6 +946,12 @@ class FactoredRandomEffectCoordinate:
 
     def score(self, model: RandomEffectModel) -> np.ndarray:
         return model.score(self.data)
+
+    def score_device(self, model: RandomEffectModel) -> Array:
+        """Training-data margins as a device array (the residual engine's
+        scoring path; the factored coordinate exports a plain
+        :class:`RandomEffectModel`, so scoring is the same gather-join)."""
+        return _random_score_device(self, model)
 
 
 def build_coordinate(

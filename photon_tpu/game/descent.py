@@ -17,11 +17,14 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional, Sequence
 
-import numpy as np
-
 from photon_tpu.evaluation.evaluators import MultiEvaluator
 from photon_tpu.game.data import GameDataset
 from photon_tpu.game.model import GameModel
+from photon_tpu.game.residuals import (
+    HostResiduals,
+    ResidualEngine,
+    resolve_residual_mode,
+)
 from photon_tpu.telemetry import NULL_SESSION
 from photon_tpu.utils.logging import PhotonLogger
 
@@ -65,6 +68,12 @@ class CoordinateDescent:
 
     ``coordinates`` maps name -> built Coordinate object; iteration order is
     the update order (the reference's coordinateUpdateSequence).
+
+    Residual passing runs in one of two modes (``game.residuals``):
+    ``device`` keeps every coordinate's score vector in a device-resident
+    table and computes each coordinate's training offsets with one jitted
+    kernel; ``host`` is the float64 numpy accumulate the seed shipped with
+    (``PHOTON_RESIDUALS=host`` / ``--residuals host``).
     """
 
     def __init__(
@@ -76,6 +85,7 @@ class CoordinateDescent:
         evaluators: Optional[MultiEvaluator] = None,
         logger: Optional[PhotonLogger] = None,
         telemetry=None,
+        residual_mode: Optional[str] = None,
     ):
         if not coordinates:
             raise ValueError("CoordinateDescent needs at least one coordinate")
@@ -86,6 +96,34 @@ class CoordinateDescent:
         self.evaluators = evaluators
         self.logger = logger or PhotonLogger("photon_tpu.game")
         self.telemetry = telemetry or NULL_SESSION
+        self.residual_mode = resolve_residual_mode(residual_mode)
+
+    def _build_residuals(self):
+        """The residual state for this run: the device engine, or the host
+        float64 path (escape hatch / multi-process fallback)."""
+        cls = ResidualEngine if self.residual_mode == "device" else HostResiduals
+        mesh = next(
+            (c.mesh for c in self.coordinates.values()
+             if getattr(c, "mesh", None) is not None),
+            None,
+        )
+        with self.telemetry.span(
+            "descent.residuals.init", mode=self.residual_mode
+        ):
+            return cls(
+                self.training_data.offset,
+                names=list(self.coordinates),
+                mesh=mesh,
+                telemetry=self.telemetry,
+            )
+
+    def _score(self, coord, model):
+        """Score a coordinate's model over the training data: device path
+        returns a device array (no host round-trip); host path returns the
+        numpy vector the seed produced."""
+        if self.residual_mode == "device" and hasattr(coord, "score_device"):
+            return coord.score_device(model)
+        return coord.score(model)
 
     def _evaluate(self, model: GameModel) -> Dict[str, float]:
         if self.validation_data is None or self.evaluators is None:
@@ -117,19 +155,17 @@ class CoordinateDescent:
             if initial_model is not None and name not in initial_model.coordinates:
                 raise KeyError(f"locked coordinate {name!r} missing from initial model")
 
-        n = self.training_data.num_examples
         models: Dict[str, object] = {}
-        scores: Dict[str, np.ndarray] = {}
+        residuals = self._build_residuals()
         if initial_model is not None:
             for name, coord_model in initial_model.coordinates.items():
                 if name not in self.coordinates:
                     continue
                 models[name] = coord_model
-                scores[name] = np.asarray(
-                    self.coordinates[name].score(coord_model), np.float64
+                residuals.update(
+                    name, self._score(self.coordinates[name], coord_model)
                 )
 
-        base_offset = self.training_data.offset.astype(np.float64)
         best_model: Optional[GameModel] = None
         best_metrics: Dict[str, float] = {}
         history = []
@@ -141,16 +177,26 @@ class CoordinateDescent:
                 for name, coord in self.coordinates.items():
                     if name in locked:
                         continue
-                    offsets = base_offset.copy()
-                    for other, s in scores.items():
-                        if other != name:
-                            offsets += s
+                    offsets = residuals.offsets_for(name)
                     with self.logger.timed(f"iter{it}-{name}"):
                         model, info = coord.train(
-                            offsets.astype(np.float32), initial_model=models.get(name)
+                            offsets, initial_model=models.get(name)
                         )
                     models[name] = model
-                    scores[name] = np.asarray(coord.score(model), np.float64)
+                    residuals.update(name, self._score(coord, model))
+                    cache_bytes = getattr(
+                        getattr(coord, "device_data", None),
+                        "_score_cache_bytes", 0,
+                    )
+                    if cache_bytes:
+                        # The device scoring path's cached feature/index
+                        # residency (a second, replicated copy of the shard
+                        # — see coordinate._scoring_feats): the memory side
+                        # of the transfer trade, next to the engine's
+                        # residuals.device_bytes.
+                        telemetry.gauge(
+                            "residuals.scoring_cache_bytes", coordinate=name
+                        ).set(cache_bytes)
                     telemetry.counter(
                         "descent.coordinate_updates", coordinate=name
                     ).inc()

@@ -69,10 +69,13 @@ class GameEstimator:
         normalization: Optional[Dict[str, NormalizationContext]] = None,
         logger: Optional[PhotonLogger] = None,
         telemetry=None,
+        residual_mode: Optional[str] = None,
     ):
         """``normalization`` is keyed by feature-shard name and applies to
         fixed-effect coordinates on that shard (the reference normalizes the
-        fixed-effect objective only)."""
+        fixed-effect objective only).  ``residual_mode`` selects how descent
+        passes residuals between coordinates (``auto``/``device``/``host`` —
+        see :mod:`photon_tpu.game.residuals`)."""
         self.task_type = task_type
         self.training_data = training_data
         self.validation_data = validation_data
@@ -87,6 +90,7 @@ class GameEstimator:
         self.normalization = normalization or {}
         self.logger = logger or PhotonLogger("photon_tpu.game")
         self.telemetry = telemetry or NULL_SESSION
+        self.residual_mode = residual_mode
         # Device-resident data shared across sweep configurations: building
         # the bucketed random-effect datasets (the reference's shuffle) and
         # uploading feature blocks happens once per distinct data config.
@@ -157,6 +161,7 @@ class GameEstimator:
                     self.evaluators,
                     logger=self.logger,
                     telemetry=self.telemetry,
+                    residual_mode=self.residual_mode,
                 ).run(
                     config.descent_iterations,
                     initial_model=initial_model,

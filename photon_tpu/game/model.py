@@ -98,6 +98,11 @@ class FixedEffectModel:
         feats, dense = _shard_feats(data.shard(self.shard_name))
         return to_host(_fixed_margins(self.coefficients.means, feats, dense))
 
+    def margins_device(self, feats, dense: bool) -> Array:
+        """Device-resident margins against pre-uploaded shard features —
+        the residual engine's scoring path (no host round-trip)."""
+        return _fixed_margins(jnp.asarray(self.coefficients.means), feats, dense)
+
 
 @dataclasses.dataclass(frozen=True)
 class RandomEffectModel:
@@ -139,6 +144,12 @@ class RandomEffectModel:
         return to_host(
             _random_margins(self.table, jnp.asarray(entity_idx), feats, dense)
         )
+
+    def margins_device(self, entity_idx: Array, feats, dense: bool) -> Array:
+        """Device-resident margins against pre-uploaded shard features and a
+        pre-computed per-row entity index — the residual engine's scoring
+        path (the gather-join with no host round-trip)."""
+        return _random_margins(jnp.asarray(self.table), entity_idx, feats, dense)
 
 
 CoordinateModel = "FixedEffectModel | RandomEffectModel"

@@ -1,0 +1,259 @@
+"""Device-resident residual engine for GAME coordinate descent.
+
+The reference's CoordinateDescent passes residuals between coordinates via
+RDD shuffles; the seed rebuilt that as HOST float64 accumulation — every
+coordinate of every outer iteration summed the other coordinates' score
+vectors in numpy, uploaded the result, and fetched the fresh scores back to
+host after rescoring.  That is an O(n · coordinates · iterations) host
+round-trip on the hottest loop of GAME training (Snap ML's hierarchy
+argument, PAPERS.md: keep hot state at the fastest tier).
+
+This engine keeps the residual state on device:
+
+- ``scores`` — ONE stacked ``[C, n]`` float32 table, row ``c`` holding
+  coordinate ``c``'s current score vector, replicated over the mesh when one
+  is given (every shard reads whole score rows).
+- ``total``/``comp`` — a Neumaier-compensated sum of the score rows,
+  refreshed by the same jitted kernel that writes an updated row.  Training
+  offsets for coordinate ``c`` are ``base + (total - scores[c]) + comp`` —
+  one O(n) jitted kernel per coordinate instead of a host O(C·n) float64
+  accumulate + upload.  The compensation term holds the summation parity the
+  host float64 path provided (the f32 table stores exactly what scoring
+  produced; only the cross-coordinate sum ever needed the extra precision).
+- Row updates **donate** the score table (and the total/comp pair), so
+  rescoring a coordinate recycles its row's buffer instead of allocating a
+  second ``[C, n]`` table per update.
+
+Hosts see score data only where the algorithm genuinely needs host values:
+validation metrics once per outer iteration, and model export at the end.
+
+``PHOTON_RESIDUALS=host`` (or the GAME driver's ``--residuals host``)
+restores the seed's host-resident float64 path end to end — the escape
+hatch if a backend misbehaves under donation or long async dispatch chains.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.parallel.mesh import put_replicated
+from photon_tpu.telemetry import NULL_SESSION
+
+Array = jax.Array
+
+
+def resolve_residual_mode(mode: Optional[str] = None) -> str:
+    """Resolve the operative residual mode: ``device`` | ``host``.
+
+    Precedence: explicit ``mode`` argument (driver flag) over the
+    ``PHOTON_RESIDUALS`` env var over the default (``auto`` == device).
+    ``auto`` falls back to ``host`` under multi-process runs — the device
+    engine is single-controller for now (ROADMAP open item) and the host
+    path is known-correct under ``jax.distributed``.  An EXPLICIT
+    ``device`` request on a multi-process run raises instead of silently
+    downgrading: a benchmark that asked for the engine must not quietly
+    measure the host path.
+    """
+    resolved = mode or os.environ.get("PHOTON_RESIDUALS", "").strip().lower() \
+        or "auto"
+    if resolved not in ("auto", "device", "host"):
+        raise ValueError(
+            f"residual mode must be 'auto', 'device' or 'host', got {resolved!r}"
+        )
+    if resolved == "auto":
+        return "host" if jax.process_count() > 1 else "device"
+    if resolved == "device" and jax.process_count() > 1:
+        raise ValueError(
+            "residual mode 'device' was requested explicitly, but the device "
+            "engine is single-controller and this is a multi-process run; "
+            "use 'auto' (falls back to host automatically) or 'host'"
+        )
+    return resolved
+
+
+def _neumaier_rows(scores: Array) -> tuple[Array, Array]:
+    """Compensated column-wise sum of the ``[C, n]`` table -> (total, comp).
+
+    Neumaier's variant of Kahan summation: ``total + comp`` carries the row
+    sum to roughly twice f32 precision, which is what lets the f32 engine
+    match the host float64 accumulate within validation-metric tolerance.
+    """
+    zero = jnp.zeros_like(scores[0])
+
+    def step(carry, row):
+        total, comp = carry
+        t = total + row
+        lost = jnp.where(
+            jnp.abs(total) >= jnp.abs(row),
+            (total - t) + row,
+            (row - t) + total,
+        )
+        return (t, comp + lost), None
+
+    (total, comp), _ = jax.lax.scan(step, (zero, zero), scores)
+    return total, comp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _set_row_and_resum(
+    scores: Array, total: Array, comp: Array, c, new_row: Array
+) -> tuple[Array, Array, Array]:
+    """Write row ``c`` and refresh the compensated total in one program.
+
+    The table and the old total/comp are donated: the update recycles their
+    buffers (XLA aliases the output table onto the input) instead of holding
+    two ``[C, n]`` tables live.  ``total``/``comp`` are recomputed from the
+    full table — never incrementally drifted — so compensation error cannot
+    accumulate across descent iterations.
+    """
+    del total, comp  # recomputed below; parameters exist to donate buffers
+    scores = scores.at[c].set(new_row)
+    new_total, new_comp = _neumaier_rows(scores)
+    return scores, new_total, new_comp
+
+
+@jax.jit
+def _offsets_kernel(base: Array, total: Array, comp: Array,
+                    scores: Array, c) -> Array:
+    """Training offsets for coordinate ``c``: ``base + Σ_{k≠c} scores[k]``
+    as ``base + (total - scores[c]) + comp`` — one fused O(n) program."""
+    return base + ((total - scores[c]) + comp)
+
+
+class ResidualEngine:
+    """Per-coordinate score vectors resident on device with a maintained
+    compensated total (see module docstring).
+
+    ``names`` fixes the row order; ``base_offset`` is the dataset offset
+    (uploaded once).  All arrays are replicated over ``mesh`` when given —
+    the fixed effect re-shards its offsets over the data axis and the
+    random-effect bucket gathers emit entity-sharded blocks, both from the
+    replicated row vectors.
+    """
+
+    def __init__(
+        self,
+        base_offset: np.ndarray,
+        names: Sequence[str],
+        mesh=None,
+        telemetry=None,
+    ):
+        if not names:
+            raise ValueError("ResidualEngine needs at least one coordinate")
+        self.names = list(names)
+        self._row = {name: i for i, name in enumerate(self.names)}
+        if len(self._row) != len(self.names):
+            raise ValueError(f"duplicate coordinate names in {self.names}")
+        self.mesh = mesh
+        self.telemetry = telemetry or NULL_SESSION
+        self.n = int(len(base_offset))
+        base = jnp.asarray(base_offset, jnp.float32)
+        self.base = put_replicated(base, mesh)
+        zeros = jnp.zeros((len(self.names), self.n), jnp.float32)
+        self.scores = put_replicated(zeros, mesh)
+        self.total = put_replicated(jnp.zeros(self.n, jnp.float32), mesh)
+        self.comp = put_replicated(jnp.zeros(self.n, jnp.float32), mesh)
+        # The one-time upload is the device path's entire steady-state h2d
+        # cost for residuals; the host path pays ~2 vectors per coordinate
+        # per iteration (see game.descent counters).
+        self.telemetry.counter(
+            "descent.host_transfer_bytes", direction="h2d", path="residuals"
+        ).inc(self.base.nbytes)
+        self.telemetry.gauge("residuals.device_bytes").set(
+            self.scores.nbytes + self.base.nbytes
+            + self.total.nbytes + self.comp.nbytes
+        )
+
+    def row(self, name: str) -> int:
+        return self._row[name]
+
+    def update(self, name: str, new_scores: Array) -> None:
+        """Replace ``name``'s score row (device array, ``[n]``) and refresh
+        the compensated total.  Donates the previous table buffers."""
+        if isinstance(new_scores, np.ndarray):
+            # A host score vector entering the device table is a real h2d
+            # transfer (warm-start models scored on host, or a coordinate
+            # without a device scoring path) — count it.
+            self.telemetry.counter(
+                "descent.host_transfer_bytes", direction="h2d", path="residuals"
+            ).inc(new_scores.size * 4)
+        new_row = jnp.asarray(new_scores, jnp.float32)
+        if new_row.shape != (self.n,):
+            raise ValueError(
+                f"score vector for {name!r} has shape {new_row.shape}, "
+                f"want ({self.n},)"
+            )
+        with self.telemetry.span("residuals.update", coordinate=name):
+            self.scores, self.total, self.comp = _set_row_and_resum(
+                self.scores, self.total, self.comp, self._row[name], new_row
+            )
+        self.telemetry.counter("residuals.updates", coordinate=name).inc()
+
+    def offsets_for(self, name: str) -> Array:
+        """Training offsets for ``name``: ``base + Σ_{other} scores`` as one
+        jitted device kernel; float32, shape ``[n]``, replicated."""
+        with self.telemetry.span("residuals.offsets", coordinate=name):
+            return _offsets_kernel(
+                self.base, self.total, self.comp, self.scores, self._row[name]
+            )
+
+    def scores_for(self, name: str) -> Array:
+        """Coordinate ``name``'s current score row (device view)."""
+        return self.scores[self._row[name]]
+
+
+class HostResiduals:
+    """The seed's host-resident float64 residual path — the escape hatch.
+
+    Scores live on host as float64 numpy vectors; offsets for a coordinate
+    are accumulated in float64 and cast to float32, bit-for-bit the
+    pre-engine behavior.  Every coordinate of every outer iteration pays one
+    O(C·n) host accumulate, one h2d offsets upload, and one d2h score fetch;
+    the same telemetry counters the device engine emits make that recurring
+    cost visible next to the engine's one-time upload.
+    """
+
+    def __init__(
+        self,
+        base_offset: np.ndarray,
+        names: Sequence[str] = (),
+        mesh=None,
+        telemetry=None,
+    ):
+        del names, mesh  # same signature as ResidualEngine; state is host-only
+        self.base = np.asarray(base_offset, np.float64)
+        self.scores: dict = {}
+        self.telemetry = telemetry or NULL_SESSION
+
+    def update(self, name: str, new_scores) -> None:
+        """Store ``name``'s score vector on host (fetching it if needed)."""
+        host = np.asarray(new_scores, np.float64)
+        if host.shape != self.base.shape:
+            raise ValueError(
+                f"score vector for {name!r} has shape {host.shape}, "
+                f"want {self.base.shape}"
+            )
+        self.scores[name] = host
+        # The fetch moved one f32 score vector device→host.
+        self.telemetry.counter(
+            "descent.host_transfer_bytes", direction="d2h", path="residuals"
+        ).inc(host.size * 4)
+        self.telemetry.counter("residuals.updates", coordinate=name).inc()
+
+    def offsets_for(self, name: str) -> np.ndarray:
+        """float32 host offsets; the coordinate's train() uploads them."""
+        offsets = self.base.copy()
+        for other, s in self.scores.items():
+            if other != name:
+                offsets += s
+        out = offsets.astype(np.float32)
+        self.telemetry.counter(
+            "descent.host_transfer_bytes", direction="h2d", path="residuals"
+        ).inc(out.nbytes)
+        return out

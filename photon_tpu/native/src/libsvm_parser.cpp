@@ -14,6 +14,7 @@
 
 #include <algorithm>
 #include <charconv>
+#include <clocale>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -49,6 +50,16 @@ unsigned nthreads(int64_t rows) {
 // magnitudes become +/-inf and underflows become 0/denormal exactly as
 // before (the svm_open terminator guarantee keeps strtof in bounds).
 // Returns the end of the parsed token, or `p` itself on no-parse.
+//
+// libstdc++ shipped floating-point from_chars only from GCC 11
+// (__cpp_lib_to_chars); older toolchains take a strtof path for every
+// token, shimmed for cross-toolchain parity: strtof ALSO skips leading
+// whitespace (refused up front — from_chars and the Python reference both
+// reject it), accepts hex floats ("0x2" must parse as the leading zero
+// only, like from_chars' general format), and honors LC_NUMERIC (a
+// comma-decimal locale set by any host library would reparse "1.5" as "1"),
+// so glibc builds parse under a cached "C" locale via strtof_l.
+#if defined(__cpp_lib_to_chars)
 inline const char* parse_float(const char* p, const char* end, float* out) {
   const char* q = p;
   // Skip one '+' only when a number follows: "+-2.5" must stay a parse
@@ -66,6 +77,31 @@ inline const char* parse_float(const char* p, const char* end, float* out) {
   }
   return p;
 }
+#else
+inline const char* parse_float(const char* p, const char* end, float* out) {
+  if (p >= end || *p == ' ' || *p == '\t' || *p == '\n' || *p == '\r' ||
+      *p == '\f' || *p == '\v')
+    return p;
+  const char* q = p;
+  if (*q == '+' || *q == '-') ++q;
+  if (q + 1 < end && q[0] == '0' && (q[1] == 'x' || q[1] == 'X')) {
+    // from_chars parity: hex is not in the general format — "0x2" parses
+    // as the leading zero and stops at the 'x'.
+    *out = (*p == '-') ? -0.0f : 0.0f;
+    return q + 1;
+  }
+  char* ep = nullptr;
+#if defined(__GLIBC__)
+  static const locale_t c_locale = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  *out = c_locale != (locale_t)0 ? strtof_l(p, &ep, c_locale)
+                                 : strtof(p, &ep);
+#else
+  *out = strtof(p, &ep);
+#endif
+  if (ep == p || ep > end) return p;
+  return ep;
+}
+#endif
 
 }  // namespace
 

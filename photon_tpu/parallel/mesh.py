@@ -104,6 +104,21 @@ def shard_batch(
     return jax.device_put(padded, batch_sharding(mesh, padded, axis_name))
 
 
+def put_replicated(x, mesh: Optional[Mesh]):
+    """Place a pytree of arrays fully replicated over ``mesh``.
+
+    ``mesh=None`` (single device) just materializes the leaves as device
+    arrays.  The residual engine and the coordinate scoring caches use this
+    for state every shard reads whole (score rows, offsets, feature shards
+    for scoring): replication makes the per-coordinate offset kernels pure
+    element-wise programs with no collectives.
+    """
+    if mesh is None:
+        return jax.tree.map(jnp.asarray, x)
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), x)
+
+
 def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 

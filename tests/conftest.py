@@ -63,6 +63,29 @@ os.environ.pop("PHOTON_REAL_DATA_DIR", None)
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session")
+def native_router():
+    """The native ``_photon_native.so``, building it once per session.
+
+    ``build.get_lib`` caches both on disk (the compiled .so survives across
+    sessions) and in process (a failed build costs one attempt), so this
+    fixture is effectively free after the first use.  Tests whose routes
+    exceed the pure-Python edge-colorer's size cap (ops/clos.py) depend on
+    it; when no working C++ toolchain is present they skip with a reason
+    instead of erroring out of ``route_permutation``.
+    """
+    from photon_tpu.native import build
+
+    lib = build.get_lib()
+    if lib is None:
+        pytest.skip(
+            "native _photon_native.so unavailable (no working C++ toolchain "
+            "to build clos_edge_color; routes over the Python fallback cap "
+            "cannot be colored)"
+        )
+    return lib
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Bound the CPU client's accumulated compiled-executable state.

@@ -1,0 +1,247 @@
+"""Device-resident residual engine (game/residuals.py): parity with the
+seed's host float64 path, donation safety, and mode resolution.
+
+The ISSUE-2 acceptance bar: the device path's validation metrics must pin to
+the host-path reference within 1e-4 on a synthetic GAME fit, and donated
+score-table buffers must never be read after donation (scores reproducible
+across two identical runs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from photon_tpu.core.objective import RegularizationContext  # noqa: E402
+from photon_tpu.core.optimizers import OptimizerConfig  # noqa: E402
+from photon_tpu.core.problem import ProblemConfig  # noqa: E402
+from photon_tpu.data.synthetic import make_game_dataset  # noqa: E402
+from photon_tpu.game.coordinate import (  # noqa: E402
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import split_game_dataset  # noqa: E402
+from photon_tpu.game.estimator import (  # noqa: E402
+    GameEstimator,
+    GameOptimizationConfiguration,
+)
+from photon_tpu.game.residuals import (  # noqa: E402
+    HostResiduals,
+    ResidualEngine,
+    resolve_residual_mode,
+)
+from photon_tpu.telemetry import TelemetrySession  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Engine-level parity and donation safety
+# ---------------------------------------------------------------------------
+
+
+def _random_scores(n: int, n_coords: int, seed: int = 0) -> list:
+    rng = np.random.default_rng(seed)
+    # Spread magnitudes so compensated summation has real work to do.
+    return [
+        (rng.standard_normal(n) * 10.0 ** (i - 1)).astype(np.float32)
+        for i in range(n_coords)
+    ]
+
+
+def test_engine_offsets_match_host_reference():
+    n, names = 257, ["a", "b", "c", "d"]
+    rng = np.random.default_rng(3)
+    base = rng.standard_normal(n).astype(np.float32)
+    scores = _random_scores(n, len(names), seed=4)
+
+    engine = ResidualEngine(base, names=names)
+    host = HostResiduals(base)
+    for name, s in zip(names, scores):
+        engine.update(name, jnp.asarray(s))
+        host.update(name, s)
+
+    for name in names:
+        dev = np.asarray(engine.offsets_for(name))
+        ref = host.offsets_for(name)
+        np.testing.assert_allclose(dev, ref, rtol=0, atol=1e-4)
+
+
+def test_engine_partial_updates_exclude_own_row():
+    n = 64
+    base = np.zeros(n, np.float32)
+    engine = ResidualEngine(base, names=["x", "y"])
+    sx = np.full(n, 2.0, np.float32)
+    engine.update("x", jnp.asarray(sx))
+    # y's offsets see x's scores; x's offsets see only zeros (y unset).
+    np.testing.assert_allclose(np.asarray(engine.offsets_for("y")), sx)
+    np.testing.assert_allclose(
+        np.asarray(engine.offsets_for("x")), np.zeros(n, np.float32)
+    )
+
+
+def test_engine_update_rejects_bad_shape_and_duplicate_names():
+    engine = ResidualEngine(np.zeros(8, np.float32), names=["a"])
+    with pytest.raises(ValueError, match="shape"):
+        engine.update("a", jnp.zeros(9, jnp.float32))
+    with pytest.raises(ValueError, match="duplicate"):
+        ResidualEngine(np.zeros(8, np.float32), names=["a", "a"])
+    with pytest.raises(ValueError, match="at least one"):
+        ResidualEngine(np.zeros(8, np.float32), names=[])
+
+
+def test_donation_safety_two_runs_identical():
+    """Updates donate the score table; a second identical run must produce
+    bit-identical offsets (any use-after-donate would corrupt or raise)."""
+    n, names = 513, ["f", "r0", "r1"]
+    base = np.linspace(-1, 1, n).astype(np.float32)
+    score_seq = [_random_scores(n, len(names), seed=s) for s in (7, 8, 9)]
+
+    def run() -> list:
+        engine = ResidualEngine(base, names=names)
+        outs = []
+        for scores in score_seq:  # three "descent iterations"
+            for name, s in zip(names, scores):
+                outs.append(np.asarray(engine.offsets_for(name)).copy())
+                engine.update(name, jnp.asarray(s))
+        outs.append(np.asarray(engine.scores_for("r1")).copy())
+        return outs
+
+    first, second = run(), run()
+    assert len(first) == len(second)
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_compensated_sum_beats_naive_f32():
+    """The Neumaier total must recover a small signal buried under large
+    cancelling rows — the regime where naive f32 accumulation loses the
+    parity the host float64 path provides."""
+    n = 128
+    big = np.full(n, 3e7, np.float32)
+    small = np.full(n, 0.5, np.float32)
+    engine = ResidualEngine(
+        np.zeros(n, np.float32), names=["big", "neg", "small", "probe"]
+    )
+    engine.update("big", jnp.asarray(big))
+    engine.update("neg", jnp.asarray(-big))
+    engine.update("small", jnp.asarray(small))
+    # Σ other = big - big + small: exact answer 0.5 everywhere.
+    out = np.asarray(engine.offsets_for("probe"))
+    np.testing.assert_allclose(out, small, rtol=0, atol=1e-6)
+
+
+def test_engine_counts_one_upload_and_tracks_updates():
+    session = TelemetrySession("test-residuals")
+    base = np.zeros(100, np.float32)
+    engine = ResidualEngine(base, names=["a", "b"], telemetry=session)
+    engine.update("a", jnp.ones(100, jnp.float32))
+    engine.offsets_for("b")
+    h2d = session.counter(
+        "descent.host_transfer_bytes", direction="h2d", path="residuals"
+    ).value
+    assert h2d == base.nbytes  # the one-time base upload; device rows free
+    assert session.counter("residuals.updates", coordinate="a").value == 1
+
+
+# ---------------------------------------------------------------------------
+# Mode resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_residual_mode(monkeypatch):
+    monkeypatch.delenv("PHOTON_RESIDUALS", raising=False)
+    assert resolve_residual_mode() == "device"
+    assert resolve_residual_mode("host") == "host"
+    monkeypatch.setenv("PHOTON_RESIDUALS", "host")
+    assert resolve_residual_mode() == "host"
+    # Explicit argument wins over the env var.
+    assert resolve_residual_mode("device") == "device"
+    monkeypatch.setenv("PHOTON_RESIDUALS", "nonsense")
+    with pytest.raises(ValueError, match="residual mode"):
+        resolve_residual_mode()
+
+
+def test_resolve_residual_mode_multiprocess(monkeypatch):
+    """``auto`` falls back to host under multi-process (the engine is
+    single-controller); an EXPLICIT device request raises instead of
+    silently measuring the host path."""
+    import photon_tpu.game.residuals as residuals_mod
+
+    monkeypatch.delenv("PHOTON_RESIDUALS", raising=False)
+    monkeypatch.setattr(residuals_mod.jax, "process_count", lambda: 2)
+    assert resolve_residual_mode() == "host"
+    assert resolve_residual_mode("auto") == "host"
+    assert resolve_residual_mode("host") == "host"
+    with pytest.raises(ValueError, match="single-controller"):
+        resolve_residual_mode("device")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end parity on a synthetic GAME fit
+# ---------------------------------------------------------------------------
+
+
+def _fit_metrics(mode: str) -> dict:
+    data, _ = make_game_dataset(30, 10, 6, 4, seed=11, n_random_coords=2)
+    train, val = split_game_dataset(data, 0.25)
+
+    def problem(lam: float, max_iters: int) -> ProblemConfig:
+        return ProblemConfig(
+            regularization=RegularizationContext("l2", lam),
+            optimizer_config=OptimizerConfig(max_iterations=max_iters),
+        )
+
+    config = GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", problem(0.01, 40)),
+            "re0": RandomEffectCoordinateConfig("re0", "re0", problem(1.0, 20)),
+            "re1": RandomEffectCoordinateConfig("re1", "re1", problem(1.0, 20)),
+        },
+        descent_iterations=2,
+    )
+    estimator = GameEstimator(
+        "logistic_regression", train, val, residual_mode=mode
+    )
+    return estimator.fit([config])[0].metrics
+
+
+def test_score_device_foreign_model_uses_model_layout():
+    """score_device must honor the MODEL's shard/entity layout: a foreign
+    warm start (different shard_name/entity_column than the coordinate's
+    config) falls back to the model's own host scoring path instead of
+    silently scoring against the coordinate's cached device features."""
+    import dataclasses
+
+    from photon_tpu.game.coordinate import build_coordinate
+
+    data, _ = make_game_dataset(20, 6, 6, 4, seed=5, n_random_coords=2)
+    coord = build_coordinate(
+        data,
+        RandomEffectCoordinateConfig(
+            "re0", "re0",
+            ProblemConfig(
+                regularization=RegularizationContext("l2", 1.0),
+                optimizer_config=OptimizerConfig(max_iterations=5),
+            ),
+        ),
+        "logistic_regression",
+    )
+    model, _ = coord.train(np.zeros(data.num_examples, np.float32))
+    np.testing.assert_allclose(
+        np.asarray(coord.score_device(model)), model.score(data), atol=1e-5
+    )
+    foreign = dataclasses.replace(model, shard_name="re1", entity_column="re1")
+    np.testing.assert_allclose(
+        np.asarray(coord.score_device(foreign)), foreign.score(data),
+        atol=1e-5,
+    )
+
+
+def test_game_fit_device_matches_host_within_1e4():
+    host = _fit_metrics("host")
+    device = _fit_metrics("device")
+    assert host and device
+    for name, ref in host.items():
+        assert abs(device[name] - ref) < 1e-4, (name, device[name], ref)

@@ -150,6 +150,15 @@ def test_stream_scale_mp_bench_mode(tmp_path):
     pass runs, the JSON line parses, and the (value, |grad|) cross-check
     against the single-process pass holds (both CPU-pinned workers)."""
     line = _run_stream_scale_bench(tmp_path, "--stream-scale-mp", 2000)
+    if line["metric"] == "bench_error":
+        # Some jaxlibs cannot run cross-process collectives on the CPU
+        # backend at all; that is a platform limitation, not a bench bug
+        # (same signatures test_multiprocess skips on).
+        from bench import MP_UNSUPPORTED_MARKERS
+
+        err = str(line["detail"].get("error", ""))
+        if any(marker in err for marker in MP_UNSUPPORTED_MARKERS):
+            pytest.skip(f"platform cannot run multi-process JAX: {err[:200]}")
     assert line["metric"] == "config5_stream_mp_rows_per_sec"
     assert line["detail"]["processes"] == 2
     assert line["detail"]["rows"] == 2000

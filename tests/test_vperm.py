@@ -29,6 +29,12 @@ from photon_tpu.ops.vperm import (
 CS = CH_SMALL * LANES
 INTERP = jax.default_backend() != "tpu"
 
+# Tests below route permutations past the pure-Python edge-colorer's size
+# cap (ops/clos.py): they need the native library, which the session-scoped
+# conftest fixture builds once (and skips, with a reason, when no C++
+# toolchain can build it).
+needs_native_router = pytest.mark.usefixtures("native_router")
+
 
 def _check(n, seed):
     rng = np.random.default_rng(seed)
@@ -41,27 +47,32 @@ def _check(n, seed):
     return route
 
 
+@needs_native_router
 def test_single_chunk_exact():
     route = _check(CS, seed=0)
     assert route.nc == 1
 
 
+@needs_native_router
 def test_single_chunk_padded():
     route = _check(CS - 12345, seed=1)
     assert route.nc == 1
 
 
+@needs_native_router
 def test_multi_chunk_exact():
     route = _check(2 * CS, seed=2)
     assert route.nc == 2
 
 
+@needs_native_router
 def test_multi_chunk_padded_to_pow2():
     # ceil(n/CS) == 3 pads to NC = 4 so the middle stage lane-packs.
     route = _check(3 * CS - 777, seed=3)
     assert route.nc == 4
 
 
+@needs_native_router
 def test_inverse_roundtrip():
     n = 2 * CS
     rng = np.random.default_rng(4)
@@ -91,6 +102,7 @@ def test_rejects_oversize():
         pick_geometry(MAX_N + 1)
 
 
+@needs_native_router
 def test_rectangular_bijection_route():
     # n_in != n_out: a source stream routed into a longer destination
     # stream with pad destinations (dest_src < 0) carrying zeros — the
@@ -117,6 +129,7 @@ def test_rectangular_bijection_route():
     np.testing.assert_array_equal(back, x)
 
 
+@needs_native_router
 def test_cumsum_reduce_precision_under_cancellation(monkeypatch):
     """The compensated prefix sum must recover small per-feature sums
     buried under a large-magnitude running prefix — the failure mode of
@@ -146,6 +159,7 @@ def test_cumsum_reduce_precision_under_cancellation(monkeypatch):
 
 
 @pytest.mark.parametrize("zipf", [False, True])
+@needs_native_router
 def test_balanced_route_multi_chunk_matches_oracle(zipf):
     """The coloring-free balanced exchange at NC > 1 (two chunk passes
     around one block transpose) must reproduce the oracle gradient."""
@@ -181,6 +195,7 @@ def test_balanced_route_multi_chunk_matches_oracle(zipf):
                                atol=5e-3)
 
 
+@needs_native_router
 def test_xchg_bf16_payload_close_to_f32(monkeypatch):
     """PHOTON_XCHG_DTYPE=bfloat16 rides the exchange at half width; the
     reduce stays f32, so gradients track the f32 path to bf16 product
@@ -204,6 +219,7 @@ def test_xchg_bf16_payload_close_to_f32(monkeypatch):
 
 
 @pytest.mark.parametrize("k,n_off", [(32, 0), (32, -1), (6, 0)])
+@needs_native_router
 def test_fused_dz_expansion_matches_oracle(monkeypatch, k, n_off):
     """The stage-A fused dz expansion (k | 128) must reproduce the
     oracle; (32, -1) makes cs_real indivisible by k so the window
@@ -237,6 +253,7 @@ def test_fused_dz_expansion_matches_oracle(monkeypatch, k, n_off):
                                atol=5e-3)
 
 
+@needs_native_router
 def test_balanced_aligned_route_multi_chunk(monkeypatch):
     """The balanced exchange into the ALIGNED slot stream (repack +
     position-reduce) must reproduce the oracle at NC > 1."""
@@ -325,6 +342,7 @@ def test_route_cache_round_trip(monkeypatch, tmp_path):
                                atol=2e-4)
 
 
+@needs_native_router
 def test_xchg_segment_grad_matches_oracle():
     from photon_tpu.ops.pallas_gather import (
         build_aligned_layout,
@@ -352,6 +370,7 @@ def test_xchg_segment_grad_matches_oracle():
                                atol=2e-4)
 
 
+@needs_native_router
 def test_balanced_nc3_chunk_height_sublane_aligned(monkeypatch):
     """Non-power-of-two NC (e.g. 3) must still yield a chunk height that
     is a multiple of 8*nc: Mosaic's f32 sublane tile is 8, and a block
@@ -414,6 +433,7 @@ def test_baked_vals_guard_rejects_stale_stream(monkeypatch):
         )
 
 
+@needs_native_router
 def test_threaded_chunk_colorings_match_serial(monkeypatch):
     """PHOTON_ROUTE_THREADS > 1 must produce a route with identical
     applied results to the serial build (the colorings are independent;
