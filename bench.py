@@ -459,16 +459,13 @@ def _bench_config(num: int) -> None:
     })
 
 
-def _bench_descent() -> None:
-    """GAME coordinate-descent residual micro-bench (``--mode descent``).
-
-    Runs the SAME synthetic multi-coordinate GAME fit twice — once under the
-    seed's host float64 residual path (``PHOTON_RESIDUALS=host``) and once
-    under the device-resident residual engine (``game/residuals.py``) — and
-    emits one JSON line whose value is the device path's descent
-    iterations/sec, with the host path's number and the speedup in detail.
-    Each mode is timed on its SECOND fit: the first pays compilation and the
-    estimator's one-time device-data upload, which both modes share.
+def _game_bench_fixture(n_random_coords: int, descent_iterations: int):
+    """Shared synthetic-fit fixture of the GAME micro-benches: one dataset
+    + configuration sized so the path under test (residual passing /
+    validation) is a visible slice of the wall clock — solver work is
+    capped at a few inner iterations.  ~200k rows x coordinates on CPU:
+    below that, solve noise swamps the deltas.  ONE builder so the descent
+    and validation benches can never drift onto differently-shaped fits.
     """
     import jax
 
@@ -480,37 +477,49 @@ def _bench_descent() -> None:
         FixedEffectCoordinateConfig,
         RandomEffectCoordinateConfig,
     )
-    from photon_tpu.game.estimator import (
-        GameEstimator,
-        GameOptimizationConfiguration,
-    )
+    from photon_tpu.game.estimator import GameOptimizationConfiguration
 
     platform = jax.devices()[0].platform
     big = platform != "cpu"
-    # Residual traffic scales with rows x coordinates x iterations; solver
-    # work is capped (few inner iterations) so the residual path — the thing
-    # under test — is a visible slice of the wall clock.  ~200k rows x 4
-    # coordinates on CPU: below that, solve noise swamps the residual delta.
     n_entities, rows_mean = (20_000, 50) if big else (8000, 25)
-    iters = 3
     data, _ = make_game_dataset(
-        n_entities, rows_mean, 32, 8, seed=0, n_random_coords=3
+        n_entities, rows_mean, 32, 8, seed=0,
+        n_random_coords=n_random_coords,
     )
 
-    def _problem(lam: float, max_iters: int) -> ProblemConfig:
+    def problem(lam: float, max_iters: int) -> ProblemConfig:
         return ProblemConfig(
             regularization=RegularizationContext("l2", lam),
             optimizer_config=OptimizerConfig(max_iterations=max_iters),
         )
 
+    coordinates = {"fixed": FixedEffectCoordinateConfig("global", problem(0.01, 5))}
+    for i in range(n_random_coords):
+        coordinates[f"re{i}"] = RandomEffectCoordinateConfig(
+            f"re{i}", f"re{i}", problem(1.0, 4)
+        )
     config = GameOptimizationConfiguration(
-        coordinates={
-            "fixed": FixedEffectCoordinateConfig("global", _problem(0.01, 5)),
-            "re0": RandomEffectCoordinateConfig("re0", "re0", _problem(1.0, 4)),
-            "re1": RandomEffectCoordinateConfig("re1", "re1", _problem(1.0, 4)),
-            "re2": RandomEffectCoordinateConfig("re2", "re2", _problem(1.0, 4)),
-        },
-        descent_iterations=iters,
+        coordinates=coordinates, descent_iterations=descent_iterations
+    )
+    return platform, n_entities, data, config
+
+
+def _bench_descent() -> None:
+    """GAME coordinate-descent residual micro-bench (``--mode descent``).
+
+    Runs the SAME synthetic multi-coordinate GAME fit twice — once under the
+    seed's host float64 residual path (``PHOTON_RESIDUALS=host``) and once
+    under the device-resident residual engine (``game/residuals.py``) — and
+    emits one JSON line whose value is the device path's descent
+    iterations/sec, with the host path's number and the speedup in detail.
+    Each mode is timed on its SECOND fit: the first pays compilation and the
+    estimator's one-time device-data upload, which both modes share.
+    """
+    from photon_tpu.game.estimator import GameEstimator
+
+    iters = 3
+    platform, n_entities, data, config = _game_bench_fixture(
+        n_random_coords=3, descent_iterations=iters
     )
 
     walls = {}
@@ -537,6 +546,87 @@ def _bench_descent() -> None:
         "host_iters_per_sec": round(iters / walls["host"], 3),
         "speedup_vs_host": round(walls["host"] / walls["device"], 3),
         "rows_per_sec": round(iters * data.num_examples / walls["device"], 1),
+        "platform": platform,
+    })
+
+
+def _bench_validation() -> None:
+    """GAME validation-pipeline micro-bench (``--mode validation``).
+
+    Fits one synthetic multi-coordinate GAME model, then times the per-
+    outer-iteration validation step both ways on the SAME fit: the seed's
+    host path (full ``GameModel.score`` fetch + numpy evaluator pass, once
+    per iteration) against the device pipeline (incremental re-score of the
+    one coordinate that "just trained", compensated composite, jitted
+    device metrics — one scalar sync per metric).  Emits one JSON line
+    whose value is the device path's validation rows/sec.
+    """
+    from photon_tpu.evaluation.evaluators import MultiEvaluator, get_evaluator
+    from photon_tpu.game.data import split_game_dataset
+    from photon_tpu.game.estimator import GameEstimator
+    from photon_tpu.game.model import DeviceScoringCache
+    from photon_tpu.game.residuals import ValidationEngine
+
+    platform, _, data, config = _game_bench_fixture(
+        n_random_coords=2, descent_iterations=1
+    )
+    train, val = split_game_dataset(data, 0.25)
+    evaluators = MultiEvaluator(
+        [get_evaluator("auc"), get_evaluator("logistic_loss"),
+         get_evaluator("sharded_auc:re0")]
+    )
+    model = GameEstimator(
+        "logistic_regression", train, val, evaluators=evaluators
+    ).fit([config])[0].model
+    names = list(model.coordinates)
+    n_val, iters, reps = val.num_examples, 8, 3
+
+    # Host path: what every outer iteration used to pay — full composite
+    # re-score (margins of EVERY coordinate to host) + numpy evaluators.
+    def host_pass() -> None:
+        scores = model.score(val)
+        evaluators.evaluate(scores, val.label, val.weight, dict(val.id_columns))
+
+    host_pass()  # warm-up: jitted per-coordinate margins compile
+    host_best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            host_pass()
+        host_best = min(host_best, time.perf_counter() - t0)
+
+    # Device pipeline: the steady state of descent — only the coordinate
+    # that just trained re-scores; metrics are jitted device kernels.
+    cache = DeviceScoringCache(val)
+    engine = ValidationEngine(val.offset, names=names)
+    entity_ids = {"re0": cache.entity_codes("re0")}
+    for name in names:
+        engine.update(name, cache.score(model.coordinates[name]))
+
+    def device_pass(i: int) -> None:
+        name = names[i % len(names)]
+        engine.update(name, cache.score(model.coordinates[name]))
+        evaluators.evaluate(
+            engine.composite(), cache.label, cache.weight, entity_ids
+        )
+
+    device_pass(0)  # warm-up: metric kernels compile
+    device_best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            device_pass(i)
+        device_best = min(device_best, time.perf_counter() - t0)
+
+    _emit("game_validation_rows_per_sec", iters * n_val / device_best, "rows/s", {
+        "validation_rows": n_val,
+        "iterations": iters,
+        "coordinates": len(names),
+        "metrics": [ev.name for ev in evaluators.evaluators],
+        "device_seconds": round(device_best, 4),
+        "host_seconds": round(host_best, 4),
+        "host_rows_per_sec": round(iters * n_val / host_best, 1),
+        "speedup_vs_host": round(host_best / device_best, 3),
         "platform": platform,
     })
 
@@ -903,12 +993,14 @@ def main() -> None:
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--mode":
         mode = sys.argv[2] if len(sys.argv) > 2 else ""
-        if mode != "descent":
+        if mode not in ("descent", "validation"):
             # An unknown mode must not silently fall through to the full
             # (minutes-long) default run; the raise reaches the top-level
             # handler and emits a bench_error JSON line.
-            raise ValueError(f"unknown bench mode {mode!r}; valid: descent")
-        _bench_descent()
+            raise ValueError(
+                f"unknown bench mode {mode!r}; valid: descent, validation"
+            )
+        (_bench_descent if mode == "descent" else _bench_validation)()
         return
     if len(sys.argv) <= 1 or sys.argv[1] != "--headline-only":
         # Default run: all five SURVEY.md §6 configs first (one JSON line
@@ -936,20 +1028,23 @@ def main() -> None:
                 _emit(f"config{num}_error", 0.0, "error", {
                     "error": f"{type(ex).__name__}: {ex}"[:500],
                 })
-        # The GAME residual-engine micro-bench rides the full run (its JSON
-        # line lands next to the headline), same budget guard + isolation
-        # as the numbered configs.
-        elapsed = time.perf_counter() - t_start
-        if elapsed > budget_s:
-            _emit("game_descent_skipped", 0.0, "skipped", {
-                "reason": f"bench budget exhausted after {elapsed:.0f}s; "
-                          "run `bench.py --mode descent` individually",
-            })
-        else:
+        # The GAME residual-engine and validation-pipeline micro-benches
+        # ride the full run (their JSON lines land next to the headline),
+        # same budget guard + isolation as the numbered configs.
+        for label, fn in (("game_descent", _bench_descent),
+                          ("game_validation", _bench_validation)):
+            elapsed = time.perf_counter() - t_start
+            if elapsed > budget_s:
+                _emit(f"{label}_skipped", 0.0, "skipped", {
+                    "reason": f"bench budget exhausted after {elapsed:.0f}s; "
+                              f"run `bench.py --mode "
+                              f"{label.split('_', 1)[1]}` individually",
+                })
+                continue
             try:
-                _bench_descent()
+                fn()
             except Exception as ex:  # noqa: BLE001 — config isolation
-                _emit("game_descent_error", 0.0, "error", {
+                _emit(f"{label}_error", 0.0, "error", {
                     "error": f"{type(ex).__name__}: {ex}"[:500],
                 })
     import jax

@@ -76,10 +76,19 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "device", "host"),
                    help="residual passing between coordinates: 'device' "
                    "keeps per-coordinate score vectors in a device-resident "
-                   "table (default via auto), 'host' restores the float64 "
-                   "numpy accumulate (escape hatch; also the automatic "
-                   "fallback under multi-process runs).  Overrides "
+                   "sharded table (default via auto; SPMD-safe, runs under "
+                   "multi-process meshes), 'host' restores the float64 "
+                   "numpy accumulate (escape hatch).  Overrides "
                    "PHOTON_RESIDUALS")
+    p.add_argument("--validation-pipeline", default=None,
+                   choices=("auto", "device", "host"),
+                   help="validation scoring/evaluation: 'device' keeps a "
+                   "per-coordinate validation score table on device, "
+                   "re-scores only retrained coordinates, and runs the "
+                   "jitted metrics (one scalar sync per metric); 'host' "
+                   "restores the full per-iteration GameModel.score fetch "
+                   "+ numpy evaluators.  'auto' (default) follows "
+                   "--residuals.  Overrides PHOTON_VALIDATION")
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"),
                    help="storage dtype for FEATURE VALUES in every shard "
@@ -429,6 +438,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         logger=logger,
         telemetry=session,
         residual_mode=args.residuals,
+        validation_mode=args.validation_pipeline,
     )
 
     import jax as _jax

@@ -6,6 +6,12 @@ follow the reference's CLI vocabulary: ``AUC``, ``RMSE``, ``LOGISTIC_LOSS``,
 ``POISSON_LOSS``, ``SQUARED_LOSS``, ``SMOOTHED_HINGE_LOSS``,
 ``PRECISION@k`` (e.g. ``PRECISION@10``), and sharded variants
 ``SHARDED_AUC:<id_col>`` / ``SHARDED_PRECISION@k:<id_col>``.
+
+Evaluators accept DEVICE arrays throughout (the on-device validation
+pipeline — ``game.descent``): the headline metrics are jitted JAX already,
+and the sharded variants route to ``metrics.sharded_metric_device`` when
+handed ``(entity_codes, num_segments)`` instead of raw entity ids — one
+jitted segment-reduce per metric, one scalar host sync each.
 """
 
 from __future__ import annotations
@@ -29,6 +35,10 @@ class Evaluator:
     maximize: bool
     entity_column: Optional[str] = None  # set for sharded evaluators
     requires_both_classes: bool = False
+    # Device segment-reduce routing for sharded evaluators: the
+    # metrics.sharded_metric_device kind ("auc" | "precision") and its k.
+    device_kind: Optional[str] = None
+    device_k: int = 10
 
     def evaluate(
         self,
@@ -41,6 +51,21 @@ class Evaluator:
             if entity_ids is None:
                 raise ValueError(
                     f"evaluator {self.name} needs entity ids ({self.entity_column})"
+                )
+            if isinstance(entity_ids, tuple):
+                # (entity_codes, num_segments) — the device validation
+                # pipeline's pre-coded ids: one jitted segment-reduce, one
+                # scalar sync (the float()).
+                if self.device_kind is None:
+                    raise ValueError(
+                        f"evaluator {self.name} has no device sharded path"
+                    )
+                codes, num_segments = entity_ids
+                return float(
+                    M.sharded_metric_device(
+                        self.device_kind, scores, labels, codes,
+                        num_segments, weights, k=self.device_k,
+                    )
                 )
             return float(
                 M.sharded_metric(
@@ -129,6 +154,7 @@ def get_evaluator(name: str) -> Evaluator:
                 maximize=True,
                 entity_column=col,
                 requires_both_classes=True,
+                device_kind="auc",
             )
         k = int(k_str)
         return Evaluator(
@@ -136,6 +162,8 @@ def get_evaluator(name: str) -> Evaluator:
             lambda s, l, w=None, k=k: M.precision_at_k(s, l, w, k),
             maximize=True,
             entity_column=col,
+            device_kind="precision",
+            device_k=k,
         )
     raise KeyError(f"unknown evaluator {name!r}")
 

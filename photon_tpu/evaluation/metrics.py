@@ -2,13 +2,19 @@
 
 All metrics treat ``weight == 0`` rows as absent — the padding convention —
 so they compose directly with padded/sharded batches.  The headline metrics
-are jit-compatible vectorized JAX; per-entity (sharded) aggregation runs
-host-side in numpy (evaluation is off the hot path, matching the reference
-where evaluators are a separate Spark pass).
+are jit-compatible vectorized JAX.  Per-entity (sharded) aggregation has two
+paths: :func:`sharded_metric` is the host numpy reference (one jitted metric
+call per entity group — the reference's separate Spark evaluator pass), and
+:func:`sharded_metric_device` is a single jitted segment-reduce program over
+integer entity codes — the on-device validation pipeline's path
+(``game.descent``), which under a sharded mesh lets GSPMD place the sort /
+psum collectives (the DrJAX shape, arXiv:2403.07128) and syncs exactly one
+scalar per metric.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -110,6 +116,8 @@ def sharded_metric(
     Groups where the metric is undefined (e.g. single-class for AUC when
     ``require_both_classes``) are skipped, matching the reference.
     """
+    # host-sync: the HOST sharded path — device callers use
+    # sharded_metric_device instead.
     scores = np.asarray(scores)
     labels = np.asarray(labels)
     entity_ids = np.asarray(entity_ids)
@@ -138,3 +146,131 @@ def sharded_metric(
         total += float(metric(s, l, ww, **kw))
         count += 1
     return total / count if count else float("nan")
+
+
+def _segment_starts(order_key: Array) -> Array:
+    """For a SORTED key vector, the index of each row's segment start
+    (``cummax`` over the boundary indices — O(n), no host sync)."""
+    n = order_key.shape[0]
+    idx = jnp.arange(n)
+    new = jnp.concatenate(
+        [jnp.ones(1, bool), order_key[1:] != order_key[:-1]]
+    )
+    return jax.lax.cummax(jnp.where(new, idx, 0))
+
+
+def _segmented_cumsum(x: Array, new_seg: Array) -> Array:
+    """Inclusive cumulative sum that RESETS at each segment boundary.
+
+    A segmented-sum associative scan — the sums stay segment-local, so late
+    segments never pay the cancellation error a global-cumsum-and-subtract
+    would (difference of two large prefixes in f32)."""
+
+    def combine(a, b):
+        a_sum, a_new = a
+        b_sum, b_new = b
+        return jnp.where(b_new, b_sum, a_sum + b_sum), a_new | b_new
+
+    total, _ = jax.lax.associative_scan(combine, (x, new_seg))
+    return total
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def _sharded_auc_kernel(
+    scores: Array, labels: Array, weights: Array, codes: Array,
+    num_segments: int,
+) -> tuple[Array, Array]:
+    """Per-entity weighted tie-corrected AUC, averaged over entities with
+    both classes present, as ONE program: sort by (entity, score), take
+    segment-local cumulative negative weight with tie-group correction, and
+    segment-sum the Mann-Whitney numerators.  Matches ``sharded_metric(
+    area_under_roc_curve, ..., require_both_classes=True)``."""
+    pos = weights * labels
+    neg = weights * (1.0 - labels)
+    order = jnp.lexsort((scores, codes))
+    s, e = scores[order], codes[order]
+    pw, nw = pos[order], neg[order]
+    n = s.shape[0]
+    idx = jnp.arange(n)
+    new_seg = jnp.concatenate([jnp.ones(1, bool), e[1:] != e[:-1]])
+    new_tie = new_seg | jnp.concatenate(
+        [jnp.ones(1, bool), s[1:] != s[:-1]]
+    )
+    tie_start = jax.lax.cummax(jnp.where(new_tie, idx, 0))
+    # Segment-local EXCLUSIVE negative-weight prefix, evaluated at each
+    # row's tie-group start: the weight of strictly-lower-scored negatives
+    # in the same entity.
+    csneg_ex = _segmented_cumsum(nw, new_seg) - nw
+    below = csneg_ex[tie_start]
+    tie_gid = jnp.cumsum(new_tie) - 1
+    tied = jax.ops.segment_sum(nw, tie_gid, num_segments=n)[tie_gid]
+    num = jax.ops.segment_sum(
+        pw * (below + 0.5 * tied), e, num_segments=num_segments
+    )
+    wpos = jax.ops.segment_sum(pw, e, num_segments=num_segments)
+    wneg = jax.ops.segment_sum(nw, e, num_segments=num_segments)
+    valid = (wpos > 0) & (wneg > 0)
+    auc = jnp.where(valid, num / jnp.maximum(wpos * wneg, 1e-30), 0.0)
+    count = jnp.sum(valid)
+    return jnp.sum(auc) / jnp.maximum(count, 1), count
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "k"))
+def _sharded_precision_kernel(
+    scores: Array, labels: Array, weights: Array, codes: Array,
+    num_segments: int, k: int,
+) -> tuple[Array, Array]:
+    """Per-entity precision@k averaged over entities with any live row:
+    sort by (entity, -masked score); a row is selected when its within-
+    segment rank is below ``k`` and its weight is live.  Matches
+    ``sharded_metric(precision_at_k, ..., k=k)``."""
+    masked = jnp.where(weights > 0, scores, -jnp.inf)
+    order = jnp.lexsort((-masked, codes))
+    e, l, w = codes[order], labels[order], weights[order]
+    idx = jnp.arange(scores.shape[0])
+    rank = idx - _segment_starts(e)
+    sel = (rank < k) & (w > 0)
+    hits = jax.ops.segment_sum(l * sel, e, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(
+        sel.astype(jnp.float32), e, num_segments=num_segments
+    )
+    live = jax.ops.segment_sum(
+        (w > 0).astype(jnp.float32), e, num_segments=num_segments
+    )
+    valid = live > 0
+    prec = jnp.where(valid, hits / jnp.maximum(cnt, 1.0), 0.0)
+    count = jnp.sum(valid)
+    return jnp.sum(prec) / jnp.maximum(count, 1), count
+
+
+def sharded_metric_device(
+    kind: str,
+    scores: Array,
+    labels: Array,
+    entity_codes: Array,
+    num_segments: int,
+    weights: Array | None = None,
+    k: int = 10,
+) -> Array:
+    """Device-resident :func:`sharded_metric`: per-entity metric averaged
+    over entities, as one jitted segment-reduce program on integer entity
+    codes (``kind``: ``auc`` | ``precision``).
+
+    Inputs stay device arrays end to end (sharded inputs run SPMD — GSPMD
+    inserts the sort/psum collectives); the return value is a device scalar,
+    NaN when no entity qualifies — ``float()`` it for the one host sync.
+    Weight-0 rows (padding) are invisible, and segments holding only
+    weight-0 rows don't count, matching the host path's live-row filter.
+    """
+    w = jnp.ones_like(scores) if weights is None else weights
+    if kind == "auc":
+        mean, count = _sharded_auc_kernel(
+            scores, labels, w, entity_codes, num_segments
+        )
+    elif kind == "precision":
+        mean, count = _sharded_precision_kernel(
+            scores, labels, w, entity_codes, num_segments, k
+        )
+    else:
+        raise KeyError(f"unknown device sharded metric {kind!r}")
+    return jnp.where(count > 0, mean, jnp.nan)

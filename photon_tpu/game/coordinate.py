@@ -49,6 +49,7 @@ from photon_tpu.game.data import (
     _gather_shard_rows,
     build_random_effect_dataset,
     entity_index_for,
+    keys_match,
     pad_bucket_entities,
     pad_bucket_rows,
 )
@@ -61,7 +62,10 @@ from photon_tpu.game.model import (
 from photon_tpu.models.glm import Coefficients, model_for_task
 from photon_tpu.parallel.mesh import (
     DATA_AXIS,
-    put_replicated,
+    mesh_shards,
+    pad_to_multiple,
+    put_sharded,
+    reshard,
     shard_batch,
     to_host,
 )
@@ -96,25 +100,56 @@ def _bucket_offsets(device_data, i: int, bucket, offsets) -> Array:
     )
 
 
+@jax.jit
+def _restrict_index_map(table: Array, proj_ids: Array, mask: Array) -> Array:
+    """Device warm-start restriction for index-map projections: gather each
+    entity's active global columns into its local slots (the device analog
+    of ``IndexMapBucketProjection.restrict_table``)."""
+    return jnp.take_along_axis(table, proj_ids, axis=1) * mask
+
+
+@jax.jit
+def _restrict_random(table: Array, matrix: Array, inv_col_norms: Array) -> Array:
+    """Device warm-start restriction for random projections: the
+    column-normalized least-squares pullback of
+    ``RandomProjectionMatrix.restrict_table``."""
+    return (table @ matrix) * inv_col_norms
+
+
+def _score_pad(coord) -> int:
+    """Padded row count of the coordinate's scoring caches and score rows:
+    the training row count rounded up to a multiple of the mesh size (the
+    residual engine pads identically, so score rows line up shard for
+    shard)."""
+    return pad_to_multiple(coord.data.num_examples, mesh_shards(coord.mesh))
+
+
 def _scoring_feats(coord) -> tuple:
     """The coordinate's training-shard features as device arrays, uploaded
     once and cached on the coordinate's shared ``device_data`` (which the
     estimator reuses across sweep configurations, unlike the coordinate
-    objects themselves), replicated over the mesh: the residual engine
-    re-scores every coordinate every outer iteration, and the seed's
+    objects themselves), SHARDED over the mesh data axis: the residual
+    engine re-scores every coordinate every outer iteration, and the seed's
     ``model.score(data)`` re-uploaded the shard each time.
 
     This cache is a SECOND device copy of the shard's features (the training
     copies live row-selected/bucketed in the batch structures and cannot
-    serve full-row-order scoring), replicated over the mesh — a deliberate
-    memory-for-transfers trade.  ``_score_cache_bytes`` makes the residency
-    visible (the descent loop exports it as the
-    ``residuals.scoring_cache_bytes`` gauge); ``PHOTON_RESIDUALS=host``
-    never pays it."""
+    serve full-row-order scoring) — a deliberate memory-for-transfers
+    trade.  Sharding it over the data axis (rows zero-padded to the mesh
+    multiple) keeps that trade to ONE extra copy across the whole mesh
+    rather than the one-per-device the replicated cache used to cost.
+    ``_score_cache_bytes`` makes the residency visible (the descent loop
+    exports it as the ``residuals.scoring_cache_bytes`` gauge — global
+    bytes; per-device residency divides by the mesh size);
+    ``PHOTON_RESIDUALS=host`` never pays it."""
     holder = coord.device_data
     if holder._score_feats is None:
-        feats, dense = _shard_feats(coord.data.shard(coord.config.shard_name))
-        dev_feats = put_replicated(feats, coord.mesh)
+        from photon_tpu.game.model import _shard_feats_padded
+
+        leaves, dense = _shard_feats_padded(
+            coord.data.shard(coord.config.shard_name), _score_pad(coord)
+        )
+        dev_feats = put_sharded(leaves, coord.mesh)
         holder._score_feats = (dev_feats, dense)
         holder._score_cache_bytes += sum(
             leaf.nbytes for leaf in jax.tree.leaves(dev_feats)
@@ -135,21 +170,26 @@ def _random_score_device(coord, model) -> Array:
         return model.score(coord.data)
     feats, dense = _scoring_feats(coord)
     holder = coord.device_data
-    # Identity first: a model trained by this coordinate carries the
-    # dataset's own keys object, so the O(num_entities) host compare runs
-    # only for foreign models (warm starts loaded from disk).
-    if model.keys is coord.dataset.keys or np.array_equal(
-        np.asarray(model.keys), coord.dataset.keys
-    ):
+    n_pad = _score_pad(coord)
+
+    def pad_idx(idx: np.ndarray) -> np.ndarray:
+        # Padding rows carry entity index -1 -> zero margins.
+        return np.pad(
+            idx.astype(np.int32), (0, n_pad - len(idx)), constant_values=-1
+        )
+
+    # host-sync: foreign-vocabulary key compare (warm starts from disk);
+    # same-run models hit the identity check inside keys_match.
+    if keys_match(model.keys, coord.dataset.keys):
         if holder._score_entity_idx is None:
-            holder._score_entity_idx = put_replicated(
-                jnp.asarray(coord.dataset.entity_idx_per_row), coord.mesh
+            holder._score_entity_idx = put_sharded(
+                pad_idx(coord.dataset.entity_idx_per_row), coord.mesh
             )
             holder._score_cache_bytes += holder._score_entity_idx.nbytes
         entity_idx = holder._score_entity_idx
     else:
-        entity_idx = put_replicated(
-            jnp.asarray(entity_index_for(
+        entity_idx = put_sharded(
+            pad_idx(entity_index_for(
                 coord.data.id_columns[coord.config.entity_column],
                 np.asarray(model.keys),
             )),
@@ -359,8 +399,9 @@ class FixedEffectDeviceData:
 
     def offsets_to_device(self, offsets) -> Array:
         """Training offsets ready for the batch: accepts the residual
-        engine's device vector (row selection stays a device gather) or a
-        host numpy vector (the seed's upload path)."""
+        engine's device vector — already padded to the mesh multiple, so the
+        row gather / pad below is sized off the ACTUAL length — or a host
+        numpy vector (the seed's upload path)."""
         if isinstance(offsets, jax.Array):
             dev = offsets
             if self.train_rows is not None:
@@ -373,8 +414,10 @@ class FixedEffectDeviceData:
             dev = jnp.asarray(offsets, jnp.float32)
         if self.mesh is None:
             return dev
-        padded = jnp.pad(dev, (0, self.batch.num_examples - self.unpadded_n))
-        return jax.device_put(padded, NamedSharding(self.mesh, P(DATA_AXIS)))
+        short = self.batch.num_examples - dev.shape[0]
+        if short:
+            dev = jnp.pad(dev, (0, short))
+        return reshard(dev, NamedSharding(self.mesh, P(DATA_AXIS)))
 
 
 class RandomEffectDeviceData:
@@ -484,6 +527,39 @@ class RandomEffectDeviceData:
         if self.row_split:
             return jax.device_put(leaf, NamedSharding(self.mesh, P()))
         return jax.device_put(leaf, self._sharding(leaf.ndim))
+
+    def restrict_device(self, i: int, table: Array) -> Array:
+        """Bucket ``i``'s warm-start restriction applied on DEVICE: local
+        per-entity coefficients from the globally-gathered ``[E_b, dim]``
+        table.  The projection's static buffers (index-map slots + mask, or
+        the random matrix + its column norms) upload on first warm start
+        and stay cached — the seed fetched the whole aligned table to host
+        and restricted in numpy once per bucket per warm start."""
+        dev = self.device_buckets[i]
+        proj = dev["proj"]
+        if proj is None:
+            return table
+        from photon_tpu.game.projection import IndexMapBucketProjection
+
+        if "restrict_buffers" not in dev:
+            if isinstance(proj, IndexMapBucketProjection):
+                ids, mask = proj.scatter_args()
+                dev["restrict_buffers"] = (
+                    self._place(jnp.asarray(ids)),
+                    self._place(jnp.asarray(mask)),
+                )
+            else:
+                col_norms = (proj.matrix**2).sum(axis=0)
+                dev["restrict_buffers"] = (
+                    jnp.asarray(proj.matrix),
+                    jnp.asarray(
+                        (1.0 / np.maximum(col_norms, 1e-12)).astype(np.float32)
+                    ),
+                )
+        a, b = dev["restrict_buffers"]
+        if isinstance(proj, IndexMapBucketProjection):
+            return _restrict_index_map(table, a, b)
+        return _restrict_random(table, a, b)
 
     def gather_buffers(self, i: int) -> tuple[Array, Array]:
         """Bucket ``i``'s device-resident ``row_index``/mask gather buffers
@@ -634,14 +710,29 @@ class RandomEffectCoordinate:
         """Align a warm-start model's per-entity rows onto THIS dataset's
         vocabulary by key (the model may come from different training data —
         SURVEY.md §5 warm start); unseen entities start at zero.  The dummy
-        slot at the end absorbs padded entities."""
-        aligned = np.zeros((self.dataset.num_entities + 1, self.dim), np.float32)
-        src_idx = entity_index_for(self.dataset.keys, np.asarray(initial_model.keys))
-        found = src_idx >= 0
+        slot at the end absorbs padded entities.
+
+        The common case — coordinate descent re-passing the model THIS
+        coordinate trained last iteration, whose ``keys`` is the dataset's
+        own object — stays entirely on device: the table gets its dummy row
+        appended by one device concatenate, no d2h fetch and no O(E) key
+        join (the per-warm-start host path the ROADMAP flagged)."""
         if initial_model.dim != self.dim:
             raise ValueError(
                 f"warm-start model dim {initial_model.dim} != coordinate dim {self.dim}"
             )
+        # Only FOREIGN vocabularies (warm starts loaded from disk) pay the
+        # host compare + join below; see data.keys_match.
+        if keys_match(initial_model.keys, self.dataset.keys):
+            table = jnp.asarray(initial_model.table, jnp.float32)
+            return jnp.concatenate(
+                [table, jnp.zeros((1, self.dim), table.dtype)]
+            )
+        # host-sync: foreign-vocabulary warm start joins by key on host,
+        # once per warm start (not per iteration).
+        aligned = np.zeros((self.dataset.num_entities + 1, self.dim), np.float32)
+        src_idx = entity_index_for(self.dataset.keys, np.asarray(initial_model.keys))
+        found = src_idx >= 0
         aligned[:-1][found] = to_host(initial_model.table)[src_idx[found]]
         return jnp.asarray(aligned)
 
@@ -678,15 +769,12 @@ class RandomEffectCoordinate:
             entity_idx = dev["entity_index"]
             proj = dev["proj"]
             if init_table is not None:
-                if proj is None:
-                    w0 = self.device_data._place_w0(init_table[entity_idx])
-                else:
-                    # Projection restriction is host-side numpy (built once
-                    # per descent iteration per bucket; warm-start only).
-                    w0_global = to_host(init_table)[np.asarray(entity_idx)]
-                    w0 = self.device_data._place_w0(
-                        jnp.asarray(proj.restrict_table(w0_global))
-                    )
+                # Device gather against the bucket's entity index, then the
+                # projection's device restriction (cached static buffers) —
+                # the whole warm-start alignment stays on device.
+                w0 = self.device_data._place_w0(
+                    self.device_data.restrict_device(i, init_table[entity_idx])
+                )
             else:
                 w0 = dev["w0"]
             if self.device_data.row_split:
@@ -804,12 +892,15 @@ class FactoredRandomEffectCoordinate:
 
         def place_rows(a):
             a = jnp.asarray(a)
-            if self._pool_pad:
-                a = jnp.pad(a, [(0, self._pool_pad)] + [(0, 0)] * (a.ndim - 1))
+            # Pad to the POOLED target length (residual-engine offsets
+            # arrive pre-padded to the mesh multiple; host vectors don't).
+            short = (self.data.num_examples + self._pool_pad) - a.shape[0]
+            if short > 0:
+                a = jnp.pad(a, [(0, short)] + [(0, 0)] * (a.ndim - 1))
             if mesh is None:
                 return a
             ax = next(iter(mesh.shape))
-            return jax.device_put(
+            return reshard(
                 a, NamedSharding(mesh, P(ax, *([None] * (a.ndim - 1))))
             )
 

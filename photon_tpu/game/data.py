@@ -254,6 +254,19 @@ def entity_index_for(raw_keys: np.ndarray, vocab_keys: np.ndarray) -> np.ndarray
     return np.where(found, pos, -1).astype(np.int32)
 
 
+def keys_match(keys, ref, ref_array: Optional[np.ndarray] = None) -> bool:
+    """Is ``keys`` the same vocabulary as ``ref``?  Identity first — a model
+    trained in THIS run carries the dataset's own keys object, so the O(E)
+    host value compare runs only for foreign vocabularies (warm starts
+    loaded from disk).  ``ref_array`` is ``ref`` pre-coerced to numpy when
+    the caller caches it."""
+    if keys is ref:
+        return True
+    return np.array_equal(
+        np.asarray(keys), ref if ref_array is None else ref_array
+    )
+
+
 def _bucket_capacity(count: int, cap: Optional[int]) -> int:
     """Power-of-two row capacity for an entity with ``count`` active rows."""
     if cap is not None:

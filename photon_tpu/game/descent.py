@@ -19,11 +19,13 @@ from typing import Dict, Optional, Sequence
 
 from photon_tpu.evaluation.evaluators import MultiEvaluator
 from photon_tpu.game.data import GameDataset
-from photon_tpu.game.model import GameModel
+from photon_tpu.game.model import DeviceScoringCache, GameModel
 from photon_tpu.game.residuals import (
     HostResiduals,
     ResidualEngine,
+    ValidationEngine,
     resolve_residual_mode,
+    resolve_validation_mode,
 )
 from photon_tpu.telemetry import NULL_SESSION
 from photon_tpu.utils.logging import PhotonLogger
@@ -74,6 +76,14 @@ class CoordinateDescent:
     table and computes each coordinate's training offsets with one jitted
     kernel; ``host`` is the float64 numpy accumulate the seed shipped with
     (``PHOTON_RESIDUALS=host`` / ``--residuals host``).
+
+    Validation runs in one of two modes too (``validation_mode``):
+    ``device`` keeps a second score table over the validation rows
+    (:class:`ValidationEngine` + a shared :class:`DeviceScoringCache`),
+    re-scores ONLY the coordinates that retrained each outer iteration, and
+    evaluates the jitted device metrics — the per-iteration host traffic is
+    the per-metric scalars; ``host`` is the seed's full
+    ``GameModel.score`` fetch + numpy evaluator pass.
     """
 
     def __init__(
@@ -86,6 +96,8 @@ class CoordinateDescent:
         logger: Optional[PhotonLogger] = None,
         telemetry=None,
         residual_mode: Optional[str] = None,
+        validation_mode: Optional[str] = None,
+        validation_cache: Optional[DeviceScoringCache] = None,
     ):
         if not coordinates:
             raise ValueError("CoordinateDescent needs at least one coordinate")
@@ -97,25 +109,53 @@ class CoordinateDescent:
         self.logger = logger or PhotonLogger("photon_tpu.game")
         self.telemetry = telemetry or NULL_SESSION
         self.residual_mode = resolve_residual_mode(residual_mode)
+        self.validation_mode = resolve_validation_mode(
+            validation_mode, self.residual_mode
+        )
+        # Scoring-side device data for the validation rows, shared across
+        # descent runs by the estimator (feature uploads happen once per
+        # shard, not once per sweep configuration).
+        self._validation_cache = validation_cache
 
-    def _build_residuals(self):
-        """The residual state for this run: the device engine, or the host
-        float64 path (escape hatch / multi-process fallback)."""
-        cls = ResidualEngine if self.residual_mode == "device" else HostResiduals
-        mesh = next(
+    def _mesh(self):
+        return next(
             (c.mesh for c in self.coordinates.values()
              if getattr(c, "mesh", None) is not None),
             None,
         )
+
+    def _build_residuals(self):
+        """The residual state for this run: the device engine, or the host
+        float64 path (escape hatch)."""
+        cls = ResidualEngine if self.residual_mode == "device" else HostResiduals
         with self.telemetry.span(
             "descent.residuals.init", mode=self.residual_mode
         ):
             return cls(
                 self.training_data.offset,
                 names=list(self.coordinates),
-                mesh=mesh,
+                mesh=self._mesh(),
                 telemetry=self.telemetry,
             )
+
+    def _build_validation(self):
+        """The validation engine + scoring cache for a device-mode run (the
+        cache is reused across runs when the estimator supplied one)."""
+        cache = self._validation_cache
+        if cache is None or cache.data is not self.validation_data:
+            cache = DeviceScoringCache(
+                self.validation_data, mesh=self._mesh(),
+                telemetry=self.telemetry,
+            )
+            self._validation_cache = cache
+        with self.telemetry.span("descent.validation.init"):
+            engine = ValidationEngine(
+                self.validation_data.offset,
+                names=list(self.coordinates),
+                mesh=self._mesh(),
+                telemetry=self.telemetry,
+            )
+        return engine, cache
 
     def _score(self, coord, model):
         """Score a coordinate's model over the training data: device path
@@ -129,9 +169,35 @@ class CoordinateDescent:
         if self.validation_data is None or self.evaluators is None:
             return {}
         data = self.validation_data
+        # host-sync: the HOST validation path (escape hatch) — every
+        # coordinate's margins come to host and evaluators run in numpy.
         scores = model.score(data)
         entity_ids = dict(data.id_columns)
         return self.evaluators.evaluate(scores, data.label, data.weight, entity_ids)
+
+    def _evaluate_device(self, engine: ValidationEngine,
+                         cache: DeviceScoringCache) -> Dict[str, float]:
+        """Device-resident validation: composite margin from the score
+        table, jitted metrics over the cached labels/weights/entity codes.
+        The per-metric ``float()`` scalars are the only d2h traffic."""
+        composite = engine.composite()
+        entity_ids = {
+            ev.entity_column: cache.entity_codes(ev.entity_column)
+            for ev in self.evaluators.evaluators
+            if ev.entity_column is not None and ev.device_kind is not None
+        }
+        metrics = self.evaluators.evaluate(
+            composite, cache.label, cache.weight, entity_ids
+        )
+        # host-sync: the per-metric scalars — the ONE host sync the device
+        # validation pipeline performs per outer iteration.
+        self.telemetry.counter(
+            "descent.host_transfer_bytes", direction="d2h", path="validation"
+        ).inc(4 * len(metrics))
+        self.telemetry.gauge("validation.scoring_cache_bytes").set(
+            cache.device_bytes
+        )
+        return metrics
 
     def run(
         self,
@@ -157,6 +223,10 @@ class CoordinateDescent:
 
         models: Dict[str, object] = {}
         residuals = self._build_residuals()
+        val_engine = val_cache = None
+        if (self.validation_data is not None and self.evaluators is not None
+                and self.validation_mode == "device"):
+            val_engine, val_cache = self._build_validation()
         if initial_model is not None:
             for name, coord_model in initial_model.coordinates.items():
                 if name not in self.coordinates:
@@ -165,6 +235,11 @@ class CoordinateDescent:
                 residuals.update(
                     name, self._score(self.coordinates[name], coord_model)
                 )
+                if val_engine is not None:
+                    # Seed the validation score table: locked coordinates
+                    # are never re-scored again (their rows are reused every
+                    # iteration — validation.score_reuse counts them).
+                    val_engine.update(name, val_cache.score(coord_model))
 
         best_model: Optional[GameModel] = None
         best_metrics: Dict[str, float] = {}
@@ -173,6 +248,7 @@ class CoordinateDescent:
         telemetry = self.telemetry
         for it in range(num_iterations):
             coord_logs = {}
+            trained = 0
             with telemetry.span("descent.iteration", iteration=it) as iter_span:
                 for name, coord in self.coordinates.items():
                     if name in locked:
@@ -184,6 +260,11 @@ class CoordinateDescent:
                         )
                     models[name] = model
                     residuals.update(name, self._score(coord, model))
+                    if val_engine is not None:
+                        # Incremental re-score: ONLY the coordinate that
+                        # just trained touches its validation score row.
+                        val_engine.update(name, val_cache.score(model))
+                    trained += 1
                     cache_bytes = getattr(
                         getattr(coord, "device_data", None),
                         "_score_cache_bytes", 0,
@@ -214,7 +295,17 @@ class CoordinateDescent:
                     with telemetry.span("descent.checkpoint", iteration=it):
                         checkpoint_fn(it, game_model)
                 with telemetry.span("descent.validate", iteration=it):
-                    metrics = self._evaluate(game_model)
+                    if val_engine is not None:
+                        # Rows whose device scores were REUSED this
+                        # iteration (locked / not-retrained coordinates):
+                        # the host path re-scored every coordinate's margins
+                        # each iteration regardless.
+                        telemetry.counter("validation.score_reuse").inc(
+                            (len(self.coordinates) - trained) * val_cache.n
+                        )
+                        metrics = self._evaluate_device(val_engine, val_cache)
+                    else:
+                        metrics = self._evaluate(game_model)
                 if metrics:
                     self.logger.info("iter %d validation %s", it, metrics)
                     iter_span.set_attribute("metrics", metrics)

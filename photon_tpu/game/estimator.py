@@ -70,11 +70,13 @@ class GameEstimator:
         logger: Optional[PhotonLogger] = None,
         telemetry=None,
         residual_mode: Optional[str] = None,
+        validation_mode: Optional[str] = None,
     ):
         """``normalization`` is keyed by feature-shard name and applies to
         fixed-effect coordinates on that shard (the reference normalizes the
         fixed-effect objective only).  ``residual_mode`` selects how descent
-        passes residuals between coordinates (``auto``/``device``/``host`` —
+        passes residuals between coordinates, ``validation_mode`` how it
+        scores/evaluates validation data (``auto``/``device``/``host`` —
         see :mod:`photon_tpu.game.residuals`)."""
         self.task_type = task_type
         self.training_data = training_data
@@ -91,10 +93,36 @@ class GameEstimator:
         self.logger = logger or PhotonLogger("photon_tpu.game")
         self.telemetry = telemetry or NULL_SESSION
         self.residual_mode = residual_mode
+        self.validation_mode = validation_mode
         # Device-resident data shared across sweep configurations: building
         # the bucketed random-effect datasets (the reference's shuffle) and
         # uploading feature blocks happens once per distinct data config.
         self._device_data_cache: Dict[tuple, object] = {}
+        # Validation scoring cache shared across the whole sweep: one upload
+        # of the validation feature shards for ALL configurations.
+        self._validation_cache = None
+
+    def _validation_scoring_cache(self):
+        """The shared device validation cache, when the resolved modes call
+        for one (host-mode runs never pay the upload)."""
+        from photon_tpu.game.model import DeviceScoringCache
+        from photon_tpu.game.residuals import (
+            resolve_residual_mode,
+            resolve_validation_mode,
+        )
+
+        if self.validation_data is None or self.evaluators is None:
+            return None
+        mode = resolve_validation_mode(
+            self.validation_mode, resolve_residual_mode(self.residual_mode)
+        )
+        if mode != "device":
+            return None
+        if self._validation_cache is None:
+            self._validation_cache = DeviceScoringCache(
+                self.validation_data, mesh=self.mesh, telemetry=self.telemetry
+            )
+        return self._validation_cache
 
     def _device_data(self, coord_config):
         from photon_tpu.game.coordinate import (
@@ -162,6 +190,8 @@ class GameEstimator:
                     logger=self.logger,
                     telemetry=self.telemetry,
                     residual_mode=self.residual_mode,
+                    validation_mode=self.validation_mode,
+                    validation_cache=self._validation_scoring_cache(),
                 ).run(
                     config.descent_iterations,
                     initial_model=initial_model,

@@ -26,7 +26,13 @@ import numpy as np
 
 from photon_tpu.core.losses import get_loss
 from photon_tpu.data.batch import DenseBatch, SparseBatch
-from photon_tpu.game.data import DenseShard, GameDataset, Shard, SparseShard
+from photon_tpu.game.data import (
+    DenseShard,
+    GameDataset,
+    Shard,
+    SparseShard,
+    keys_match,
+)
 from photon_tpu.parallel.mesh import to_host
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel, model_for_task
 
@@ -80,6 +86,23 @@ def _shard_feats(shard: Shard):
     if isinstance(shard, DenseShard):
         return jnp.asarray(shard.x), True
     return (jnp.asarray(shard.ids), jnp.asarray(shard.vals)), False
+
+
+def _shard_feats_padded(shard: Shard, n_pad: int):
+    """Host-side feature leaves padded to ``n_pad`` rows (zero rows on the
+    padding — they produce zero margins and carry weight 0 everywhere), in
+    upload-ready numpy form: ``(leaves, dense)`` like :func:`_shard_feats`.
+    """
+    if isinstance(shard, DenseShard):
+        x = shard.x
+        if n_pad != x.shape[0]:
+            x = np.pad(x, [(0, n_pad - x.shape[0]), (0, 0)])
+        return x, True
+    ids, vals = shard.ids, shard.vals
+    if n_pad != ids.shape[0]:
+        widths = [(0, n_pad - ids.shape[0]), (0, 0)]
+        ids, vals = np.pad(ids, widths), np.pad(vals, widths)
+    return (ids, vals), False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,6 +176,128 @@ class RandomEffectModel:
 
 
 CoordinateModel = "FixedEffectModel | RandomEffectModel"
+
+
+class DeviceScoringCache:
+    """Device-resident scoring-side data for one (validation) GameDataset.
+
+    Holds everything the on-device validation pipeline needs to re-score a
+    coordinate and evaluate metrics without touching host memory: per-shard
+    feature blocks, labels and weights, per-id-column integer entity codes
+    (for the segment-reduce sharded evaluators), and per-(column,
+    vocabulary) row→entity indices.  Rows are padded to a multiple of the
+    mesh size (padded rows carry weight 0 and entity index -1 — invisible
+    to margins and metrics) and every per-row array is SHARDED over the
+    data axis: one copy of the validation data across the mesh.
+
+    Built once per estimator and shared across sweep configurations and
+    descent runs — feature uploads happen once per shard, not once per
+    (configuration × iteration) as the host path's ``GameModel.score`` did.
+    """
+
+    def __init__(self, data: GameDataset, mesh=None, telemetry=None):
+        from photon_tpu.parallel.mesh import mesh_shards, pad_to_multiple
+        from photon_tpu.telemetry import NULL_SESSION
+
+        self.data = data
+        self.mesh = mesh
+        self.telemetry = telemetry or NULL_SESSION
+        self.n = data.num_examples
+        self.n_pad = pad_to_multiple(self.n, mesh_shards(mesh))
+        self.device_bytes = 0
+        self._feats: Dict[str, tuple] = {}
+        self._entity_codes: Dict[str, Array] = {}
+        self._entity_idx: Dict[str, tuple] = {}
+        self.label = self._put(np.asarray(data.label, np.float32))
+        self.weight = self._put(np.asarray(data.weight, np.float32))
+
+    def _put(self, host: np.ndarray, pad_value=0) -> Array:
+        """Upload one per-row host array padded + sharded, with transfer and
+        residency accounting."""
+        from photon_tpu.parallel.mesh import axis_sharding
+
+        if self.n_pad != host.shape[0]:
+            widths = [(0, self.n_pad - host.shape[0])] + [(0, 0)] * (host.ndim - 1)
+            host = np.pad(host, widths, constant_values=pad_value)
+        if self.mesh is None:
+            dev = jnp.asarray(host)
+        else:
+            dev = jax.device_put(host, axis_sharding(self.mesh, host.ndim))
+        self.telemetry.counter(
+            "descent.host_transfer_bytes", direction="h2d", path="validation"
+        ).inc(dev.nbytes)
+        self.device_bytes += dev.nbytes
+        return dev
+
+    def feats(self, shard_name: str) -> tuple:
+        """Shard ``shard_name``'s features as padded, sharded device leaves
+        (uploaded on first use): ``(leaves, dense)``."""
+        if shard_name not in self._feats:
+            leaves, dense = _shard_feats_padded(
+                self.data.shard(shard_name), self.n_pad
+            )
+            if dense:
+                dev = self._put(leaves)
+            else:
+                dev = (self._put(leaves[0]), self._put(leaves[1]))
+            self._feats[shard_name] = (dev, dense)
+        return self._feats[shard_name]
+
+    def entity_index(self, column: str, keys: np.ndarray) -> Array:
+        """Per-row entity index of ``column`` against ``keys`` (``[n_pad]``
+        int32, -1 = unseen/padding), cached per column for the latest
+        vocabulary — identity-checked first, so the common case (a model
+        trained on this run's own vocabulary, every iteration) never pays
+        the O(n) host key lookup again."""
+        cached = self._entity_idx.get(column)
+        if cached is not None:
+            ref, arr, dev = cached
+            # host-sync: key compare runs only for FOREIGN vocabularies
+            # (warm starts loaded from disk); same-run models hit the
+            # identity check.
+            if keys_match(keys, ref, arr):
+                return dev
+        from photon_tpu.game.data import entity_index_for
+
+        arr = np.asarray(keys)
+        idx = entity_index_for(self.data.id_columns[column], arr)
+        dev = self._put(idx.astype(np.int32), pad_value=-1)
+        if cached is not None:
+            # The replaced index buffer is dropped: keep the residency
+            # gauge honest (device_bytes tracks LIVE bytes, not uploads).
+            self.device_bytes -= cached[2].nbytes
+        self._entity_idx[column] = (keys, arr, dev)
+        return dev
+
+    def entity_codes(self, column: str) -> tuple:
+        """``(codes, num_segments)``: dense integer codes of ``column``'s
+        raw entity keys (``[n_pad]`` int32; padding rows get a fresh code so
+        they form their own — all weight-0, hence skipped — segment) plus
+        the static segment count, for the segment-reduce sharded
+        evaluators (``evaluation.metrics.sharded_metric_device``)."""
+        if column not in self._entity_codes:
+            uniq, codes = np.unique(self.data.id_columns[column],
+                                    return_inverse=True)
+            self._entity_codes[column] = (
+                self._put(codes.astype(np.int32), pad_value=len(uniq)),
+                len(uniq) + 1,
+            )
+        return self._entity_codes[column]
+
+    def score(self, model) -> Array:
+        """Device-resident margins of one coordinate model over the cached
+        (validation) rows — ``[n_pad]``, sharded, no host round-trip."""
+        if isinstance(model, FixedEffectModel):
+            feats, dense = self.feats(model.shard_name)
+            return model.margins_device(feats, dense)
+        if isinstance(model, RandomEffectModel):
+            entity_idx = self.entity_index(model.entity_column, model.keys)
+            feats, dense = self.feats(model.shard_name)
+            return model.margins_device(entity_idx, feats, dense)
+        raise TypeError(
+            f"cannot device-score a {type(model).__name__}; expected "
+            "FixedEffectModel or RandomEffectModel"
+        )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,4 +1,4 @@
-"""Device-resident residual engine for GAME coordinate descent.
+"""Device-resident score engines for GAME coordinate descent.
 
 The reference's CoordinateDescent passes residuals between coordinates via
 RDD shuffles; the seed rebuilt that as HOST float64 accumulation — every
@@ -8,28 +8,52 @@ host after rescoring.  That is an O(n · coordinates · iterations) host
 round-trip on the hottest loop of GAME training (Snap ML's hierarchy
 argument, PAPERS.md: keep hot state at the fastest tier).
 
-This engine keeps the residual state on device:
+Two engines keep score state on device, both built on one stacked table:
 
-- ``scores`` — ONE stacked ``[C, n]`` float32 table, row ``c`` holding
-  coordinate ``c``'s current score vector, replicated over the mesh when one
-  is given (every shard reads whole score rows).
-- ``total``/``comp`` — a Neumaier-compensated sum of the score rows,
-  refreshed by the same jitted kernel that writes an updated row.  Training
+- :class:`ResidualEngine` — training-side residual passing.  Training
   offsets for coordinate ``c`` are ``base + (total - scores[c]) + comp`` —
   one O(n) jitted kernel per coordinate instead of a host O(C·n) float64
-  accumulate + upload.  The compensation term holds the summation parity the
-  host float64 path provided (the f32 table stores exactly what scoring
-  produced; only the cross-coordinate sum ever needed the extra precision).
+  accumulate + upload.
+- :class:`ValidationEngine` — validation-side incremental scoring.  The
+  same table over the validation rows; only the coordinate that just
+  trained is re-scored each outer iteration, and the composite margin is
+  ``base + total + comp`` from the same compensated-total kernel.  The
+  descent loop's one remaining host sync per iteration is the per-metric
+  scalars (see ``game.descent``).
+
+Shared table mechanics:
+
+- ``scores`` — ONE stacked ``[C, n_pad]`` float32 table, row ``c`` holding
+  coordinate ``c``'s current score vector.  Under a mesh the row length is
+  padded to a multiple of the mesh size and SHARDED over the data axis
+  (``PartitionSpec(None, "data")``) — each device holds only its column
+  slice, one copy of the score state across the mesh instead of the
+  replicated copy per device earlier rounds paid for.
+- ``total``/``comp`` — a Neumaier-compensated sum of the score rows,
+  refreshed by the same jitted kernel that writes an updated row.  The
+  compensation term holds the summation parity the host float64 path
+  provided.  The scan over rows is element-wise per column, so the sharded
+  table needs NO collectives for updates or offsets; reductions that do
+  cross shards (validation metrics) get their psums from GSPMD inside the
+  jitted metric kernels — the DrJAX shape (arXiv:2403.07128): express the
+  map-reduce as sharded collectives and let the partitioner place them.
+  Because every rank of a multi-process run executes the same jitted
+  programs over globally-sharded arrays, the engine is multi-controller
+  safe: ``--residuals device`` is legal under ``jax.process_count() > 1``
+  (the PR-2 engine was single-controller and fell back to host).
 - Row updates **donate** the score table (and the total/comp pair), so
   rescoring a coordinate recycles its row's buffer instead of allocating a
-  second ``[C, n]`` table per update.
+  second ``[C, n_pad]`` table per update.
 
 Hosts see score data only where the algorithm genuinely needs host values:
-validation metrics once per outer iteration, and model export at the end.
+per-metric validation scalars once per outer iteration, and model export at
+the end.
 
 ``PHOTON_RESIDUALS=host`` (or the GAME driver's ``--residuals host``)
 restores the seed's host-resident float64 path end to end — the escape
 hatch if a backend misbehaves under donation or long async dispatch chains.
+``PHOTON_VALIDATION=host`` (``--validation-pipeline host``) does the same
+for the validation side alone.
 """
 
 from __future__ import annotations
@@ -42,7 +66,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.parallel.mesh import put_replicated
+from photon_tpu.parallel.mesh import (
+    DATA_AXIS,
+    axis_sharding,
+    mesh_shards,
+    pad_to_multiple,
+    reshard,
+)
 from photon_tpu.telemetry import NULL_SESSION
 
 Array = jax.Array
@@ -53,12 +83,10 @@ def resolve_residual_mode(mode: Optional[str] = None) -> str:
 
     Precedence: explicit ``mode`` argument (driver flag) over the
     ``PHOTON_RESIDUALS`` env var over the default (``auto`` == device).
-    ``auto`` falls back to ``host`` under multi-process runs — the device
-    engine is single-controller for now (ROADMAP open item) and the host
-    path is known-correct under ``jax.distributed``.  An EXPLICIT
-    ``device`` request on a multi-process run raises instead of silently
-    downgrading: a benchmark that asked for the engine must not quietly
-    measure the host path.
+    The device engine runs as sharded SPMD programs over globally-sharded
+    score rows, so ``auto`` resolves to ``device`` under multi-process runs
+    too (the PR-2 single-controller engine used to fall back to host
+    there); ``host`` remains the explicit escape hatch.
     """
     resolved = mode or os.environ.get("PHOTON_RESIDUALS", "").strip().lower() \
         or "auto"
@@ -66,14 +94,28 @@ def resolve_residual_mode(mode: Optional[str] = None) -> str:
         raise ValueError(
             f"residual mode must be 'auto', 'device' or 'host', got {resolved!r}"
         )
-    if resolved == "auto":
-        return "host" if jax.process_count() > 1 else "device"
-    if resolved == "device" and jax.process_count() > 1:
+    return "device" if resolved == "auto" else resolved
+
+
+def resolve_validation_mode(
+    mode: Optional[str] = None, residual_mode: str = "device"
+) -> str:
+    """Resolve the validation-pipeline mode: ``device`` | ``host``.
+
+    ``auto`` (default) follows the residual mode: a device-resident descent
+    run scores and evaluates validation on device too; a host-mode run
+    (escape hatch) keeps the seed's host evaluation end to end.  Explicit
+    ``device``/``host`` (driver flag or ``PHOTON_VALIDATION``) overrides.
+    """
+    resolved = mode or os.environ.get("PHOTON_VALIDATION", "").strip().lower() \
+        or "auto"
+    if resolved not in ("auto", "device", "host"):
         raise ValueError(
-            "residual mode 'device' was requested explicitly, but the device "
-            "engine is single-controller and this is a multi-process run; "
-            "use 'auto' (falls back to host automatically) or 'host'"
+            f"validation mode must be 'auto', 'device' or 'host', "
+            f"got {resolved!r}"
         )
+    if resolved == "auto":
+        return "device" if residual_mode == "device" else "host"
     return resolved
 
 
@@ -83,6 +125,7 @@ def _neumaier_rows(scores: Array) -> tuple[Array, Array]:
     Neumaier's variant of Kahan summation: ``total + comp`` carries the row
     sum to roughly twice f32 precision, which is what lets the f32 engine
     match the host float64 accumulate within validation-metric tolerance.
+    Element-wise per column — sharded tables sum shard-locally.
     """
     zero = jnp.zeros_like(scores[0])
 
@@ -126,16 +169,25 @@ def _offsets_kernel(base: Array, total: Array, comp: Array,
     return base + ((total - scores[c]) + comp)
 
 
-class ResidualEngine:
-    """Per-coordinate score vectors resident on device with a maintained
-    compensated total (see module docstring).
+@jax.jit
+def _composite_kernel(base: Array, total: Array, comp: Array) -> Array:
+    """Composite margin over ALL coordinates: ``base + Σ_k scores[k]`` as
+    ``base + (total + comp)`` — the validation engine's scoring output."""
+    return base + (total + comp)
+
+
+class _DeviceScoreTable:
+    """Shared table state of the residual and validation engines: a stacked
+    ``[C, n_pad]`` score table with a maintained Neumaier-compensated total,
+    sharded over the mesh data axis (see module docstring).
 
     ``names`` fixes the row order; ``base_offset`` is the dataset offset
-    (uploaded once).  All arrays are replicated over ``mesh`` when given —
-    the fixed effect re-shards its offsets over the data axis and the
-    random-effect bucket gathers emit entity-sharded blocks, both from the
-    replicated row vectors.
+    (``[n]``, uploaded once, zero-padded to ``n_pad``).  ``path`` labels the
+    telemetry transfer counters (``residuals`` / ``validation``).
     """
+
+    _PATH = "table"
+    _BYTES_GAUGE: Optional[str] = None
 
     def __init__(
         self,
@@ -145,7 +197,9 @@ class ResidualEngine:
         telemetry=None,
     ):
         if not names:
-            raise ValueError("ResidualEngine needs at least one coordinate")
+            raise ValueError(
+                f"{type(self).__name__} needs at least one coordinate"
+            )
         self.names = list(names)
         self._row = {name: i for i, name in enumerate(self.names)}
         if len(self._row) != len(self.names):
@@ -153,19 +207,51 @@ class ResidualEngine:
         self.mesh = mesh
         self.telemetry = telemetry or NULL_SESSION
         self.n = int(len(base_offset))
-        base = jnp.asarray(base_offset, jnp.float32)
-        self.base = put_replicated(base, mesh)
-        zeros = jnp.zeros((len(self.names), self.n), jnp.float32)
-        self.scores = put_replicated(zeros, mesh)
-        self.total = put_replicated(jnp.zeros(self.n, jnp.float32), mesh)
-        self.comp = put_replicated(jnp.zeros(self.n, jnp.float32), mesh)
+        self.n_pad = pad_to_multiple(self.n, mesh_shards(mesh))
+        base = np.zeros(self.n_pad, np.float32)
+        # host-sync: one-time base-offset staging (host numpy in; the upload
+        # below is the table's entire steady-state h2d cost).
+        base[: self.n] = np.asarray(base_offset, np.float32)
+        self._row_sharding = (
+            None if mesh is None else axis_sharding(mesh, 1, 0, DATA_AXIS)
+        )
+        self.base = self._put(base)
+        # The table and its running total are the DONATED buffers
+        # (_set_row_and_resum recycles them): build them XLA-born via
+        # jnp.zeros, never from host numpy memory — a zero-copy host upload
+        # entering a donating kernel would be freed out from under numpy.
+        self.scores = self._device(
+            jnp.zeros((len(self.names), self.n_pad), jnp.float32), axis=1
+        )
+        self.total = self._device(jnp.zeros(self.n_pad, jnp.float32))
+        self.comp = self._device(jnp.zeros(self.n_pad, jnp.float32))
         # The one-time upload is the device path's entire steady-state h2d
-        # cost for residuals; the host path pays ~2 vectors per coordinate
+        # cost for this table; the host path pays ~2 vectors per coordinate
         # per iteration (see game.descent counters).
         self.telemetry.counter(
-            "descent.host_transfer_bytes", direction="h2d", path="residuals"
+            "descent.host_transfer_bytes", direction="h2d", path=self._PATH
         ).inc(self.base.nbytes)
-        self.telemetry.gauge("residuals.device_bytes").set(
+        if self._BYTES_GAUGE:
+            self.telemetry.gauge(self._BYTES_GAUGE).set(self.device_bytes)
+
+    def _put(self, host: np.ndarray, axis: int = 0) -> Array:
+        if self.mesh is None:
+            return jnp.asarray(host)
+        return jax.device_put(
+            host, axis_sharding(self.mesh, host.ndim, axis, DATA_AXIS)
+        )
+
+    def _device(self, dev: Array, axis: int = 0) -> Array:
+        """Place an already-device array onto the table's row sharding."""
+        if self.mesh is None:
+            return dev
+        return reshard(dev, axis_sharding(self.mesh, dev.ndim, axis, DATA_AXIS))
+
+    @property
+    def device_bytes(self) -> int:
+        """Global bytes of the table state (per-device residency is this
+        divided by the mesh size — the rows are sharded, not replicated)."""
+        return (
             self.scores.nbytes + self.base.nbytes
             + self.total.nbytes + self.comp.nbytes
         )
@@ -173,39 +259,86 @@ class ResidualEngine:
     def row(self, name: str) -> int:
         return self._row[name]
 
-    def update(self, name: str, new_scores: Array) -> None:
-        """Replace ``name``'s score row (device array, ``[n]``) and refresh
-        the compensated total.  Donates the previous table buffers."""
+    def update(self, name: str, new_scores) -> None:
+        """Replace ``name``'s score row and refresh the compensated total.
+        Donates the previous table buffers.
+
+        Accepts a device row of length ``n_pad`` (the device scoring paths
+        emit padded, sharded rows) or a host/device vector of length ``n``
+        (host-scored fallbacks; padded and counted as an h2d transfer).
+        """
         if isinstance(new_scores, np.ndarray):
             # A host score vector entering the device table is a real h2d
             # transfer (warm-start models scored on host, or a coordinate
             # without a device scoring path) — count it.
             self.telemetry.counter(
-                "descent.host_transfer_bytes", direction="h2d", path="residuals"
+                "descent.host_transfer_bytes", direction="h2d", path=self._PATH
             ).inc(new_scores.size * 4)
         new_row = jnp.asarray(new_scores, jnp.float32)
-        if new_row.shape != (self.n,):
+        if new_row.shape == (self.n,) and self.n != self.n_pad:
+            new_row = jnp.pad(new_row, (0, self.n_pad - self.n))
+        if new_row.shape != (self.n_pad,):
             raise ValueError(
                 f"score vector for {name!r} has shape {new_row.shape}, "
-                f"want ({self.n},)"
+                f"want ({self.n},) or padded ({self.n_pad},)"
             )
-        with self.telemetry.span("residuals.update", coordinate=name):
+        if self._row_sharding is not None:
+            new_row = reshard(new_row, self._row_sharding)
+        with self.telemetry.span(f"{self._PATH}.update", coordinate=name):
             self.scores, self.total, self.comp = _set_row_and_resum(
                 self.scores, self.total, self.comp, self._row[name], new_row
             )
-        self.telemetry.counter("residuals.updates", coordinate=name).inc()
+        self.telemetry.counter(
+            f"{self._PATH}.updates", coordinate=name
+        ).inc()
+
+    def scores_for(self, name: str) -> Array:
+        """Coordinate ``name``'s current score row (device view, ``[n]`` —
+        padding trimmed)."""
+        return self.scores[self._row[name], : self.n]
+
+
+class ResidualEngine(_DeviceScoreTable):
+    """Training-side per-coordinate score vectors resident on device with a
+    maintained compensated total (see module docstring).
+
+    The fixed effect re-shards the emitted offsets over the data axis (a
+    no-op: they already are) and the random-effect bucket gathers pull the
+    rows they need across shards — GSPMD inserts the gather.
+    """
+
+    _PATH = "residuals"
+    _BYTES_GAUGE = "residuals.device_bytes"
 
     def offsets_for(self, name: str) -> Array:
         """Training offsets for ``name``: ``base + Σ_{other} scores`` as one
-        jitted device kernel; float32, shape ``[n]``, replicated."""
+        jitted device kernel; float32, shape ``[n_pad]``, sharded over the
+        data axis (padding rows carry whatever the base padding holds —
+        weight-0 rows never read them)."""
         with self.telemetry.span("residuals.offsets", coordinate=name):
             return _offsets_kernel(
                 self.base, self.total, self.comp, self.scores, self._row[name]
             )
 
-    def scores_for(self, name: str) -> Array:
-        """Coordinate ``name``'s current score row (device view)."""
-        return self.scores[self._row[name]]
+
+class ValidationEngine(_DeviceScoreTable):
+    """Validation-side score table: incremental per-coordinate re-scoring
+    with a composite margin from the same compensated-total kernel.
+
+    The descent loop updates only the rows whose coordinate just retrained
+    (``validation.score_reuse`` counts the rows it did NOT have to touch)
+    and evaluates metrics on :meth:`composite` without fetching scores to
+    host — see ``game.descent``.
+    """
+
+    _PATH = "validation"
+    _BYTES_GAUGE = "validation.device_bytes"
+
+    def composite(self) -> Array:
+        """Composite validation margin ``base + Σ_k scores[k]`` — float32,
+        ``[n_pad]``, sharded; padded rows carry weight 0 for every metric."""
+        with self.telemetry.span("validation.composite"):
+            return _composite_kernel(self.base, self.total, self.comp)
 
 
 class HostResiduals:
@@ -227,12 +360,15 @@ class HostResiduals:
         telemetry=None,
     ):
         del names, mesh  # same signature as ResidualEngine; state is host-only
+        # host-sync: the escape hatch keeps ALL residual state on host.
         self.base = np.asarray(base_offset, np.float64)
         self.scores: dict = {}
         self.telemetry = telemetry or NULL_SESSION
 
     def update(self, name: str, new_scores) -> None:
         """Store ``name``'s score vector on host (fetching it if needed)."""
+        # host-sync: the host escape hatch IS the host path — every update
+        # fetches one score vector, counted below.
         host = np.asarray(new_scores, np.float64)
         if host.shape != self.base.shape:
             raise ValueError(

@@ -108,15 +108,75 @@ def put_replicated(x, mesh: Optional[Mesh]):
     """Place a pytree of arrays fully replicated over ``mesh``.
 
     ``mesh=None`` (single device) just materializes the leaves as device
-    arrays.  The residual engine and the coordinate scoring caches use this
-    for state every shard reads whole (score rows, offsets, feature shards
-    for scoring): replication makes the per-coordinate offset kernels pure
-    element-wise programs with no collectives.
+    arrays.  Used for state every shard reads whole (model coefficient
+    vectors, small index buffers); bulk per-row state (score rows, scoring
+    feature caches) is sharded with :func:`put_sharded` instead.
     """
     if mesh is None:
         return jax.tree.map(jnp.asarray, x)
     sharding = NamedSharding(mesh, P())
     return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), x)
+
+
+def mesh_shards(mesh: Optional[Mesh]) -> int:
+    """Number of shards along a mesh's axes (1 without a mesh)."""
+    if mesh is None:
+        return 1
+    return int(np.prod(list(mesh.shape.values())))
+
+
+def axis_sharding(
+    mesh: Mesh, ndim: int, axis: int = 0, axis_name: str = DATA_AXIS
+) -> NamedSharding:
+    """Sharding that splits dimension ``axis`` of an ``ndim``-array over
+    ``axis_name`` and replicates every other dimension."""
+    spec = [None] * ndim
+    spec[axis] = axis_name
+    return NamedSharding(mesh, P(*spec))
+
+
+def put_sharded(x, mesh: Optional[Mesh], axis: int = 0,
+                axis_name: str = DATA_AXIS):
+    """Place a pytree of arrays with dimension ``axis`` sharded over the
+    mesh (``mesh=None`` just materializes device arrays).
+
+    The residual/validation engines and the coordinate scoring caches use
+    this for per-row state (score rows, feature shards, entity indices):
+    each device holds only its row slice — one copy of the data across the
+    mesh instead of one copy per device — and the per-coordinate offset /
+    compensated-total kernels stay element-wise per shard, with GSPMD
+    inserting the collectives (psum for metric reductions, gathers for
+    cross-shard row selection) where an op genuinely crosses shards.
+    The sharded dimension must already be padded to a multiple of the mesh
+    size (:func:`pad_to_multiple`; padded rows carry weight 0).
+    """
+    if mesh is None:
+        return jax.tree.map(jnp.asarray, x)
+    return jax.tree.map(
+        lambda leaf: jax.device_put(
+            leaf, axis_sharding(mesh, leaf.ndim, axis, axis_name)
+        ),
+        x,
+    )
+
+
+_RESHARD_CACHE: dict = {}
+
+
+def reshard(x: jax.Array, sharding: NamedSharding) -> jax.Array:
+    """Re-place a DEVICE array onto ``sharding`` through a jitted identity.
+
+    ``jax.device_put`` on committed multi-process arrays cannot always move
+    data across processes; a jitted identity with ``out_shardings`` lets
+    XLA insert the collective instead, and is a no-op when the sharding
+    already matches.  Jitted identities are cached per sharding so repeated
+    calls (one per descent iteration) never retrace.
+    """
+    fn = _RESHARD_CACHE.get(sharding)
+    if fn is None:
+        fn = jax.jit(lambda y: y, out_shardings=sharding)
+        _RESHARD_CACHE[sharding] = fn
+    return fn(x)
 
 
 def pad_to_multiple(n: int, k: int) -> int:

@@ -1,0 +1,56 @@
+"""tools/check_host_sync.py: the GAME hot loop stays free of unsanctioned
+host syncs, and the checker actually catches one when introduced."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from check_host_sync import check_file, main  # noqa: E402
+
+
+def test_hot_loop_is_clean():
+    assert main([]) == 0
+
+
+def test_checker_flags_unsanctioned_sync(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def hot(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    assert check_file(bad) == [(3, "return np.asarray(x)")]
+    assert main([str(bad)]) == 1
+
+
+def test_checker_accepts_marker_within_window(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import numpy as np\n"
+        "def hot(x):\n"
+        "    # host-sync: the one sanctioned scalar fetch\n"
+        "    return np.asarray(x)\n"
+    )
+    assert check_file(ok) == []
+    far = tmp_path / "far.py"
+    far.write_text(
+        "import numpy as np\n"
+        "# host-sync: too far above to sanction the call\n"
+        "a = 1\nb = 2\nc = 3\nd = 4\n"
+        "x = np.asarray(a)\n"
+    )
+    assert len(check_file(far)) == 1
+
+
+def test_checker_ignores_jnp_and_comments(tmp_path):
+    f = tmp_path / "f.py"
+    f.write_text(
+        "import jax.numpy as jnp\n"
+        "# np.asarray(commented out)\n"
+        "y = jnp.asarray([1.0])\n"
+    )
+    assert check_file(f) == []

@@ -379,6 +379,7 @@ import json, os, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
 sys.path.insert(0, sys.argv[1])
 coordinator, pid, out_dir = sys.argv[2], int(sys.argv[3]), sys.argv[4]
+extra = sys.argv[5:]
 from photon_tpu.drivers import train_game
 
 summary = train_game.run(train_game.build_parser().parse_args([
@@ -391,7 +392,7 @@ summary = train_game.run(train_game.build_parser().parse_args([
     "--descent-iterations", "1",
     "--validation-split", "0.25",
     "--output-dir", out_dir,
-]))
+] + extra))
 if pid == 0:
     with open(os.path.join(out_dir, "mp_metrics.json"), "w") as f:
         json.dump(summary["best_metrics"], f)
@@ -427,6 +428,42 @@ def test_two_process_game_driver_matches_single(tmp_path):
 
     mp_metrics = json.load(open(os.path.join(outs[0], "mp_metrics.json")))
     assert os.path.isdir(os.path.join(outs[0], "best_model"))
+    for name, value in single["best_metrics"].items():
+        assert mp_metrics[name] == pytest.approx(value, rel=2e-3), (
+            name, mp_metrics[name], value
+        )
+
+
+def test_two_process_device_residuals_match_single(tmp_path):
+    """EXPLICIT ``--residuals device --validation-pipeline device`` under a
+    2-process global mesh: the sharded score tables (training residuals AND
+    validation) run as SPMD programs over globally-sharded rows, so the
+    device engine no longer falls back to host multi-process — metrics must
+    reproduce a single-process device-mode run."""
+    from photon_tpu.drivers import train_game
+
+    flags = ["--residuals", "device", "--validation-pipeline", "device"]
+    argv = [
+        "--backend", "cpu",
+        "--input", "synthetic-game:32:4:8:4:1:7",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=6",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=5",
+        "--descent-iterations", "1",
+        "--validation-split", "0.25",
+    ] + flags
+    single = train_game.run(train_game.build_parser().parse_args(
+        argv + ["--output-dir", str(tmp_path / "single")]))
+
+    worker = tmp_path / "game_worker.py"
+    worker.write_text(GAME_WORKER)
+    outs = [str(tmp_path / f"mp{i}") for i in range(2)]
+    run_worker_pair(lambda coordinator: [
+        [sys.executable, str(worker), REPO, coordinator, str(i), outs[i]]
+        + flags
+        for i in range(2)
+    ], what="GAME device-residual worker")
+
+    mp_metrics = json.load(open(os.path.join(outs[0], "mp_metrics.json")))
     for name, value in single["best_metrics"].items():
         assert mp_metrics[name] == pytest.approx(value, rel=2e-3), (
             name, mp_metrics[name], value

@@ -164,18 +164,36 @@ def test_resolve_residual_mode(monkeypatch):
 
 
 def test_resolve_residual_mode_multiprocess(monkeypatch):
-    """``auto`` falls back to host under multi-process (the engine is
-    single-controller); an EXPLICIT device request raises instead of
-    silently measuring the host path."""
+    """The sharded engine is multi-controller safe: ``auto`` stays
+    ``device`` under multi-process runs (the PR-2 single-controller engine
+    used to fall back to host there) and an explicit ``device`` request is
+    legal."""
     import photon_tpu.game.residuals as residuals_mod
 
     monkeypatch.delenv("PHOTON_RESIDUALS", raising=False)
     monkeypatch.setattr(residuals_mod.jax, "process_count", lambda: 2)
-    assert resolve_residual_mode() == "host"
-    assert resolve_residual_mode("auto") == "host"
+    assert resolve_residual_mode() == "device"
+    assert resolve_residual_mode("auto") == "device"
     assert resolve_residual_mode("host") == "host"
-    with pytest.raises(ValueError, match="single-controller"):
-        resolve_residual_mode("device")
+    assert resolve_residual_mode("device") == "device"
+
+
+def test_resolve_validation_mode(monkeypatch):
+    """``auto`` follows the residual mode; explicit flag / env override."""
+    from photon_tpu.game.residuals import resolve_validation_mode
+
+    monkeypatch.delenv("PHOTON_VALIDATION", raising=False)
+    assert resolve_validation_mode() == "device"
+    assert resolve_validation_mode(residual_mode="host") == "host"
+    assert resolve_validation_mode("device", residual_mode="host") == "device"
+    assert resolve_validation_mode("host", residual_mode="device") == "host"
+    monkeypatch.setenv("PHOTON_VALIDATION", "host")
+    assert resolve_validation_mode(residual_mode="device") == "host"
+    # Explicit argument wins over the env var.
+    assert resolve_validation_mode("device", residual_mode="host") == "device"
+    monkeypatch.setenv("PHOTON_VALIDATION", "nonsense")
+    with pytest.raises(ValueError, match="validation mode"):
+        resolve_validation_mode()
 
 
 # ---------------------------------------------------------------------------

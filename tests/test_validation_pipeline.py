@@ -1,0 +1,374 @@
+"""On-device validation pipeline (ISSUE 3): incremental validation scoring
+(`ValidationEngine` + `DeviceScoringCache`), device metric parity with the
+host evaluators, the one-host-sync-per-iteration telemetry contract, and the
+device warm-start alignment/restriction paths.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from photon_tpu.core.objective import RegularizationContext  # noqa: E402
+from photon_tpu.core.optimizers import OptimizerConfig  # noqa: E402
+from photon_tpu.core.problem import ProblemConfig  # noqa: E402
+from photon_tpu.data.synthetic import make_game_dataset  # noqa: E402
+from photon_tpu.evaluation import metrics as M  # noqa: E402
+from photon_tpu.evaluation.evaluators import (  # noqa: E402
+    MultiEvaluator,
+    get_evaluator,
+)
+from photon_tpu.game.coordinate import (  # noqa: E402
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+    build_coordinate,
+)
+from photon_tpu.game.data import split_game_dataset  # noqa: E402
+from photon_tpu.game.estimator import (  # noqa: E402
+    GameEstimator,
+    GameOptimizationConfiguration,
+)
+from photon_tpu.game.model import DeviceScoringCache  # noqa: E402
+from photon_tpu.game.residuals import ValidationEngine  # noqa: E402
+from photon_tpu.telemetry import TelemetrySession  # noqa: E402
+
+
+def _problem(lam: float, max_iters: int) -> ProblemConfig:
+    return ProblemConfig(
+        regularization=RegularizationContext("l2", lam),
+        optimizer_config=OptimizerConfig(max_iterations=max_iters),
+    )
+
+
+def _config(iters: int = 2) -> GameOptimizationConfiguration:
+    return GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", _problem(0.01, 40)),
+            "re0": RandomEffectCoordinateConfig("re0", "re0", _problem(1.0, 20)),
+            "re1": RandomEffectCoordinateConfig("re1", "re1", _problem(1.0, 20)),
+        },
+        descent_iterations=iters,
+    )
+
+
+def _evaluators() -> MultiEvaluator:
+    return MultiEvaluator([
+        get_evaluator("auc"),
+        get_evaluator("logistic_loss"),
+        get_evaluator("sharded_auc:re0"),
+        get_evaluator("sharded_precision@3:re0"),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# Engine-level incremental re-scoring
+# ---------------------------------------------------------------------------
+
+
+def test_validation_engine_incremental_rescore_matches_full():
+    """After updating ONLY one coordinate's row, the composite must equal a
+    fresh engine's composite over the same final rows — incremental
+    re-scoring may never drift from full re-scoring."""
+    n, names = 129, ["a", "b", "c"]
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal(n).astype(np.float32)
+    rows = {m: rng.standard_normal(n).astype(np.float32) for m in names}
+
+    engine = ValidationEngine(base, names=names)
+    for m in names:
+        engine.update(m, jnp.asarray(rows[m]))
+    before = np.asarray(engine.composite()).copy()
+    np.testing.assert_allclose(
+        before, base + sum(rows.values()), rtol=0, atol=1e-5
+    )
+
+    rows["b"] = rng.standard_normal(n).astype(np.float32)
+    engine.update("b", jnp.asarray(rows["b"]))  # only 'b' re-scored
+
+    fresh = ValidationEngine(base, names=names)
+    for m in names:
+        fresh.update(m, jnp.asarray(rows[m]))
+    np.testing.assert_array_equal(
+        np.asarray(engine.composite()), np.asarray(fresh.composite())
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device-vs-host metric parity on identical scores
+# ---------------------------------------------------------------------------
+
+
+def test_device_metrics_match_host_within_1e6():
+    """Every evaluator must agree between its host path (numpy ids) and its
+    device path (entity codes + jitted kernels) to 1e-6 on the SAME scores
+    — ties, weight-0 rows, and single-class entities included."""
+    rng = np.random.default_rng(1)
+    n, n_entities = 1500, 40
+    # Two-decimal scores force real tie groups through the AUC kernel.
+    scores = np.round(rng.standard_normal(n), 2).astype(np.float32)
+    labels = (rng.random(n) < 0.35).astype(np.float32)
+    weights = np.where(
+        rng.random(n) < 0.1, 0.0, rng.uniform(0.5, 2.0, n)
+    ).astype(np.float32)
+    ids = rng.integers(0, n_entities, n)
+    # Entity 7: single-class (sharded AUC must skip it on both paths).
+    labels[ids == 7] = 1.0
+    uniq, codes = np.unique(ids, return_inverse=True)
+
+    for ev in _evaluators().evaluators:
+        host_ids = ids if ev.entity_column is not None else None
+        host = ev.evaluate(scores, labels, weights, host_ids)
+        dev_ids = (
+            (jnp.asarray(codes.astype(np.int32)), len(uniq) + 1)
+            if ev.entity_column is not None else None
+        )
+        dev = ev.evaluate(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights),
+            dev_ids,
+        )
+        assert abs(host - dev) < 1e-6, (ev.name, host, dev)
+
+
+def test_sharded_metric_device_nan_when_no_valid_group():
+    out = M.sharded_metric_device(
+        "auc",
+        jnp.asarray(np.zeros(8, np.float32)),
+        jnp.asarray(np.ones(8, np.float32)),  # single class everywhere
+        jnp.asarray(np.zeros(8, np.int32)),
+        2,
+    )
+    assert np.isnan(float(out))
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: device validation pipeline on a real fit
+# ---------------------------------------------------------------------------
+
+
+def _fit(validation_mode: str, iters: int = 2, telemetry=None,
+         initial_model=None, locked=()):
+    data, _ = make_game_dataset(30, 10, 6, 4, seed=11, n_random_coords=2)
+    train, val = split_game_dataset(data, 0.25)
+    estimator = GameEstimator(
+        "logistic_regression", train, val, evaluators=_evaluators(),
+        residual_mode="device", validation_mode=validation_mode,
+        telemetry=telemetry,
+    )
+    result = estimator.fit(
+        [_config(iters)], initial_model=initial_model,
+        locked_coordinates=locked,
+    )[0]
+    return result, val
+
+
+def test_game_fit_device_validation_matches_host():
+    host, _ = _fit("host")
+    device, _ = _fit("device")
+    assert host.metrics and device.metrics
+    for name, ref in host.metrics.items():
+        # Composite scores differ at f32-rounding level between the host
+        # float64 accumulate and the compensated device table; the metric
+        # gap that rounding can produce is bounded well below 1e-5.
+        assert abs(device.metrics[name] - ref) < 1e-5, (
+            name, device.metrics[name], ref
+        )
+
+
+def test_device_validation_one_host_sync_per_iteration():
+    """The acceptance bar: with device validation, the ONLY d2h traffic on
+    the validation path is the per-metric scalars — 4 bytes x metrics x
+    iterations — and the h2d upload is one-time (does not scale with
+    iterations)."""
+    n_metrics = len(_evaluators().evaluators)
+
+    sessions = {}
+    for iters in (1, 3):
+        session = TelemetrySession(f"val-sync-{iters}")
+        _fit("device", iters=iters, telemetry=session)
+        sessions[iters] = session
+        d2h = session.counter(
+            "descent.host_transfer_bytes", direction="d2h", path="validation"
+        ).value
+        assert d2h == 4 * n_metrics * iters, (iters, d2h)
+
+    # One-time upload: tripling the iterations must not grow h2d traffic.
+    h2d = {
+        iters: s.counter(
+            "descent.host_transfer_bytes", direction="h2d", path="validation"
+        ).value
+        for iters, s in sessions.items()
+    }
+    assert h2d[1] > 0
+    assert h2d[3] == h2d[1], h2d
+
+    # Residency gauges exported.
+    assert sessions[3].gauge("validation.device_bytes").value > 0
+    assert sessions[3].gauge("validation.scoring_cache_bytes").value > 0
+
+
+def test_validation_score_reuse_counts_locked_rows():
+    """A locked coordinate is never re-scored: its validation rows are
+    reused every iteration, and the counter proves it."""
+    warm, _ = _fit("device", iters=1)
+    session = TelemetrySession("val-reuse")
+    _, val = _fit(
+        "device", iters=2, telemetry=session,
+        initial_model=warm.model, locked=["re1"],
+    )
+    reuse = session.counter("validation.score_reuse").value
+    assert reuse == 2 * val.num_examples, (reuse, val.num_examples)
+
+
+def test_host_validation_mode_never_builds_device_cache():
+    data, _ = make_game_dataset(20, 6, 6, 4, seed=5, n_random_coords=1)
+    train, val = split_game_dataset(data, 0.25)
+    estimator = GameEstimator(
+        "logistic_regression", train, val,
+        residual_mode="host", validation_mode="auto",
+    )
+    estimator.fit([GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", _problem(0.01, 10)),
+            "re0": RandomEffectCoordinateConfig("re0", "re0", _problem(1.0, 8)),
+        },
+        descent_iterations=1,
+    )])
+    assert estimator._validation_cache is None
+
+
+# ---------------------------------------------------------------------------
+# DeviceScoringCache
+# ---------------------------------------------------------------------------
+
+
+def test_scoring_cache_scores_match_host_model_scores():
+    data, _ = make_game_dataset(25, 8, 6, 4, seed=3, n_random_coords=2)
+    train, val = split_game_dataset(data, 0.3)
+    result, _ = _fit("host", iters=1)
+    # Build the cache over THIS val split and compare per-coordinate
+    # margins against each model's host scoring path.
+    cache = DeviceScoringCache(val)
+    fit_model = GameEstimator(
+        "logistic_regression", train, val, residual_mode="device",
+    ).fit([_config(1)])[0].model
+    for name, model in fit_model.coordinates.items():
+        dev = np.asarray(cache.score(model))[: cache.n]
+        np.testing.assert_allclose(dev, model.score(val), rtol=0, atol=1e-5)
+
+
+def test_scoring_cache_entity_index_caches_same_run_keys():
+    data, _ = make_game_dataset(15, 6, 6, 4, seed=9, n_random_coords=1)
+    cache = DeviceScoringCache(data)
+    keys = np.unique(data.id_columns["re0"])
+    a = cache.entity_index("re0", keys)
+    b = cache.entity_index("re0", keys)  # identity hit — same device array
+    assert a is b
+    # A foreign (subset) vocabulary rebuilds the index with -1 for unseen.
+    foreign = keys[:-1]
+    c = np.asarray(cache.entity_index("re0", foreign))[: cache.n]
+    from photon_tpu.game.data import entity_index_for
+
+    np.testing.assert_array_equal(
+        c, entity_index_for(data.id_columns["re0"], foreign)
+    )
+    # Replacing the cached per-column index must not leak residency:
+    # device_bytes tracks LIVE bytes, so alternating vocabularies holds it
+    # constant after the first replacement.
+    stable = cache.device_bytes
+    cache.entity_index("re0", keys)
+    cache.entity_index("re0", foreign)
+    assert cache.device_bytes == stable
+
+
+# ---------------------------------------------------------------------------
+# Device warm-start alignment + projection restriction
+# ---------------------------------------------------------------------------
+
+
+def test_initial_table_same_keys_stays_device_and_matches_host_align():
+    data, _ = make_game_dataset(20, 6, 6, 4, seed=5, n_random_coords=1)
+    coord = build_coordinate(
+        data,
+        RandomEffectCoordinateConfig("re0", "re0", _problem(1.0, 5)),
+        "logistic_regression",
+    )
+    model, _ = coord.train(np.zeros(data.num_examples, np.float32))
+    assert model.keys is coord.dataset.keys  # the common warm-start case
+    aligned = np.asarray(coord._initial_table(model))
+    np.testing.assert_array_equal(aligned[:-1], np.asarray(model.table))
+    assert not aligned[-1].any()
+
+    # Foreign vocabulary (subset): the host key join must still align rows.
+    import dataclasses
+
+    foreign = dataclasses.replace(
+        model, keys=model.keys[:-1], table=model.table[:-1]
+    )
+    aligned_f = np.asarray(coord._initial_table(foreign))
+    np.testing.assert_allclose(
+        aligned_f[: len(model.keys) - 1], np.asarray(model.table)[:-1]
+    )
+    assert not aligned_f[len(model.keys) - 1].any()  # unseen entity -> zero
+
+
+def test_restrict_kernels_match_host_projection_restriction():
+    from photon_tpu.game.coordinate import (
+        _restrict_index_map,
+        _restrict_random,
+    )
+    from photon_tpu.game.projection import (
+        IndexMapBucketProjection,
+        build_random_projection,
+    )
+
+    rng = np.random.default_rng(2)
+    E, dim, p = 6, 12, 4
+    table = rng.standard_normal((E, dim)).astype(np.float32)
+
+    proj_ids = np.sort(
+        rng.choice(dim, size=(E, p), replace=True), axis=1
+    ).astype(np.int32)
+    mask = (rng.random((E, p)) < 0.8).astype(np.float32)
+    imap = IndexMapBucketProjection(proj_ids=proj_ids, mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(_restrict_index_map(
+            jnp.asarray(table), jnp.asarray(proj_ids), jnp.asarray(mask)
+        )),
+        imap.restrict_table(table),
+        rtol=1e-6,
+    )
+
+    rproj = build_random_projection(dim, p, seed=0)
+    col_norms = (rproj.matrix**2).sum(axis=0)
+    inv = (1.0 / np.maximum(col_norms, 1e-12)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_restrict_random(
+            jnp.asarray(table), jnp.asarray(rproj.matrix), jnp.asarray(inv)
+        )),
+        rproj.restrict_table(table),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_warm_start_projected_fit_still_converges():
+    """End-to-end guard for the device restriction path: a projected
+    random-effect coordinate warm-started from its own previous model must
+    train without error and score close to the cold fit."""
+    data, _ = make_game_dataset(20, 8, 6, 8, seed=7, n_random_coords=1)
+    coord = build_coordinate(
+        data,
+        RandomEffectCoordinateConfig(
+            "re0", "re0", _problem(1.0, 10),
+            projection="random", projected_dim=4,
+        ),
+        "logistic_regression",
+    )
+    offsets = np.zeros(data.num_examples, np.float32)
+    cold, _ = coord.train(offsets)
+    warm, _ = coord.train(offsets, initial_model=cold)
+    np.testing.assert_allclose(
+        warm.score(data), cold.score(data), rtol=0, atol=5e-3
+    )

@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Guard against host-sync regressions in the GAME hot loop.
+
+The device-resident score engines (``photon_tpu/game/residuals.py``) exist
+so the descent loop's steady state never round-trips score data through the
+host: the per-metric validation scalars are the ONE sanctioned sync per
+outer iteration, and everything else stays on device (see the residuals
+module docstring and README §"Device-resident residual engine").
+
+This check greps the hot-loop modules for the calls that move device data
+to host — ``np.asarray(``, ``jax.device_get(`` / ``.device_get(``,
+``to_host(`` — and fails unless the call site is explicitly sanctioned
+with a ``host-sync:`` marker comment on the same line or within the three
+lines above it.  Adding a new host fetch to the hot loop therefore forces
+a visible, reviewed annotation instead of silently reintroducing the
+per-iteration transfer the engines removed.
+
+Usage: ``python tools/check_host_sync.py [files...]`` (defaults to the
+GAME hot-loop modules).  Exit code 0 = clean, 1 = unsanctioned syncs.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# The GAME hot loop: the score engines and the descent loop that drives
+# them.  Coordinate/model scoring helpers keep legitimate host paths (the
+# escape hatch, model export) and are covered by their host-sync markers
+# where they intersect the loop.
+DEFAULT_FILES = (
+    "photon_tpu/game/residuals.py",
+    "photon_tpu/game/descent.py",
+)
+
+SYNC_PATTERN = re.compile(
+    r"\bnp\.asarray\s*\(|jax\.device_get\s*\(|\bdevice_get\s*\(|\bto_host\s*\("
+)
+MARKER = "host-sync:"
+# Lines above a call site that may carry the sanction marker.
+MARKER_WINDOW = 3
+
+
+def check_file(path: Path) -> list[tuple[int, str]]:
+    """Unsanctioned sync call sites in ``path`` as (line_number, line)."""
+    lines = path.read_text().splitlines()
+    violations = []
+    for i, line in enumerate(lines):
+        stripped = line.strip()
+        if stripped.startswith("#") or not SYNC_PATTERN.search(line):
+            continue
+        window = lines[max(0, i - MARKER_WINDOW): i + 1]
+        if not any(MARKER in w for w in window):
+            violations.append((i + 1, stripped))
+    return violations
+
+
+def main(argv: list[str]) -> int:
+    files = [Path(a) for a in argv] if argv else [
+        REPO / rel for rel in DEFAULT_FILES
+    ]
+    failed = False
+    for path in files:
+        for lineno, line in check_file(path):
+            failed = True
+            print(f"{path}:{lineno}: unsanctioned host sync: {line}")
+    if failed:
+        print(
+            "\nThe GAME hot loop must not fetch device data to host outside "
+            "the sanctioned sync points (the per-metric validation scalars "
+            "and the explicit host escape hatches).  If this sync is "
+            "intentional, annotate the call site with a `# host-sync: "
+            "<why>` comment within the three lines above it; see the "
+            "photon_tpu/game/residuals.py module docstring and the README "
+            "residual-engine section for the residency contract."
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
