@@ -631,6 +631,67 @@ def _bench_validation() -> None:
     })
 
 
+def _bench_recovery() -> None:
+    """Checkpoint write/restore overhead micro-bench (``--mode recovery``).
+
+    Fits the shared synthetic GAME fixture three ways on one estimator:
+    plain (no checkpointing), with per-outer-iteration descent checkpoints
+    (``photon_tpu/fault/checkpoint.py`` — models + residual score rows +
+    best tracking, atomic publish), and resumed from the completed
+    checkpoint (pure load + rebuild, no solves).  Emits one JSON line whose
+    value is the mean checkpoint WRITE seconds per outer iteration — the
+    per-iteration insurance premium a preemptible run pays — with the
+    restore wall clock and the fit overhead in detail.
+    """
+    import shutil
+    import tempfile
+
+    from photon_tpu.game.estimator import GameEstimator
+    from photon_tpu.telemetry import TelemetrySession
+
+    iters = 3
+    platform, n_entities, data, config = _game_bench_fixture(
+        n_random_coords=2, descent_iterations=iters
+    )
+    tmp = tempfile.mkdtemp(prefix="photon-bench-recovery-")
+    try:
+        session = TelemetrySession("bench-recovery")
+        estimator = GameEstimator(
+            "logistic_regression", data, telemetry=session
+        )
+        estimator.fit([config])  # warm-up: compile + device-data upload
+        t0 = time.perf_counter()
+        estimator.fit([config])
+        plain = time.perf_counter() - t0
+
+        ckpt_dir = os.path.join(tmp, "ckpt")
+        t0 = time.perf_counter()
+        estimator.fit([config], checkpoint_dir=ckpt_dir)
+        with_ckpt = time.perf_counter() - t0
+        write_hist = session.histogram("checkpoint.write_seconds")
+
+        t0 = time.perf_counter()
+        estimator.fit([config], checkpoint_dir=ckpt_dir, resume="auto")
+        restore = time.perf_counter() - t0
+
+        _emit("game_checkpoint_secs", write_hist.mean or 0.0, "s/iter", {
+            "rows": data.num_examples,
+            "entities": n_entities,
+            "coordinates": 3,
+            "descent_iterations": iters,
+            "plain_fit_seconds": round(plain, 4),
+            "checkpointed_fit_seconds": round(with_ckpt, 4),
+            "checkpoint_overhead_seconds": round(with_ckpt - plain, 4),
+            "restore_seconds": round(restore, 4),
+            "checkpoint_writes": int(
+                session.counter("checkpoint.saves").value
+            ),
+            "platform": platform,
+        })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _generate_stream_files(
     out_dir: str, total_rows: int, n_files: int, k: int, d: int, seed: int = 0
 ) -> list:
@@ -993,14 +1054,19 @@ def main() -> None:
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--mode":
         mode = sys.argv[2] if len(sys.argv) > 2 else ""
-        if mode not in ("descent", "validation"):
+        modes = {
+            "descent": _bench_descent,
+            "validation": _bench_validation,
+            "recovery": _bench_recovery,
+        }
+        if mode not in modes:
             # An unknown mode must not silently fall through to the full
             # (minutes-long) default run; the raise reaches the top-level
             # handler and emits a bench_error JSON line.
             raise ValueError(
-                f"unknown bench mode {mode!r}; valid: descent, validation"
+                f"unknown bench mode {mode!r}; valid: {', '.join(modes)}"
             )
-        (_bench_descent if mode == "descent" else _bench_validation)()
+        modes[mode]()
         return
     if len(sys.argv) <= 1 or sys.argv[1] != "--headline-only":
         # Default run: all five SURVEY.md §6 configs first (one JSON line
@@ -1028,11 +1094,13 @@ def main() -> None:
                 _emit(f"config{num}_error", 0.0, "error", {
                     "error": f"{type(ex).__name__}: {ex}"[:500],
                 })
-        # The GAME residual-engine and validation-pipeline micro-benches
-        # ride the full run (their JSON lines land next to the headline),
-        # same budget guard + isolation as the numbered configs.
+        # The GAME residual-engine, validation-pipeline, and checkpoint-
+        # recovery micro-benches ride the full run (their JSON lines land
+        # next to the headline), same budget guard + isolation as the
+        # numbered configs.
         for label, fn in (("game_descent", _bench_descent),
-                          ("game_validation", _bench_validation)):
+                          ("game_validation", _bench_validation),
+                          ("game_recovery", _bench_recovery)):
             elapsed = time.perf_counter() - t_start
             if elapsed > budget_s:
                 _emit(f"{label}_skipped", 0.0, "skipped", {

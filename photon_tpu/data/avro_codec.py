@@ -342,6 +342,30 @@ def _read_blocks(f, schema: dict, named: "_Named", sync: bytes, path: str):
             raise ValueError(f"{path}: sync marker mismatch")
 
 
+def open_container(path: str):
+    """Open a container and parse its header EAGERLY; returns
+    ``(open file, schema, named registry, sync)``.
+
+    The retriable prefix of a container read: callers that wrap the open +
+    header parse in a retry loop (``photon_tpu.fault.retry``) pair this
+    with :func:`iter_records` instead of :func:`iter_container`, whose lazy
+    generator would defer the failure past the retry scope.  The caller
+    owns closing the returned file.
+    """
+    f = open(path, "rb")
+    try:
+        schema, named, sync = _read_header(f, path)
+    except BaseException:
+        f.close()
+        raise
+    return f, schema, named, sync
+
+
+def iter_records(f, schema, named, sync, path: str):
+    """Yield records block-at-a-time from an :func:`open_container` result."""
+    return _read_blocks(f, schema, named, sync, path)
+
+
 def iter_container(path: str):
     """Yield records from an Avro container file LAZILY (one at a time).
 
@@ -350,9 +374,9 @@ def iter_container(path: str):
     memory bounded by their own accumulators, not the record dicts
     (SURVEY.md §7 '1B-row ingestion without Spark').
     """
-    with open(path, "rb") as f:
-        schema, named, sync = _read_header(f, path)
-        yield from _read_blocks(f, schema, named, sync, path)
+    f, schema, named, sync = open_container(path)
+    with f:
+        yield from iter_records(f, schema, named, sync, path)
 
 
 def read_container(path: str) -> tuple[dict, list]:

@@ -186,12 +186,23 @@ def write_game_avro(
     avro_codec.write_container(path, schema, records)
 
 
+def _open_container_records(path: str):
+    """:func:`avro_codec.open_container` behind the fault-injection
+    ``io:read`` site — the retriable prefix of a file read (injected and
+    real transient open/header failures both exercise the retry path)."""
+    from photon_tpu.fault.injection import fault_point
+
+    fault_point("io:read", path=path)
+    return avro_codec.open_container(path)
+
+
 def read_game_avro(
     path: str,
     feature_bags: Dict[str, str],
     id_columns: Sequence[str],
     index_maps: Optional[Dict[str, IndexMap]] = None,
     intercept: bool = True,
+    telemetry=None,
 ) -> tuple[GameDataset, Dict[str, IndexMap]]:
     """Read TrainingExampleAvro file(s) into a GameDataset.
 
@@ -201,11 +212,20 @@ def read_game_avro(
     valid single-host); passing training-time maps reproduces the reference's
     fixed-index scoring path — features absent from a map are DROPPED, and
     when an intercept is present every example keeps it.
+
+    Transient IO failures retry with backoff (``photon_tpu.fault.retry``;
+    per-file on the native path, open/header on the streaming Python path
+    — mid-stream decode errors are not retried because the CSR accumulators
+    mutate incrementally), counted as ``io.retries`` on ``telemetry``.
     """
+    from photon_tpu.fault.retry import retry_call
+
     files = _input_files(narrow_avro_dir(path))
     build_maps = index_maps is None
 
-    native = _read_native(files, feature_bags, id_columns, index_maps, intercept)
+    native = _read_native(
+        files, feature_bags, id_columns, index_maps, intercept, telemetry
+    )
     if native is not None:
         label, offset, weight, ids_cols, flat_ids, flat_vals, nnz, vocab, n = native
         return _assemble_game_read(
@@ -235,51 +255,57 @@ def read_game_avro(
     nnz: Dict[str, array] = {s: array("i") for s in feature_bags}
 
     i = 0
-    for f in files:
-        for rec in avro_codec.iter_container(f):
-            label.append(rec["response"])
-            offset.append(rec.get("offset") or 0.0)
-            weight.append(1.0 if rec.get("weight") is None else rec["weight"])
-            for col in id_columns:
-                field = f"{col}__id" if f"{col}__id" in rec else col
-                if field not in rec:
-                    raise KeyError(f"record {i} missing id column {col!r}")
-                ids_cols[col].append(rec[field])
-            for shard_name, field in feature_bags.items():
-                f_ids, f_vals = flat_ids[shard_name], flat_vals[shard_name]
-                m = 0
-                if build_maps:
-                    seen = vocab[shard_name]
-                    for ntv in rec.get(field, ()):
-                        key = feature_key(ntv["name"], ntv["term"])
-                        if key == INTERCEPT_KEY:
-                            continue  # implicit: appended once below
-                        fid = seen.setdefault(key, len(seen))
-                        f_ids.append(fid)
-                        f_vals.append(ntv["value"])
-                        m += 1
-                else:
-                    imap = index_maps[shard_name]
-                    for ntv in rec.get(field, ()):
-                        key = feature_key(ntv["name"], ntv["term"])
-                        if key == INTERCEPT_KEY:
-                            continue
-                        fid = imap.get_id(key)
-                        if fid >= 0:  # absent from a fixed map -> dropped
+    for fpath in files:
+        fh, schema, named, sync = retry_call(
+            lambda p=fpath: _open_container_records(p),
+            site="avro:read", telemetry=telemetry,
+        )
+        with fh:
+            record_iter = avro_codec.iter_records(fh, schema, named, sync, fpath)
+            for rec in record_iter:
+                label.append(rec["response"])
+                offset.append(rec.get("offset") or 0.0)
+                weight.append(1.0 if rec.get("weight") is None else rec["weight"])
+                for col in id_columns:
+                    field = f"{col}__id" if f"{col}__id" in rec else col
+                    if field not in rec:
+                        raise KeyError(f"record {i} missing id column {col!r}")
+                    ids_cols[col].append(rec[field])
+                for shard_name, field in feature_bags.items():
+                    f_ids, f_vals = flat_ids[shard_name], flat_vals[shard_name]
+                    m = 0
+                    if build_maps:
+                        seen = vocab[shard_name]
+                        for ntv in rec.get(field, ()):
+                            key = feature_key(ntv["name"], ntv["term"])
+                            if key == INTERCEPT_KEY:
+                                continue  # implicit: appended once below
+                            fid = seen.setdefault(key, len(seen))
                             f_ids.append(fid)
                             f_vals.append(ntv["value"])
                             m += 1
-                if build_maps:
-                    if intercept:
-                        f_ids.append(-1)  # final id patched after the scan
+                    else:
+                        imap = index_maps[shard_name]
+                        for ntv in rec.get(field, ()):
+                            key = feature_key(ntv["name"], ntv["term"])
+                            if key == INTERCEPT_KEY:
+                                continue
+                            fid = imap.get_id(key)
+                            if fid >= 0:  # absent from a fixed map -> dropped
+                                f_ids.append(fid)
+                                f_vals.append(ntv["value"])
+                                m += 1
+                    if build_maps:
+                        if intercept:
+                            f_ids.append(-1)  # final id patched after the scan
+                            f_vals.append(1.0)
+                            m += 1
+                    elif index_maps[shard_name].intercept_id is not None:
+                        f_ids.append(index_maps[shard_name].intercept_id)
                         f_vals.append(1.0)
                         m += 1
-                elif index_maps[shard_name].intercept_id is not None:
-                    f_ids.append(index_maps[shard_name].intercept_id)
-                    f_vals.append(1.0)
-                    m += 1
-                nnz[shard_name].append(m)
-            i += 1
+                    nnz[shard_name].append(m)
+                i += 1
     return _assemble_game_read(
         path, i, label, offset, weight, ids_cols, flat_ids, flat_vals, nnz,
         vocab if build_maps else None, feature_bags, id_columns, index_maps,
@@ -287,7 +313,8 @@ def read_game_avro(
     )
 
 
-def _read_native(files, feature_bags, id_columns, index_maps, intercept):
+def _read_native(files, feature_bags, id_columns, index_maps, intercept,
+                 telemetry=None):
     """Columnar native decode of all files (src/avro_game.cpp); returns the
     same accumulator tuple the Python loop produces, or None whenever any
     file falls outside the native subset (non-null codec, unexpected field
@@ -359,12 +386,19 @@ def _read_native(files, feature_bags, id_columns, index_maps, intercept):
     # interning stays byte-identical to a sequential read.  Each in-flight
     # decode holds a full file's columns, so cap the concurrency and keep
     # the result window tight (workers + 1 resident files, not 2*workers).
+    from photon_tpu.fault.injection import fault_point
     from photon_tpu.utils.io_pool import io_threads, map_ordered
+
+    def _decode(plan):
+        # Whole-file decode is atomic (nothing mutated on failure), so the
+        # pool retries it wholesale on transient IO errors.
+        fault_point("io:read", path=plan[0])
+        return avro_native.decode_file(plan[0], plan[1], plan[2], plan[3])
 
     decode_workers = min(io_threads(), 4)
     decoded_iter = map_ordered(
-        lambda plan: avro_native.decode_file(plan[0], plan[1], plan[2], plan[3]),
-        plans, workers=decode_workers, window=decode_workers + 1,
+        _decode, plans, workers=decode_workers, window=decode_workers + 1,
+        retry_site="avro:read", telemetry=telemetry,
     )
     for (fp, data_offset, sync, compiled, id_field_of), decoded in zip(
         plans, decoded_iter

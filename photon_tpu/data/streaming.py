@@ -498,6 +498,7 @@ class LibsvmFileSource:
         intercept: bool = True,
         binary_labels: bool = True,
         feature_dim: Optional[int] = None,
+        telemetry=None,
     ):
         """Metadata must cover the GLOBAL file list (multi-process runs
         shard files AFTER construction via :meth:`with_files` — scanning a
@@ -505,13 +506,15 @@ class LibsvmFileSource:
 
         With ``feature_dim`` given (e.g. from a feature-indexing job's index
         map), only a cheap row/nnz line scan runs; otherwise each file is
-        parsed once to discover the max feature id.
+        parsed once to discover the max feature id.  ``telemetry`` receives
+        the per-part ``io.retries`` counter of the retried chunk loads.
         """
         if not files:
             raise ValueError("LibsvmFileSource needs at least one file")
         self.files = list(files)
         self.intercept = intercept
         self.binary_labels = binary_labels
+        self.telemetry = telemetry
         dim, capacity, total = feature_dim or 0, 1, 0
         if feature_dim is None:
             from photon_tpu.data.libsvm import parse_libsvm
@@ -561,16 +564,27 @@ class LibsvmFileSource:
 
     def _load_chunk(self, i: int) -> SparseBatch:
         from photon_tpu.data.libsvm import load_sparse_batch
+        from photon_tpu.fault.injection import fault_point
+        from photon_tpu.fault.retry import retry_call
 
-        # Flat-CSR fast path inside (skips per-row numpy views, which cost
-        # more than the C++ parse at streaming scale); self.capacity
-        # already counts the appended intercept column.
-        batch, _, _ = load_sparse_batch(
-            self.files[i],
-            dim=self.feature_dim,
-            intercept=self.intercept,
-            capacity=self.capacity,
-            binary_labels=self.binary_labels,
+        def _load():
+            # Flat-CSR fast path inside (skips per-row numpy views, which
+            # cost more than the C++ parse at streaming scale);
+            # self.capacity already counts the appended intercept column.
+            fault_point("io:read", path=self.files[i])
+            return load_sparse_batch(
+                self.files[i],
+                dim=self.feature_dim,
+                intercept=self.intercept,
+                capacity=self.capacity,
+                binary_labels=self.binary_labels,
+            )
+
+        # Part-file re-parses happen once per objective pass: a transient
+        # storage error mid-pass must cost a backoff, not the whole
+        # streamed fit (io.retries counts recoveries).
+        batch, _, _ = retry_call(
+            _load, site="libsvm:read", telemetry=self.telemetry
         )
         from photon_tpu.data.stream_layouts import (
             attach_stream_aux,

@@ -59,6 +59,17 @@ def add_telemetry_arg(parser: argparse.ArgumentParser) -> None:
                         "disables process-wide")
 
 
+def add_fault_args(parser: argparse.ArgumentParser) -> None:
+    """Fault-injection flags shared by every driver (the CLI face of
+    :mod:`photon_tpu.fault.injection`; overrides ``PHOTON_FAULTS``)."""
+    parser.add_argument("--faults", default=None, metavar="SPEC",
+                        help="inject deterministic faults for recovery "
+                        "testing, e.g. 'io:read:p=0.3,descent:kill:iter=2,"
+                        "solve:nan:coord=per_item' (overrides PHOTON_FAULTS)")
+    parser.add_argument("--faults-seed", type=int, default=0,
+                        help="seed of the fault plan's RNG streams")
+
+
 def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--backend", choices=("tpu", "cpu"), default="tpu",
                         help="compute platform (tpu uses the environment's "
@@ -68,6 +79,7 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the train phase")
     add_telemetry_arg(parser)
+    add_fault_args(parser)
 
 
 def add_distributed_args(parser: argparse.ArgumentParser) -> None:
@@ -149,6 +161,9 @@ def telemetry_run(args: argparse.Namespace, driver: str, logger):
     until then the operator-declared ``--process-id`` gates writing, so a
     failure before that point (bad input path on every rank) cannot have N
     processes concurrently writing the same run_report.json."""
+    from photon_tpu.fault.injection import install_from_args, set_plan
+
+    install_from_args(args)  # --faults SPEC (no-op without the flag)
     session = init_telemetry(args, driver, logger)
     if getattr(args, "coordinator", None) is not None:
         session.write = (getattr(args, "process_id", None) or 0) == 0
@@ -162,6 +177,11 @@ def telemetry_run(args: argparse.Namespace, driver: str, logger):
         raise
     else:
         session.finalize(getattr(args, "output_dir", None))
+    finally:
+        if getattr(args, "faults", None):
+            # A --faults plan is scoped to THIS run: clear it so a later
+            # in-process driver run without the flag is not injected.
+            set_plan(None)
 
 
 def add_data_args(parser: argparse.ArgumentParser) -> None:

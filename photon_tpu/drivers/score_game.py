@@ -136,7 +136,9 @@ def _run_streaming(args, model, index_maps, logger, session) -> dict:
     ids_chunks = {c: [] for c in id_cols}
 
     def load_chunk(path):
-        chunk, _ = read_game_avro(path, bags, id_cols, index_maps=index_maps)
+        chunk, _ = read_game_avro(
+            path, bags, id_cols, index_maps=index_maps, telemetry=session
+        )
         return chunk
 
     def score_chunk(chunk):
@@ -185,12 +187,18 @@ def run(args: argparse.Namespace) -> dict:
 
 
 def _run(args: argparse.Namespace, logger, session) -> dict:
+    from photon_tpu.fault.retry import retry_call
     from photon_tpu.game.model_io import load_game_model
 
     os.makedirs(args.output_dir, exist_ok=True)
 
     with logger.timed("load-model"):
-        model, index_maps = load_game_model(args.model)
+        # The model directory read spans many small files; a transient
+        # storage error retries instead of killing the scoring run.
+        model, index_maps = retry_call(
+            lambda: load_game_model(args.model),
+            site="model:load", telemetry=session, logger=logger,
+        )
         logger.info(
             "model: %s, coordinates %s", model.task_type,
             list(model.coordinates),

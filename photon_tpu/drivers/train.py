@@ -123,6 +123,7 @@ def _run_streaming(args: argparse.Namespace, logger, session) -> dict:
             files, intercept=args.intercept,
             binary_labels=args.task in BINARY_TASKS,
             feature_dim=args.feature_dim,
+            telemetry=session,  # io.retries from retried part reads
         ).with_files(shard_files_for_process(files))
     logger.info(
         "streaming %d of %d files, %d rows total, dim %d, nnz capacity %d",

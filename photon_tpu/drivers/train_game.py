@@ -117,6 +117,24 @@ def build_parser() -> argparse.ArgumentParser:
                    default=False,
                    help="write each sweep entry's model as it finishes "
                    "(resume via --initial-model)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="preemption-safe descent checkpointing: after every "
+                   "outer iteration the full restart state (models, "
+                   "residual score rows, best-model tracking, history) is "
+                   "published atomically under this directory (one "
+                   "subdirectory per sweep entry; rank 0 writes under "
+                   "multi-controller)")
+    p.add_argument("--resume", default=None, metavar="auto|latest|PATH",
+                   help="restore a descent mid-sweep from --checkpoint-dir: "
+                   "'auto' resumes whatever is checkpointed (fresh start "
+                   "otherwise), 'latest' requires a checkpoint, a path "
+                   "names one checkpoint version directory.  Completed "
+                   "sweep entries are rebuilt from their snapshots without "
+                   "re-running; a resumed fit matches an uninterrupted one")
+    p.add_argument("--max-quarantined", type=int, default=8,
+                   help="how many non-finite solves/score rows may be "
+                   "quarantined (previous iterate kept, descent.quarantined "
+                   "telemetry) before the run fails; -1 = unlimited")
     return p
 
 
@@ -291,7 +309,7 @@ def _build_sweep(specs, task: str):
     return configurations
 
 
-def _load_game_data(spec: str, args, index_maps=None):
+def _load_game_data(spec: str, args, index_maps=None, telemetry=None):
     """(dataset, index_maps) from an input spec (Avro or synthetic-game)."""
     if spec.startswith("synthetic-game:"):
         from photon_tpu.data.synthetic import make_game_dataset
@@ -319,7 +337,9 @@ def _load_game_data(spec: str, args, index_maps=None):
     from photon_tpu.data.game_io import read_game_avro
 
     bags, id_cols = parse_bags_and_id_columns(args)
-    return read_game_avro(spec, bags, id_cols, index_maps=index_maps)
+    return read_game_avro(
+        spec, bags, id_cols, index_maps=index_maps, telemetry=telemetry
+    )
 
 
 def parse_feature_bags(feature_bags: str) -> dict:
@@ -339,6 +359,19 @@ def parse_bags_and_id_columns(args) -> tuple[dict, list]:
     bags = parse_feature_bags(args.feature_bags)
     id_cols = [c.strip() for c in args.id_columns.split(",") if c.strip()]
     return bags, id_cols
+
+
+def _has_published_checkpoint(checkpoint_dir) -> bool:
+    """True when any descent checkpoint chain under ``checkpoint_dir`` has
+    a published version (its LATEST pointer exists)."""
+    from photon_tpu.fault.checkpoint import LATEST_NAME
+
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return False
+    for _dirpath, _dirnames, filenames in os.walk(checkpoint_dir):
+        if LATEST_NAME in filenames:
+            return True
+    return False
 
 
 def run(args: argparse.Namespace) -> dict:
@@ -363,6 +396,27 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
 
     os.makedirs(args.output_dir, exist_ok=True)
     specs = _coordinate_specs(args)
+    if args.resume and not args.checkpoint_dir:
+        raise ValueError("--resume needs --checkpoint-dir")
+    if args.resume == "latest" and not _has_published_checkpoint(
+        args.checkpoint_dir
+    ):
+        # Strictness means a PUBLISHED checkpoint (a LATEST pointer), not
+        # just directory debris from a run killed before its first publish.
+        raise ValueError(
+            f"--resume latest: no published checkpoint under "
+            f"{args.checkpoint_dir!r}"
+        )
+    if args.resume and args.resume not in ("auto", "latest"):
+        # An explicit checkpoint path names one descent run, so a
+        # multi-entry sweep (or tuning, whose configurations are sampled)
+        # is rejected up front — before the data load, not after entry 0
+        # has already burned its fit.
+        if args.tuning != "none" or len(_build_sweep(specs, args.task)) > 1:
+            raise ValueError(
+                "an explicit --resume path applies to a single sweep "
+                "entry; use --resume auto for sweeps/tuning"
+            )
 
     prebuilt_maps = None
     if args.index_maps:
@@ -379,11 +433,14 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         }
 
     with logger.timed("load-data"):
-        data, index_maps = _load_game_data(args.input, args, index_maps=prebuilt_maps)
+        data, index_maps = _load_game_data(
+            args.input, args, index_maps=prebuilt_maps, telemetry=session
+        )
         val_data = None
         if args.validation_input:
             val_data, _ = _load_game_data(
-                args.validation_input, args, index_maps=index_maps
+                args.validation_input, args, index_maps=index_maps,
+                telemetry=session,
             )
         elif args.validation_split:
             data, val_data = split_game_dataset(data, args.validation_split)
@@ -498,10 +555,36 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
                 shutil.rmtree(aside, ignore_errors=True)
             logger.info("checkpoint: iteration %d -> %s", iteration, ckpt_dir)
 
+    max_quarantined = (
+        None if args.max_quarantined < 0 else args.max_quarantined
+    )
+    fit_seq = itertools.count()
+
+    def _slug(label: str) -> str:
+        return "".join(c if c.isalnum() else "-" for c in label)[:80]
+
     def fit_config(config) -> "object":
+        # One stable checkpoint subdirectory per sweep entry (sequence
+        # number + sanitized label), so every descent run owns its own
+        # versioned checkpoint chain and mid-sweep resume can tell finished
+        # entries from the interrupted one.
+        ckpt_dir = resume = None
+        if args.checkpoint_dir:
+            seq = next(fit_seq)
+            ckpt_dir = os.path.join(
+                args.checkpoint_dir,
+                f"{seq:03d}-{_slug(config.name or 'config')}",
+            )
+            # Per-entry resume is auto-style: entries the interrupted run
+            # never reached have no checkpoint and start fresh ('latest'
+            # strictness — at least one checkpoint exists — was enforced
+            # above; explicit paths were validated single-entry up front).
+            resume = args.resume if args.resume != "latest" else "auto"
         result = estimator.fit(
             [config], initial_model=initial_model, locked_coordinates=locked,
             checkpoint_fn=checkpoint_fn,
+            checkpoint_dir=ckpt_dir, resume=resume,
+            max_quarantined=max_quarantined,
         )[0]
         results.append(result)
         if (args.checkpoint or args.save_all_models) and is_primary:

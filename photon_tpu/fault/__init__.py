@@ -1,0 +1,58 @@
+"""Fault tolerance for GAME training: checkpoint/resume, fault injection,
+retry with backoff, and quarantine-based graceful degradation.
+
+The pieces (see each module's docstring for the full story):
+
+- :mod:`photon_tpu.fault.checkpoint` — preemption-safe per-outer-iteration
+  descent checkpoints (atomic, versioned, manifest-hashed) + resume.
+- :mod:`photon_tpu.fault.injection` — deterministic, seedable
+  :class:`FaultPlan` (``PHOTON_FAULTS`` / ``--faults``) injecting IO errors,
+  inter-iteration kills, and NaN solves at named sites, so the recovery
+  paths are CI-testable.
+- :mod:`photon_tpu.fault.retry` — jittered, capped, telemetry-counted
+  exponential backoff around guarded IO.
+- :mod:`photon_tpu.fault.atomic` — write-to-temp + fsync + rename
+  publication and content-hash manifests.
+
+:class:`QuarantineBudgetError` is raised by the descent loop when more
+buckets/coordinates were quarantined (non-finite solves or score rows kept
+at their previous iterate) than the run's ``--max-quarantined`` budget
+allows.
+"""
+
+from photon_tpu.fault.atomic import (  # noqa: F401
+    CorruptArtifactError,
+    atomic_dir,
+    atomic_write_bytes,
+    atomic_write_json,
+    verify_manifest,
+    write_manifest,
+)
+from photon_tpu.fault.checkpoint import (  # noqa: F401
+    CheckpointError,
+    DescentCheckpointer,
+    DescentState,
+)
+from photon_tpu.fault.injection import (  # noqa: F401
+    FaultPlan,
+    InjectedFaultError,
+    InjectedIOError,
+    InjectedKillError,
+    active_plan,
+    consume_nan_injection,
+    fault_point,
+    install_from_args,
+    set_plan,
+)
+from photon_tpu.fault.retry import (  # noqa: F401
+    RETRY_TOTALS,
+    RetryPolicy,
+    default_policy,
+    retry_call,
+)
+
+
+class QuarantineBudgetError(RuntimeError):
+    """More non-finite solves/score rows were quarantined than the
+    ``--max-quarantined`` budget tolerates; the run fails loudly instead of
+    silently training a mostly-frozen model."""

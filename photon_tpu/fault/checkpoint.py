@@ -1,0 +1,386 @@
+"""Preemption-safe checkpoint/resume for GAME coordinate descent.
+
+The reference's recovery story is Spark lineage plus per-iteration HDFS
+model dumps; a preempted TPU slice has neither.  This module snapshots the
+full descent state after every outer iteration — per-coordinate models,
+the residual engine's score rows (fetched once, off the hot path), the
+best-model-so-far, validation-metric history, and the iteration/quarantine
+counters — into a versioned on-disk checkpoint published with the atomic
+protocol of :mod:`photon_tpu.fault.atomic`:
+
+    <dir>/ckpt-000002/
+        state.json      # iteration, history, best metrics, fingerprint
+        arrays.npz      # model tables + residual score rows (exact dtypes)
+        manifest.json   # content hashes, written last
+    <dir>/LATEST        # pointer file, replaced atomically
+
+Resume rebuilds the device score tables from the snapshot rows and warm
+starts every coordinate from its checkpointed model, so a resumed fit is
+numerically identical to an uninterrupted one (score rows round-trip at
+their native dtype: f32 for the device engine, f64 for the host escape
+hatch).  Under multi-controller runs every rank LOADS the checkpoint (the
+directory must be on storage all ranks can read) but only rank 0 WRITES —
+the same primary-writes rule the drivers use for models and reports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.fault.atomic import (
+    atomic_dir,
+    atomic_write_bytes,
+    verify_manifest,
+    write_manifest,
+)
+from photon_tpu.fault.injection import fault_point
+from photon_tpu.fault.retry import retry_call
+from photon_tpu.telemetry import NULL_SESSION
+
+STATE_VERSION = 1
+LATEST_NAME = "LATEST"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be loaded (missing, corrupt, or mismatched)."""
+
+
+def descent_fingerprint(
+    task_type: str, coordinate_names, num_examples: int, residual_mode: str,
+    config_key: Optional[str] = None,
+    validation_key: Optional[str] = None,
+    locked=(),
+    warm_start: bool = False,
+) -> dict:
+    """The ONE definition of a descent run's checkpoint-compatibility
+    fingerprint (descent and estimator both check against it): a resumed
+    run must be the same descent — same task, coordinate update sequence,
+    data size, residual mode, optimization configuration (when the caller
+    supplies a key), validation setup (primary evaluator, or None for an
+    unevaluated fit), lock list, and warm-start-ness — or the restored
+    state would silently be another run's model (or crash on a
+    best-metrics shape it never tracked)."""
+    fp = {
+        "task_type": task_type,
+        "coordinates": list(coordinate_names),
+        "num_examples": int(num_examples),
+        "residual_mode": residual_mode,
+        "validation": validation_key,
+        "locked": sorted(locked),
+        "warm_start": bool(warm_start),
+    }
+    if config_key is not None:
+        fp["config"] = config_key
+    return fp
+
+
+def configuration_key(coordinate_configs: dict) -> str:
+    """Digest of a sweep point's per-coordinate optimization configs
+    (regularization weights, solver settings — frozen-dataclass reprs are
+    deterministic and content-bearing).  Deliberately EXCLUDES
+    ``descent_iterations``: resuming with more iterations is a supported
+    continuation, a different regularization is a different model."""
+    import hashlib
+
+    return hashlib.sha256(repr(coordinate_configs).encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class DescentState:
+    """One outer iteration's complete restart state (live model objects;
+    (de)serialization to arrays happens in the checkpointer)."""
+
+    iteration: int              # last COMPLETED outer iteration
+    num_iterations: int         # the run's target iteration count
+    task_type: str
+    models: Dict[str, object]
+    best_models: Dict[str, object]
+    best_metrics: Dict[str, float]
+    best_iteration: int
+    history: List[dict]
+    residual_rows: Dict[str, np.ndarray]
+    quarantined: int
+    fingerprint: dict
+
+    @property
+    def completed(self) -> bool:
+        return self.iteration + 1 >= self.num_iterations
+
+
+# -- model <-> array serialization ------------------------------------------
+
+
+def _models_to_arrays(prefix: str, models: Dict[str, object]):
+    """(arrays, meta) for one model dict; array keys are
+    ``<prefix><i>__<field>`` (npz-safe, order = meta order)."""
+    from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
+    from photon_tpu.parallel.mesh import to_host
+
+    arrays, meta = {}, []
+    for i, (name, model) in enumerate(models.items()):
+        key = f"{prefix}{i}__"
+        if isinstance(model, FixedEffectModel):
+            coeff = model.coefficients
+            arrays[key + "means"] = to_host(coeff.means)
+            if coeff.variances is not None:
+                arrays[key + "variances"] = to_host(coeff.variances)
+            meta.append({
+                "name": name, "kind": "fixed", "shard_name": model.shard_name,
+                "has_variances": coeff.variances is not None,
+            })
+        elif isinstance(model, RandomEffectModel):
+            arrays[key + "table"] = to_host(model.table)
+            arrays[key + "keys"] = np.asarray(model.keys)
+            if model.variances is not None:
+                arrays[key + "variances"] = to_host(model.variances)
+            meta.append({
+                "name": name, "kind": "random", "shard_name": model.shard_name,
+                "entity_column": model.entity_column,
+                "has_variances": model.variances is not None,
+            })
+        else:
+            raise TypeError(f"cannot checkpoint coordinate model {type(model)!r}")
+    return arrays, meta
+
+
+def _models_from_arrays(prefix: str, meta: List[dict], arrays, task_type: str):
+    from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
+    from photon_tpu.models.glm import Coefficients, model_for_task
+
+    models = {}
+    for i, m in enumerate(meta):
+        key = f"{prefix}{i}__"
+        variances = (
+            jnp.asarray(arrays[key + "variances"]) if m["has_variances"] else None
+        )
+        if m["kind"] == "fixed":
+            glm = model_for_task(
+                task_type,
+                Coefficients(jnp.asarray(arrays[key + "means"]), variances),
+            )
+            models[m["name"]] = FixedEffectModel(
+                model=glm, shard_name=m["shard_name"]
+            )
+        else:
+            models[m["name"]] = RandomEffectModel(
+                table=jnp.asarray(arrays[key + "table"]),
+                keys=np.asarray(arrays[key + "keys"]),
+                entity_column=m["entity_column"],
+                shard_name=m["shard_name"],
+                task_type=task_type,
+                variances=variances,
+            )
+    return models
+
+
+class DescentCheckpointer:
+    """Writes/reads versioned descent checkpoints under one directory.
+
+    ``write`` defaults to ``jax.process_index() == 0`` at save time
+    (rank-0-writes); every rank may load.  ``keep`` bounds on-disk versions
+    (older checkpoints are pruned after a successful publish).
+    """
+
+    def __init__(self, directory: str, telemetry=None, logger=None,
+                 keep: int = 2, write: Optional[bool] = None):
+        self.directory = directory
+        self.telemetry = telemetry or NULL_SESSION
+        self.logger = logger
+        self.keep = max(1, keep)
+        self._write = write
+
+    # -- helpers -------------------------------------------------------------
+    def _should_write(self) -> bool:
+        if self._write is not None:
+            return self._write
+        import jax
+
+        return jax.process_index() == 0
+
+    def _ckpt_name(self, iteration: int) -> str:
+        return f"ckpt-{iteration:06d}"
+
+    def latest_path(self) -> Optional[str]:
+        """The checkpoint directory LATEST points to, or None."""
+        pointer = os.path.join(self.directory, LATEST_NAME)
+        if not os.path.isfile(pointer):
+            return None
+        with open(pointer) as f:
+            name = f.read().strip()
+        path = os.path.join(self.directory, name)
+        return path if os.path.isdir(path) else None
+
+    # -- save ----------------------------------------------------------------
+    def save(self, state: DescentState) -> Optional[str]:
+        """Publish ``state`` atomically; returns the checkpoint path (None
+        on non-writing ranks).  Checkpoint IO retries like any other
+        guarded write; an exhausted retry raises — a run that cannot
+        checkpoint is a failed run, not a silently unprotected one."""
+        if not self._should_write():
+            return None
+        t0 = time.monotonic()
+        path = retry_call(
+            lambda: self._save_once(state), site="checkpoint:io",
+            telemetry=self.telemetry, logger=self.logger,
+        )
+        self.telemetry.histogram("checkpoint.write_seconds").observe(
+            time.monotonic() - t0
+        )
+        self.telemetry.counter("checkpoint.saves").inc()
+        if self.logger is not None:
+            self.logger.info(
+                "checkpoint: iteration %d -> %s", state.iteration, path
+            )
+        return path
+
+    def _save_once(self, state: DescentState) -> str:
+        final = os.path.join(self.directory, self._ckpt_name(state.iteration))
+        arrays, models_meta = _models_to_arrays("m", state.models)
+        # When the best model IS the current iterate (the common improving-
+        # run case), its coordinate models are the same objects as
+        # state.models' — store name references instead of fetching and
+        # hashing every table twice.
+        best_shared = sorted(
+            name for name, model in state.best_models.items()
+            if state.models.get(name) is model
+        )
+        best_arrays, best_meta = _models_to_arrays(
+            "b",
+            {
+                name: model for name, model in state.best_models.items()
+                if name not in set(best_shared)
+            },
+        )
+        arrays.update(best_arrays)
+        for j, (name, row) in enumerate(state.residual_rows.items()):
+            arrays[f"r{j}__row"] = np.asarray(row)
+        payload = {
+            "version": STATE_VERSION,
+            "iteration": state.iteration,
+            "num_iterations": state.num_iterations,
+            "task_type": state.task_type,
+            "models": models_meta,
+            "best_models": best_meta,
+            "best_shared": best_shared,
+            "best_metrics": state.best_metrics,
+            "best_iteration": state.best_iteration,
+            "history": state.history,
+            "residual_rows": list(state.residual_rows),
+            "quarantined": state.quarantined,
+            "fingerprint": state.fingerprint,
+        }
+        with atomic_dir(final) as tmp:
+            with open(os.path.join(tmp, "arrays.npz"), "wb") as f:
+                np.savez(f, **arrays)
+            with open(os.path.join(tmp, "state.json"), "w") as f:
+                json.dump(payload, f, indent=1)
+            # The torn-write window fault injection aims at: payload files
+            # exist, manifest/publish has not happened.  A kill here leaves
+            # only an invisible .tmp dir — LATEST still names the previous
+            # complete checkpoint.
+            fault_point("checkpoint:write", iteration=state.iteration)
+            write_manifest(tmp, extra={"iteration": state.iteration})
+        atomic_write_bytes(
+            os.path.join(self.directory, LATEST_NAME),
+            os.path.basename(final).encode(),
+        )
+        self._prune(keep_name=os.path.basename(final))
+        return final
+
+    def _prune(self, keep_name: str) -> None:
+        """Drop all but the newest ``keep`` published checkpoints (the one
+        just written always survives), plus any ``.tmp-*``/``.old-*``
+        debris a hard kill left behind — saves are sequential within the
+        writing rank, so anything with those prefixes is stale by the time
+        a later save prunes."""
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
+        names = sorted(
+            n for n in entries
+            if n.startswith("ckpt-")
+            and os.path.isdir(os.path.join(self.directory, n))
+        )
+        stale = [n for n in entries if n.startswith((".tmp-", ".old-"))]
+        for name in stale + names[:-self.keep]:
+            if name != keep_name:
+                shutil.rmtree(
+                    os.path.join(self.directory, name), ignore_errors=True
+                )
+
+    # -- load ----------------------------------------------------------------
+    def load(self, resume: str) -> Optional[DescentState]:
+        """Resolve ``resume`` and load: ``auto`` returns None when nothing
+        is checkpointed yet, ``latest`` requires a checkpoint, anything else
+        is an explicit checkpoint-version directory path."""
+        if resume in ("auto", "latest"):
+            path = self.latest_path()
+            if path is None:
+                if resume == "latest":
+                    raise CheckpointError(
+                        f"--resume latest: no checkpoint under {self.directory}"
+                    )
+                return None
+            return self.load_path(path)
+        return self.load_path(resume)
+
+    @staticmethod
+    def load_path(path: str) -> DescentState:
+        """Load one checkpoint-version directory, verifying its manifest."""
+        if not os.path.isdir(path):
+            raise CheckpointError(f"no checkpoint directory at {path!r}")
+        verify_manifest(path)
+
+        def _read():
+            fault_point("checkpoint:read", path=path)
+            with open(os.path.join(path, "state.json")) as f:
+                payload = json.load(f)
+            with np.load(os.path.join(path, "arrays.npz")) as arrays:
+                return payload, {k: arrays[k] for k in arrays.files}
+
+        payload, arrays = retry_call(_read, site="checkpoint:io")
+        if payload.get("version") != STATE_VERSION:
+            raise CheckpointError(
+                f"{path}: checkpoint version {payload.get('version')!r} "
+                f"!= supported {STATE_VERSION}"
+            )
+        task = payload["task_type"]
+        models = _models_from_arrays("m", payload["models"], arrays, task)
+        best_models = _models_from_arrays(
+            "b", payload["best_models"], arrays, task
+        )
+        for name in payload.get("best_shared", []):
+            best_models[name] = models[name]
+        # Keep the composite's coordinate order (the update sequence) stable
+        # across the reference-dedup round trip.
+        best_models = {
+            name: best_models[name] for name in models if name in best_models
+        } | {
+            name: model for name, model in best_models.items()
+            if name not in models
+        }
+        return DescentState(
+            iteration=payload["iteration"],
+            num_iterations=payload["num_iterations"],
+            task_type=task,
+            models=models,
+            best_models=best_models,
+            best_metrics=dict(payload["best_metrics"]),
+            best_iteration=payload["best_iteration"],
+            history=list(payload["history"]),
+            residual_rows={
+                name: arrays[f"r{j}__row"]
+                for j, name in enumerate(payload["residual_rows"])
+            },
+            quarantined=int(payload.get("quarantined", 0)),
+            fingerprint=payload.get("fingerprint", {}),
+        )

@@ -1,0 +1,238 @@
+"""Deterministic fault injection for recovery-path testing.
+
+The reference inherits Spark's failure story: lineage re-computation plus
+driver-log archaeology, exercised in production only when something actually
+breaks.  This module makes failure a first-class, *testable* input instead:
+a :class:`FaultPlan` — seedable, parsed from ``PHOTON_FAULTS`` or a driver's
+``--faults`` flag — fires injected faults at named sites threaded through
+the IO and training stack, so CI can prove the retry/checkpoint/quarantine
+paths work rather than hoping they do (SURVEY.md §5 'Failure detection').
+
+Spec grammar (comma-separated rules; tokens within a rule are colon-
+separated; the first two tokens name the site, the rest are ``k=v`` params):
+
+    PHOTON_FAULTS="io:read:p=0.3,descent:kill:iter=2,solve:nan:coord=per_item"
+
+Sites and their actions:
+
+- ``io:read`` / ``io:write`` — raise :class:`InjectedIOError` (an
+  ``OSError``, so the retry layer treats it like any transient storage
+  failure) at guarded read/write call sites.  Params: ``p`` (per-call fire
+  probability, default 1.0), ``times`` (max fires, default unlimited).
+- ``descent:kill`` — raise :class:`InjectedKillError` at the top of a GAME
+  outer iteration, simulating a preempted process between iterations.
+  Params: ``iter`` (fire when the iteration counter equals this), ``times``
+  (default 1).
+- ``checkpoint:write`` — raise :class:`InjectedKillError` in the middle of
+  a checkpoint write (after payload files, before the manifest/publish),
+  the torn-write window the atomic protocol must survive.  Params:
+  ``times`` (default 1), ``p``.
+- ``solve:nan`` — corrupt a coordinate's solve output with NaNs (consumed
+  via :func:`consume_nan_injection`, which returns True instead of
+  raising).  Params: ``coord`` (coordinate name, or ``*`` for any),
+  ``times`` (default 1).
+
+Determinism: every rule owns a ``random.Random`` seeded by
+``(seed, site, rule index)`` — for a serial sequence of calls, the same
+spec + seed fires at the same call positions on every run
+(``PHOTON_FAULTS_SEED``, default 0).  When a fault site runs on concurrent
+IO-pool workers (e.g. pooled native decodes), the SET of draws is still
+seeded but their assignment to files follows thread scheduling — assert on
+aggregate fire/retry counts there, not on which file faulted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+from typing import Dict, Optional
+
+
+class InjectedFaultError(Exception):
+    """Marker base so tests/drivers can recognize injected faults."""
+
+
+class InjectedIOError(OSError, InjectedFaultError):
+    """An injected transient IO failure (retriable: it IS an OSError)."""
+
+
+class InjectedKillError(RuntimeError, InjectedFaultError):
+    """An injected process kill (not retriable; propagates out of the run
+    like a preemption would, so the telemetry error-report and checkpoint
+    recovery paths see exactly what a real kill leaves behind)."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One parsed rule of a fault plan, with its firing state."""
+
+    site: str
+    params: Dict[str, str]
+    rng: random.Random
+    fires: int = 0
+
+    @property
+    def probability(self) -> float:
+        return float(self.params.get("p", 1.0))
+
+    @property
+    def max_fires(self) -> Optional[int]:
+        if "times" in self.params:
+            return int(self.params["times"])
+        # Probabilistic IO rules default to unlimited; deterministic rules
+        # (kill / nan / explicit-iteration) fire once unless told otherwise.
+        return None if "p" in self.params else 1
+
+    def matches(self, site: str, ctx: Dict[str, object]) -> bool:
+        if site != self.site:
+            return False
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if "iter" in self.params:
+            if ctx.get("iteration") != int(self.params["iter"]):
+                return False
+        if "coord" in self.params and self.params["coord"] != "*":
+            if ctx.get("coordinate") != self.params["coord"]:
+                return False
+        return True
+
+    def roll(self) -> bool:
+        """Consume one deterministic draw; True when the rule fires."""
+        p = self.probability
+        fired = p >= 1.0 or self.rng.random() < p
+        if fired:
+            self.fires += 1
+        return fired
+
+
+class FaultPlan:
+    """A parsed set of fault rules with deterministic firing state.
+
+    Plans are stateful (``times`` caps, RNG streams): parse one per run.
+    """
+
+    def __init__(self, rules, seed: int = 0, spec: str = ""):
+        self.rules = list(rules)
+        self.seed = seed
+        self.spec = spec
+        # Fault sites run on IO-pool worker threads too (native decode,
+        # streamed chunk loads): the match→roll sequence mutates rule state
+        # (fire caps, RNG draws) and must be atomic or `times=` caps
+        # overshoot and the seeded fire sequence stops being deterministic.
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        rules = []
+        for i, raw in enumerate(t for t in spec.split(",") if t.strip()):
+            tokens = raw.strip().split(":")
+            if len(tokens) < 2:
+                raise ValueError(
+                    f"bad fault rule {raw!r}: want scope:action[:k=v...]"
+                )
+            site = f"{tokens[0].strip()}:{tokens[1].strip()}"
+            params = {}
+            for tok in tokens[2:]:
+                k, sep, v = tok.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"bad fault param {tok!r} in rule {raw!r} (want k=v)"
+                    )
+                params[k.strip()] = v.strip()
+            rules.append(
+                FaultRule(site, params, random.Random(f"{seed}:{site}:{i}"))
+            )
+        return cls(rules, seed=seed, spec=spec)
+
+    def consume(self, site: str, **ctx) -> Optional[FaultRule]:
+        """The first matching rule that fires for this call, else None."""
+        with self._lock:
+            for rule in self.rules:
+                if rule.matches(site, ctx) and rule.roll():
+                    return rule
+            return None
+
+
+# -- active-plan management --------------------------------------------------
+#
+# One process-wide plan: drivers install from --faults, tests via set_plan,
+# and the env var PHOTON_FAULTS covers subprocesses (the plan re-parses only
+# when the spec string changes, so the per-call cost with no plan is one
+# os.environ.get).
+
+_ENV_VAR = "PHOTON_FAULTS"
+_SEED_VAR = "PHOTON_FAULTS_SEED"
+_active: Optional[FaultPlan] = None
+_env_cache: tuple = ("", 0, None)
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with None) the process-wide fault plan.  An
+    installed plan takes precedence over ``PHOTON_FAULTS``."""
+    global _active
+    _active = plan
+
+
+def reset_env_plan() -> None:
+    """Drop the cached env-var plan so the next :func:`active_plan` call
+    re-parses ``PHOTON_FAULTS`` with fresh rule state (fire caps, RNG
+    streams).  Drivers call this at run start: an env plan is scoped per
+    run, not per process lifetime."""
+    global _env_cache
+    _env_cache = ("", 0, None)
+
+
+def install_from_args(args) -> None:
+    """Driver hook: ``--faults SPEC`` (with ``--faults-seed``) overrides the
+    env var for this process; without the flag, any env-var plan restarts
+    fresh for this run."""
+    spec = getattr(args, "faults", None)
+    if spec:
+        set_plan(FaultPlan.parse(spec, seed=getattr(args, "faults_seed", 0)))
+    else:
+        reset_env_plan()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    global _env_cache
+    if _active is not None:
+        return _active
+    spec = os.environ.get(_ENV_VAR, "").strip()
+    if not spec:
+        return None
+    seed = int(os.environ.get(_SEED_VAR, "0") or "0")
+    if _env_cache[0] != spec or _env_cache[1] != seed:
+        _env_cache = (spec, seed, FaultPlan.parse(spec, seed=seed))
+    return _env_cache[2]
+
+
+def fault_point(site: str, **ctx) -> None:
+    """Declare an injectable fault site.  No-op without an active plan;
+    raises the site's error type when a rule fires.
+
+    ``io:*`` and ``checkpoint:read`` sites raise :class:`InjectedIOError`
+    (retriable); ``*:kill`` and ``checkpoint:write`` raise
+    :class:`InjectedKillError` (fatal — the atomic-write/ checkpoint-resume
+    machinery, not a retry loop, must absorb these).
+    """
+    plan = active_plan()
+    if plan is None:
+        return
+    rule = plan.consume(site, **ctx)
+    if rule is None:
+        return
+    scope, _, action = site.partition(":")
+    if action == "kill" or site == "checkpoint:write":
+        raise InjectedKillError(f"injected kill at {site} ({ctx or rule.params})")
+    raise InjectedIOError(f"injected IO fault at {site} ({ctx or rule.params})")
+
+
+def consume_nan_injection(coordinate: Optional[str]) -> bool:
+    """True when the plan wants this coordinate's next solve corrupted with
+    NaNs (``solve:nan:coord=<name>``); consumes one fire."""
+    plan = active_plan()
+    if plan is None or coordinate is None:
+        return False
+    return plan.consume("solve:nan", coordinate=coordinate) is not None

@@ -651,6 +651,26 @@ class FixedEffectCoordinate:
         if self.normalization is not None:
             means = self.normalization.model_to_original_space(means)
             variances = self.normalization.variances_to_original_space(variances)
+        from photon_tpu.fault.injection import consume_nan_injection
+
+        if consume_nan_injection(getattr(self, "fault_name", None)):
+            means = means.at[0].set(jnp.nan)
+        # Non-finite guard (graceful degradation): a diverged/poisoned solve
+        # keeps the previous iterate (the warm-start model, or zeros on the
+        # first pass) instead of feeding NaN margins into the residual
+        # engine.  The solve already synced above, so this check is a
+        # dim-sized host reduce, not a new hot-loop transfer.
+        tracker.quarantined = 0
+        if not bool(jnp.all(jnp.isfinite(means))):
+            tracker.quarantined = 1
+            if initial_model is not None:
+                prev = initial_model.coefficients
+                means = jnp.asarray(prev.means)
+                variances = (
+                    None if prev.variances is None else jnp.asarray(prev.variances)
+                )
+            else:
+                means, variances = jnp.zeros_like(means), None
         model = FixedEffectModel(
             model=model_for_task(self.task_type, Coefficients(means, variances)),
             shard_name=self.config.shard_name,
@@ -751,11 +771,15 @@ class RandomEffectCoordinate:
         init_table = (
             None if initial_model is None else self._initial_table(initial_model)
         )
-        stats = {"entities": 0, "converged": 0, "iterations_max": 0}
+        stats = {"entities": 0, "converged": 0, "iterations_max": 0,
+                 "quarantined": 0}
+        from photon_tpu.fault.injection import consume_nan_injection
         from photon_tpu.game.projection import (
             IndexMapBucketProjection,
             RandomProjectionMatrix,
         )
+
+        inject_nan = consume_nan_injection(getattr(self, "fault_name", None))
 
         # Per-bucket convergence results stay on device until all bucket
         # solves have been DISPATCHED: the stats collection below is the one
@@ -790,35 +814,66 @@ class RandomEffectCoordinate:
             else:
                 coefficients, result = self._solver(batch, w0)
             means, variances = coefficients.means, coefficients.variances
+            if inject_nan and i == 0:
+                # Fault injection (solve:nan): poison one entity's solve so
+                # the quarantine path below is exercised end to end.
+                means = means.at[0].set(jnp.nan)
+            # Non-finite guard (graceful degradation): entities whose solve
+            # diverged to NaN/Inf keep their previous iterate (warm-start
+            # row, or zero on a cold start) instead of poisoning the table;
+            # the count joins the ONE deferred host sync below.
+            good = jnp.all(jnp.isfinite(means), axis=1)
+            prev_rows = None if init_table is None else init_table[entity_idx]
             if proj is None:
-                table = table.at[entity_idx].set(means)
+                fallback = 0.0 if prev_rows is None else prev_rows
+                table = table.at[entity_idx].set(
+                    jnp.where(good[:, None], means, fallback)
+                )
                 if var_table is not None:
-                    var_table = var_table.at[entity_idx].set(variances)
+                    # Quarantined entities get zero variance: the previous
+                    # model's variances are not carried through warm starts.
+                    var_table = var_table.at[entity_idx].set(
+                        jnp.where(good[:, None], variances, 0.0)
+                    )
             elif isinstance(proj, IndexMapBucketProjection):
                 # Scatter each local slot back to its global column; slots
                 # are unique per entity, so add-on-zero-rows equals set, and
-                # masked pad slots contribute exactly 0.
+                # masked pad slots contribute exactly 0.  Quarantined
+                # entities scatter zeros, then get their previous full row
+                # added onto their (still-zero) table row.
                 proj_ids, mask = proj.scatter_args()
                 ids_j, mask_j = jnp.asarray(proj_ids), jnp.asarray(mask)
-                table = table.at[entity_idx[:, None], ids_j].add(means * mask_j)
+                safe_means = jnp.where(good[:, None], means, 0.0)
+                table = table.at[entity_idx[:, None], ids_j].add(
+                    safe_means * mask_j
+                )
+                if prev_rows is not None:
+                    table = table.at[entity_idx].add(
+                        jnp.where(good, 0.0, 1.0)[:, None] * prev_rows
+                    )
                 if var_table is not None:
                     var_table = var_table.at[entity_idx[:, None], ids_j].add(
-                        variances * mask_j
+                        jnp.where(good[:, None], variances, 0.0) * mask_j
                     )
             else:
                 assert isinstance(proj, RandomProjectionMatrix)
-                table = table.at[entity_idx].set(proj.lift(means))
+                lifted = proj.lift(means)
+                fallback = 0.0 if prev_rows is None else prev_rows
+                table = table.at[entity_idx].set(
+                    jnp.where(good[:, None], lifted, fallback)
+                )
                 if var_table is not None:
                     var_table = var_table.at[entity_idx].set(
-                        proj.lift_variance(variances)
+                        jnp.where(good[:, None], proj.lift_variance(variances), 0.0)
                     )
             pending.append(
                 (bucket.entity_index < num_entities, result.converged,
-                 result.iterations)
+                 result.iterations, good)
             )
-        for real, converged, iterations in pending:
+        for real, converged, iterations, good in pending:
             stats["entities"] += int(real.sum())
             stats["converged"] += int(to_host(converged)[real].sum())
+            stats["quarantined"] += int((~to_host(good))[real].sum())
             if real.any():
                 stats["iterations_max"] = max(
                     stats["iterations_max"],
@@ -1026,6 +1081,29 @@ class FactoredRandomEffectCoordinate:
 
         # Materialize per-entity coefficients w_e = L z_e (padded slot drops).
         table = z_table[:num_entities] @ latent.T
+        from photon_tpu.fault.injection import consume_nan_injection
+
+        if consume_nan_injection(getattr(self, "fault_name", None)):
+            table = table.at[0].set(jnp.nan)
+        # Non-finite guard: entities whose materialized coefficients are
+        # NaN/Inf (a diverged latent alternation) fall back to the
+        # warm-start model's rows, or zeros on a cold start — the factored
+        # analog of the bucketed quarantine (train() already syncs per-
+        # bucket stats above, so this adds no new hot-loop transfer).
+        good = jnp.all(jnp.isfinite(table), axis=1)
+        stats["quarantined"] = int((~to_host(good)).sum())
+        if stats["quarantined"]:
+            if initial_model is not None:
+                aligned = np.zeros((num_entities, self.dim), np.float32)
+                src_idx = entity_index_for(
+                    self.dataset.keys, np.asarray(initial_model.keys)
+                )
+                found = src_idx >= 0
+                aligned[found] = to_host(initial_model.table)[src_idx[found]]
+                prev = jnp.asarray(aligned)
+            else:
+                prev = jnp.zeros_like(table)
+            table = jnp.where(good[:, None], table, prev)
         model = RandomEffectModel(
             table=table,
             keys=self.dataset.keys,

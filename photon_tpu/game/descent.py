@@ -17,7 +17,12 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional, Sequence
 
+import numpy as np
+
 from photon_tpu.evaluation.evaluators import MultiEvaluator
+from photon_tpu.fault import QuarantineBudgetError
+from photon_tpu.fault.checkpoint import CheckpointError, DescentState
+from photon_tpu.fault.injection import fault_point
 from photon_tpu.game.data import GameDataset
 from photon_tpu.game.model import DeviceScoringCache, GameModel
 from photon_tpu.game.residuals import (
@@ -43,6 +48,14 @@ class DescentResult:
     @property
     def models_match(self) -> bool:
         return self.best_model is self.last_model
+
+
+def _quarantine_count(info) -> int:
+    """Quarantined-solve count reported by a coordinate's train() — dict key
+    for random-effect stats, attribute for the fixed-effect tracker."""
+    if isinstance(info, dict):
+        return int(info.get("quarantined", 0))
+    return int(getattr(info, "quarantined", 0))
 
 
 def _record_coordinate_info(telemetry, name: str, info) -> None:
@@ -199,17 +212,51 @@ class CoordinateDescent:
         )
         return metrics
 
+    def _fingerprint(
+        self, config_key: Optional[str] = None, locked=(),
+        warm_start: bool = False,
+    ) -> dict:
+        from photon_tpu.fault.checkpoint import descent_fingerprint
+
+        has_validation = (
+            self.validation_data is not None and self.evaluators is not None
+        )
+        return descent_fingerprint(
+            self.task_type, self.coordinates,
+            self.training_data.num_examples, self.residual_mode,
+            config_key=config_key,
+            validation_key=(
+                self.evaluators.primary.name if has_validation else None
+            ),
+            locked=locked,
+            warm_start=warm_start,
+        )
+
     def run(
         self,
         num_iterations: int,
         initial_model: Optional[GameModel] = None,
         locked_coordinates: Sequence[str] = (),
         checkpoint_fn=None,
+        checkpointer=None,
+        resume_state: Optional[DescentState] = None,
+        max_quarantined: Optional[int] = None,
+        config_key: Optional[str] = None,
     ) -> DescentResult:
         """``checkpoint_fn(iteration, model)``, when given, is called after
         every full coordinate pass with the current composite model — the
         reference's per-iteration intermediate model output (SURVEY.md §5
         'Failure detection': restart-from-checkpoint is the recovery story).
+
+        ``checkpointer`` (a :class:`~photon_tpu.fault.checkpoint.
+        DescentCheckpointer`) snapshots the FULL restart state — models,
+        residual score rows, best-model tracking, history — after every
+        outer iteration; ``resume_state`` restores a snapshot mid-sweep
+        (device tables rebuilt from the saved rows), so a resumed fit
+        matches an uninterrupted one.  ``max_quarantined`` bounds how many
+        non-finite solves/score rows may be quarantined (previous iterate
+        kept) before the run fails with :class:`QuarantineBudgetError`
+        (None = unlimited).
         """
         locked = set(locked_coordinates)
         unknown = locked - set(self.coordinates)
@@ -227,7 +274,49 @@ class CoordinateDescent:
         if (self.validation_data is not None and self.evaluators is not None
                 and self.validation_mode == "device"):
             val_engine, val_cache = self._build_validation()
-        if initial_model is not None:
+
+        best_model: Optional[GameModel] = None
+        best_metrics: Dict[str, float] = {}
+        best_iteration = -1
+        history = []
+        start_iteration = 0
+        quarantined_total = 0
+
+        if resume_state is not None:
+            mine = self._fingerprint(
+                config_key, locked=locked,
+                warm_start=initial_model is not None,
+            )
+            if resume_state.fingerprint != mine:
+                raise CheckpointError(
+                    f"checkpoint fingerprint {resume_state.fingerprint} does "
+                    f"not match this descent {mine}; refusing to resume"
+                )
+            with self.telemetry.span(
+                "descent.resume", iteration=resume_state.iteration
+            ):
+                models = dict(resume_state.models)
+                residuals.load_rows(resume_state.residual_rows)
+                if val_engine is not None:
+                    # The validation table is NOT snapshotted: re-scoring
+                    # the restored models against the cached features is
+                    # the same deterministic kernel an uninterrupted run
+                    # used to fill these rows.
+                    for name, model in models.items():
+                        val_engine.update(name, val_cache.score(model))
+                best_model = GameModel(
+                    dict(resume_state.best_models), self.task_type
+                )
+                best_metrics = dict(resume_state.best_metrics)
+                best_iteration = resume_state.best_iteration
+                history = list(resume_state.history)
+                quarantined_total = resume_state.quarantined
+                start_iteration = resume_state.iteration + 1
+            self.telemetry.counter("descent.resumes").inc()
+            self.logger.info(
+                "resumed descent after iteration %d", resume_state.iteration
+            )
+        elif initial_model is not None:
             for name, coord_model in initial_model.coordinates.items():
                 if name not in self.coordinates:
                     continue
@@ -241,18 +330,62 @@ class CoordinateDescent:
                     # iteration — validation.score_reuse counts them).
                     val_engine.update(name, val_cache.score(coord_model))
 
-        best_model: Optional[GameModel] = None
-        best_metrics: Dict[str, float] = {}
-        history = []
+        # Drain guard flags from the seeding/resume updates BEFORE the loop:
+        # a rejected seed row belongs to the INITIAL model, not to whatever
+        # trains first in iteration 0 (misattributing it would roll a good
+        # trained iterate back to the bad initial model).  The rejected
+        # row already kept its zero state, so dropping the model is the
+        # whole fix-up.
+        seed_rejected = set(residuals.poll_quarantined())
+        if val_engine is not None:
+            seed_rejected |= set(val_engine.poll_quarantined())
+        bad_locked = sorted(seed_rejected & locked)
+        if bad_locked:
+            raise ValueError(
+                f"locked coordinate(s) {bad_locked} produced non-finite "
+                "scores from the initial model; a locked coordinate cannot "
+                "be quarantined"
+            )
+        for name in sorted(seed_rejected):
+            self.telemetry.counter(
+                "descent.quarantined", coordinate=name, stage="seed"
+            ).inc()
+            quarantined_total += 1
+            models.pop(name, None)
+            self.logger.info(
+                "coordinate %s: non-finite scores from the initial model "
+                "quarantined (cold start instead)", name,
+            )
+        if max_quarantined is not None and quarantined_total > max_quarantined:
+            raise QuarantineBudgetError(
+                f"{quarantined_total} quarantined solves/score rows "
+                f"exceed --max-quarantined {max_quarantined}"
+            )
+
+        if start_iteration >= num_iterations:
+            # Resumed a completed descent: nothing left to run.
+            last = GameModel(dict(models), self.task_type)
+            return DescentResult(
+                best_model=best_model if best_model is not None else last,
+                last_model=last,
+                best_metrics=best_metrics,
+                history=history,
+            )
 
         telemetry = self.telemetry
-        for it in range(num_iterations):
+        for it in range(start_iteration, num_iterations):
+            # The preemption site fault injection exercises: between outer
+            # iterations, where a killed run must restart from the last
+            # published checkpoint.
+            fault_point("descent:kill", iteration=it)
             coord_logs = {}
             trained = 0
+            prev_iterates: Dict[str, object] = {}
             with telemetry.span("descent.iteration", iteration=it) as iter_span:
                 for name, coord in self.coordinates.items():
                     if name in locked:
                         continue
+                    prev_iterates[name] = models.get(name)
                     offsets = residuals.offsets_for(name)
                     with self.logger.timed(f"iter{it}-{name}"):
                         model, info = coord.train(
@@ -265,6 +398,15 @@ class CoordinateDescent:
                         # just trained touches its validation score row.
                         val_engine.update(name, val_cache.score(model))
                     trained += 1
+                    q = _quarantine_count(info)
+                    if q:
+                        # Non-finite solves quarantined inside train():
+                        # those buckets kept their previous iterate.
+                        telemetry.counter(
+                            "descent.quarantined", coordinate=name,
+                            stage="solve",
+                        ).inc(q)
+                        quarantined_total += q
                     cache_bytes = getattr(
                         getattr(coord, "device_data", None),
                         "_score_cache_bytes", 0,
@@ -289,6 +431,68 @@ class CoordinateDescent:
                     )
                     coord_logs[name] = summary
                     self.logger.info("iter %d coordinate %s: %s", it, name, summary)
+
+                # Drain the score tables' non-finite guards (one tiny sync
+                # per iteration): a rejected row means the coordinate's
+                # fresh scores were poisoned even though its solve looked
+                # fine.  Roll the model back to the previous iterate (drop
+                # it entirely on a cold start) and re-sync BOTH engines'
+                # rows to the rolled-back model, so composite, residual
+                # offsets, validation rows, and any checkpoint stay
+                # consistent.  A coordinate rejected by both engines is ONE
+                # quarantine event.
+                rejected = set(residuals.poll_quarantined())
+                if val_engine is not None:
+                    rejected |= set(val_engine.poll_quarantined())
+                bad_locked = sorted(rejected & locked)
+                if bad_locked:
+                    # A locked coordinate's scores come straight from the
+                    # caller's initial model: quarantining it would silently
+                    # drop the one coordinate the caller pinned.  Fail.
+                    raise ValueError(
+                        f"locked coordinate(s) {bad_locked} produced "
+                        "non-finite scores from the initial model; a locked "
+                        "coordinate cannot be quarantined"
+                    )
+                for name in sorted(rejected):
+                    telemetry.counter(
+                        "descent.quarantined", coordinate=name,
+                        stage="score_row",
+                    ).inc()
+                    quarantined_total += 1
+                    prev = prev_iterates.get(name)
+                    if prev is not None:
+                        models[name] = prev
+                        residuals.update(
+                            name, self._score(self.coordinates[name], prev)
+                        )
+                        if val_engine is not None:
+                            val_engine.update(name, val_cache.score(prev))
+                    else:
+                        # No previous iterate: the coordinate leaves the
+                        # composite entirely this iteration (zero rows ==
+                        # absent coordinate), instead of keeping a model
+                        # whose scores are non-finite.
+                        models.pop(name, None)
+                        residuals.update(
+                            name,
+                            np.zeros(
+                                self.training_data.num_examples, np.float32
+                            ),
+                        )
+                        if val_engine is not None:
+                            val_engine.update(
+                                name, np.zeros(val_cache.n, np.float32)
+                            )
+                    self.logger.info(
+                        "iter %d coordinate %s: non-finite scores "
+                        "quarantined (previous iterate kept)", it, name,
+                    )
+                if max_quarantined is not None and quarantined_total > max_quarantined:
+                    raise QuarantineBudgetError(
+                        f"{quarantined_total} quarantined solves/score rows "
+                        f"exceed --max-quarantined {max_quarantined}"
+                    )
 
                 game_model = GameModel(dict(models), self.task_type)
                 if checkpoint_fn is not None:
@@ -317,13 +521,33 @@ class CoordinateDescent:
             )
 
             if not metrics:
-                best_model, best_metrics = game_model, metrics
+                best_model, best_metrics, best_iteration = game_model, metrics, it
             else:
                 primary = self.evaluators.primary
                 if best_model is None or primary.better_than(
                     metrics[primary.name], best_metrics[primary.name]
                 ):
-                    best_model, best_metrics = game_model, metrics
+                    best_model, best_metrics, best_iteration = game_model, metrics, it
+
+            if checkpointer is not None:
+                state = DescentState(
+                    iteration=it,
+                    num_iterations=num_iterations,
+                    task_type=self.task_type,
+                    models=dict(models),
+                    best_models=dict(best_model.coordinates),
+                    best_metrics=dict(best_metrics),
+                    best_iteration=best_iteration,
+                    history=list(history),
+                    residual_rows=residuals.snapshot_rows(),
+                    quarantined=quarantined_total,
+                    fingerprint=self._fingerprint(
+                        config_key, locked=locked,
+                        warm_start=initial_model is not None,
+                    ),
+                )
+                with telemetry.span("descent.checkpoint.save", iteration=it):
+                    checkpointer.save(state)
 
         assert best_model is not None
         return DescentResult(

@@ -16,6 +16,7 @@ retrained).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence
 
 from photon_tpu.core.normalization import NormalizationContext
@@ -153,7 +154,7 @@ class GameEstimator:
         return self._device_data_cache[key]
 
     def _build_coordinates(self, config: GameOptimizationConfiguration):
-        return {
+        coords = {
             name: build_coordinate(
                 self.training_data,
                 coord_config,
@@ -164,6 +165,12 @@ class GameEstimator:
             )
             for name, coord_config in config.coordinates.items()
         }
+        for name, coord in coords.items():
+            # The coordinate's update-sequence name, so named fault-injection
+            # sites (solve:nan:coord=<name>) and quarantine telemetry can
+            # address it.
+            coord.fault_name = name
+        return coords
 
     def fit(
         self,
@@ -171,14 +178,114 @@ class GameEstimator:
         initial_model: Optional[GameModel] = None,
         locked_coordinates: Sequence[str] = (),
         checkpoint_fn=None,
+        checkpoint_dir: Optional[str] = None,
+        resume: Optional[str] = None,
+        max_quarantined: Optional[int] = None,
     ) -> List[GameResult]:
         """``checkpoint_fn(iteration, model)`` is forwarded to each descent
-        run (per-iteration intermediate model output — SURVEY.md §5)."""
+        run (per-iteration intermediate model output — SURVEY.md §5).
+
+        ``checkpoint_dir`` turns on preemption-safe descent checkpointing
+        (one ``cfg-NNN`` subdirectory per configuration in this call);
+        ``resume`` restores from it: ``auto`` resumes whatever is
+        checkpointed (fresh start otherwise), ``latest`` requires a
+        checkpoint, an explicit path names one checkpoint version (single-
+        configuration fits only).  A configuration whose checkpoint already
+        covers its final iteration is rebuilt from the snapshot without
+        re-running — mid-sweep resume skips finished work.
+        ``max_quarantined`` is the descent quarantine budget (None =
+        unlimited; see :meth:`CoordinateDescent.run`).
+        """
         if not configurations:
             raise ValueError("fit() needs at least one configuration")
+        if resume and checkpoint_dir is None and resume in ("auto", "latest"):
+            raise ValueError(f"resume={resume!r} needs checkpoint_dir")
+        if resume and resume not in ("auto", "latest") and len(configurations) > 1:
+            raise ValueError(
+                "an explicit checkpoint path resumes a single-configuration "
+                "fit; use resume='auto' for sweeps"
+            )
+        from photon_tpu.fault.checkpoint import (
+            CheckpointError,
+            DescentCheckpointer,
+            configuration_key,
+            descent_fingerprint,
+        )
+        from photon_tpu.game.residuals import resolve_residual_mode
+
         results = []
         for i, config in enumerate(configurations):
             label = config.name or f"config-{i}"
+            config_key = configuration_key(config.coordinates)
+            checkpointer = None
+            resume_state = None
+            if checkpoint_dir is not None:
+                checkpointer = DescentCheckpointer(
+                    os.path.join(checkpoint_dir, f"cfg-{i:03d}"),
+                    telemetry=self.telemetry, logger=self.logger,
+                )
+            if resume:
+                if resume in ("auto", "latest"):
+                    resume_state = checkpointer.load(resume)
+                else:
+                    resume_state = DescentCheckpointer.load_path(resume)
+            if resume_state is not None:
+                # Validate compatibility HERE, before the completed
+                # short-circuit below can return a foreign checkpoint's
+                # model as this configuration's result.  The config key
+                # digests the per-coordinate optimization configs, so a
+                # sweep point with different regularization can never
+                # adopt this checkpoint.
+                has_validation = (
+                    self.validation_data is not None
+                    and self.evaluators is not None
+                )
+                expected = descent_fingerprint(
+                    self.task_type, config.coordinates,
+                    self.training_data.num_examples,
+                    resolve_residual_mode(self.residual_mode),
+                    config_key=config_key,
+                    validation_key=(
+                        self.evaluators.primary.name if has_validation
+                        else None
+                    ),
+                    locked=locked_coordinates,
+                    warm_start=initial_model is not None,
+                )
+                if resume_state.fingerprint != expected:
+                    raise CheckpointError(
+                        f"checkpoint fingerprint {resume_state.fingerprint} "
+                        f"does not match configuration {label!r} "
+                        f"({expected}); refusing to resume"
+                    )
+            # Completed means: covers THIS run's requested iterations (a
+            # raised descent_iterations resumes and runs the extra passes).
+            if (resume_state is not None
+                    and resume_state.iteration + 1 >= config.descent_iterations):
+                # This configuration already finished before the
+                # interruption: rebuild its result from the snapshot.
+                best = GameModel(dict(resume_state.best_models), self.task_type)
+                descent = DescentResult(
+                    best_model=best,
+                    last_model=GameModel(
+                        dict(resume_state.models), self.task_type
+                    ),
+                    best_metrics=dict(resume_state.best_metrics),
+                    history=list(resume_state.history),
+                )
+                self.telemetry.counter("estimator.configurations_resumed").inc()
+                self.logger.info(
+                    "fit-%s restored from completed checkpoint", label
+                )
+                results.append(
+                    GameResult(
+                        model=best,
+                        metrics=descent.best_metrics,
+                        configuration=config,
+                        descent=descent,
+                    )
+                )
+                continue
             with self.telemetry.span("estimator.fit", configuration=label), \
                     self.logger.timed(f"fit-{label}"):
                 descent = CoordinateDescent(
@@ -197,6 +304,10 @@ class GameEstimator:
                     initial_model=initial_model,
                     locked_coordinates=locked_coordinates,
                     checkpoint_fn=checkpoint_fn,
+                    checkpointer=checkpointer,
+                    resume_state=resume_state,
+                    max_quarantined=max_quarantined,
+                    config_key=config_key,
                 )
             self.telemetry.counter("estimator.configurations").inc()
             results.append(

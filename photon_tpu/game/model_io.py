@@ -62,7 +62,24 @@ def save_game_model(
     fmt: str = "avro",
 ) -> None:
     """``index_maps`` is keyed by feature-shard name (each coordinate stores
-    the map for its shard)."""
+    the map for its shard).
+
+    The export is ATOMIC: the whole directory is built in a hidden temp
+    sibling and renamed into place (photon_tpu.fault.atomic), so a kill
+    mid-export can never leave a torn model directory — readers see the
+    previous complete model or the new one, nothing in between."""
+    from photon_tpu.fault.atomic import atomic_dir
+
+    with atomic_dir(dir_path) as tmp:
+        _write_game_model(tmp, model, index_maps, fmt)
+
+
+def _write_game_model(
+    dir_path: str,
+    model: GameModel,
+    index_maps: Dict[str, IndexMap],
+    fmt: str = "avro",
+) -> None:
     os.makedirs(dir_path, exist_ok=True)
     meta = {"version": 1, "task_type": model.task_type, "coordinates": []}
     ext = "avro" if fmt == "avro" else "json"
@@ -97,6 +114,12 @@ def save_game_model(
             )
         else:
             raise TypeError(f"unknown coordinate model type {type(coord)!r}")
+    from photon_tpu.fault.injection import fault_point
+
+    # The mid-export window fault injection targets: coordinate files are
+    # written, metadata is not — an injected failure here must leave the
+    # previously-published model untouched (atomic_dir discards the temp).
+    fault_point("io:write", path=dir_path)
     with open(os.path.join(dir_path, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=1)
 

@@ -72,6 +72,7 @@ from photon_tpu.parallel.mesh import (
     mesh_shards,
     pad_to_multiple,
     reshard,
+    to_host,
 )
 from photon_tpu.telemetry import NULL_SESSION
 
@@ -146,7 +147,7 @@ def _neumaier_rows(scores: Array) -> tuple[Array, Array]:
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
 def _set_row_and_resum(
     scores: Array, total: Array, comp: Array, c, new_row: Array
-) -> tuple[Array, Array, Array]:
+) -> tuple[Array, Array, Array, Array]:
     """Write row ``c`` and refresh the compensated total in one program.
 
     The table and the old total/comp are donated: the update recycles their
@@ -154,11 +155,18 @@ def _set_row_and_resum(
     two ``[C, n]`` tables live.  ``total``/``comp`` are recomputed from the
     full table — never incrementally drifted — so compensation error cannot
     accumulate across descent iterations.
+
+    Non-finite guard: a row containing any NaN/Inf is REJECTED on device —
+    the previous row is kept, so one poisoned solve cannot contaminate the
+    compensated total (NaN + anything = NaN forever).  The returned ``ok``
+    scalar stays on device; the descent loop drains the flags once per
+    outer iteration and quarantines the offending coordinate.
     """
     del total, comp  # recomputed below; parameters exist to donate buffers
-    scores = scores.at[c].set(new_row)
+    ok = jnp.all(jnp.isfinite(new_row))
+    scores = scores.at[c].set(jnp.where(ok, new_row, scores[c]))
     new_total, new_comp = _neumaier_rows(scores)
-    return scores, new_total, new_comp
+    return scores, new_total, new_comp, ok
 
 
 @jax.jit
@@ -206,6 +214,9 @@ class _DeviceScoreTable:
             raise ValueError(f"duplicate coordinate names in {self.names}")
         self.mesh = mesh
         self.telemetry = telemetry or NULL_SESSION
+        # Device-resident ok-flags of recent row updates, drained (ONE tiny
+        # host sync) by poll_quarantined once per outer iteration.
+        self._pending_guard: list = []
         self.n = int(len(base_offset))
         self.n_pad = pad_to_multiple(self.n, mesh_shards(mesh))
         base = np.zeros(self.n_pad, np.float32)
@@ -285,9 +296,16 @@ class _DeviceScoreTable:
         if self._row_sharding is not None:
             new_row = reshard(new_row, self._row_sharding)
         with self.telemetry.span(f"{self._PATH}.update", coordinate=name):
-            self.scores, self.total, self.comp = _set_row_and_resum(
+            self.scores, self.total, self.comp, ok = _set_row_and_resum(
                 self.scores, self.total, self.comp, self._row[name], new_row
             )
+        # The ok flag stays a device scalar here (no sync in the hot loop);
+        # descent drains it via poll_quarantined at the iteration boundary.
+        # Bounded: callers that never poll (benches, direct engine use) cap
+        # the backlog instead of growing it per update.
+        self._pending_guard.append((name, ok))
+        if len(self._pending_guard) > 4096:
+            del self._pending_guard[:-4096]
         self.telemetry.counter(
             f"{self._PATH}.updates", coordinate=name
         ).inc()
@@ -296,6 +314,45 @@ class _DeviceScoreTable:
         """Coordinate ``name``'s current score row (device view, ``[n]`` —
         padding trimmed)."""
         return self.scores[self._row[name], : self.n]
+
+    def poll_quarantined(self) -> list:
+        """Names whose row updates were rejected (non-finite) since the
+        last poll.  ONE host sync of tiny bool scalars per outer iteration —
+        the quarantine accounting the budget check runs on."""
+        pending, self._pending_guard = self._pending_guard, []
+        # host-sync: draining the per-update ok flags — bool scalars, once
+        # per outer iteration, the sanctioned quarantine-accounting sync.
+        bad = [name for name, ok in pending if not bool(ok)]
+        for name in bad:
+            self.telemetry.counter(
+                f"{self._PATH}.nonfinite_rows", coordinate=name
+            ).inc()
+        return bad
+
+    def snapshot_rows(self) -> dict:
+        """All score rows as host float32 arrays ``{name: [n]}`` — the
+        checkpoint snapshot, fetched ONCE per outer iteration off the hot
+        path (to_host gathers across processes under multi-controller)."""
+        # host-sync: checkpoint snapshot — the sanctioned off-hot-path
+        # fetch of the score table.
+        table = to_host(self.scores)
+        self.telemetry.counter(
+            "descent.host_transfer_bytes", direction="d2h", path="checkpoint"
+        ).inc(table.nbytes)
+        return {
+            name: np.array(table[self._row[name], : self.n])
+            for name in self.names
+        }
+
+    def load_rows(self, rows: dict) -> None:
+        """Rebuild the device table from checkpointed rows (resume path):
+        one guarded update per coordinate, exactly the state an
+        uninterrupted run would hold after the same iterations."""
+        for name, row in rows.items():
+            if name in self._row:
+                # host-sync: resume-path upload of checkpointed HOST rows
+                # (asarray normalizes dtype; no device fetch happens here).
+                self.update(name, np.asarray(row, np.float32))
 
 
 class ResidualEngine(_DeviceScoreTable):
@@ -363,10 +420,14 @@ class HostResiduals:
         # host-sync: the escape hatch keeps ALL residual state on host.
         self.base = np.asarray(base_offset, np.float64)
         self.scores: dict = {}
+        self._pending_guard: list = []
         self.telemetry = telemetry or NULL_SESSION
 
     def update(self, name: str, new_scores) -> None:
-        """Store ``name``'s score vector on host (fetching it if needed)."""
+        """Store ``name``'s score vector on host (fetching it if needed).
+        Non-finite vectors are rejected — the previous iterate is kept and
+        the coordinate reported via :meth:`poll_quarantined`, mirroring the
+        device engine's guarded row writes."""
         # host-sync: the host escape hatch IS the host path — every update
         # fetches one score vector, counted below.
         host = np.asarray(new_scores, np.float64)
@@ -375,7 +436,10 @@ class HostResiduals:
                 f"score vector for {name!r} has shape {host.shape}, "
                 f"want {self.base.shape}"
             )
-        self.scores[name] = host
+        if not np.isfinite(host).all():
+            self._pending_guard.append(name)
+        else:
+            self.scores[name] = host
         # The fetch moved one f32 score vector device→host.
         self.telemetry.counter(
             "descent.host_transfer_bytes", direction="d2h", path="residuals"
@@ -393,3 +457,33 @@ class HostResiduals:
             "descent.host_transfer_bytes", direction="h2d", path="residuals"
         ).inc(out.nbytes)
         return out
+
+    def poll_quarantined(self) -> list:
+        """Names whose updates were rejected (non-finite) since last poll —
+        same contract as the device engines' guarded rows."""
+        bad, self._pending_guard = self._pending_guard, []
+        for name in bad:
+            self.telemetry.counter(
+                "residuals.nonfinite_rows", coordinate=name
+            ).inc()
+        return bad
+
+    def snapshot_rows(self) -> dict:
+        """All score rows (host float64 copies) — the checkpoint snapshot.
+        Saved at the path's native dtype so a resumed host-mode fit is
+        bit-identical to an uninterrupted one."""
+        return {name: s.copy() for name, s in self.scores.items()}
+
+    def load_rows(self, rows: dict) -> None:
+        """Restore checkpointed rows (resume path).  Stored directly —
+        checkpointed rows never crossed the device boundary, so routing
+        them through update() would count phantom d2h transfer bytes."""
+        for name, row in rows.items():
+            # host-sync: the host engine restores HOST float64 rows.
+            host = np.asarray(row, np.float64)
+            if host.shape != self.base.shape:
+                raise ValueError(
+                    f"checkpointed row for {name!r} has shape {host.shape}, "
+                    f"want {self.base.shape}"
+                )
+            self.scores[name] = host

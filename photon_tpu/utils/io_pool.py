@@ -51,10 +51,18 @@ def map_ordered(
     items: Sequence[T] | Iterable[T],
     workers: Optional[int] = None,
     window: Optional[int] = None,
+    retry_site: Optional[str] = None,
+    telemetry=None,
 ) -> Iterator[R]:
     """``map(fn, items)`` with up to ``workers`` concurrent calls, results
     yielded strictly in input order, and at most ``window`` calls in flight
     (default ``2 * workers``) so memory stays bounded.
+
+    With ``retry_site`` set, each per-item call retries transient IO
+    failures (OSError) with jittered exponential backoff on its worker
+    thread (``photon_tpu.fault.retry``), counted as
+    ``io.retries{site=retry_site}`` on ``telemetry`` — a flaky part file
+    costs backoff, not the whole pooled read.
 
     With ``workers <= 1`` (or a single item) this degrades to a plain lazy
     map — no threads, no queueing.  An exception from any call is re-raised
@@ -69,6 +77,16 @@ def map_ordered(
     window holds summaries, not payloads.
     """
     items = list(items)
+    if retry_site is not None:
+        from photon_tpu.fault.retry import retry_call
+
+        inner = fn
+
+        def fn(item):
+            return retry_call(
+                lambda: inner(item), site=retry_site, telemetry=telemetry
+            )
+
     if workers is None:
         workers = io_threads()
     if workers <= 1 or len(items) <= 1:

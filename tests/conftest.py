@@ -26,10 +26,65 @@ jax.config.update("jax_enable_x64", False)
 # optimizer while_loops and GAME programs that are identical run-to-run.
 # The cache dir is repo-local (gitignored) so repeated suite runs in one
 # workspace — including the driver's — hit warm.
-_cache_dir = os.path.abspath(os.environ.get(
-    "JAX_TEST_COMPILATION_CACHE",
-    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_test_cache"),
-))
+#
+# The cache is KEYED by jaxlib version + a digest of the photon_tpu
+# sources: stale cached programs from an older repo revision once
+# segfaulted runs when a donated-buffer program's aliasing metadata no
+# longer matched the cache entry loaded for it.  A source or jaxlib change
+# now lands in a FRESH cache subdirectory (stale siblings are pruned), so
+# that class of corruption cannot recur; unchanged sources keep hitting
+# the warm cache.  JAX_TEST_COMPILATION_CACHE overrides the location
+# verbatim (no keying) for operators managing their own cache.
+
+
+def _repo_state_digest() -> str:
+    import hashlib
+
+    root = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), os.pardir, "photon_tpu")
+    )
+    h = hashlib.sha256()
+    h.update(jax.__version__.encode())
+    try:
+        import jaxlib
+
+        h.update(jaxlib.__version__.encode())
+    except Exception:
+        pass
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            h.update(os.path.relpath(path, root).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:12]
+
+
+_cache_override = os.environ.get("JAX_TEST_COMPILATION_CACHE")
+if _cache_override:
+    _cache_dir = os.path.abspath(_cache_override)
+else:
+    _cache_root = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), os.pardir, ".jax_test_cache")
+    )
+    _cache_key = _repo_state_digest()
+    _cache_dir = os.path.join(_cache_root, _cache_key)
+    # Prune stale entries (old keyed subdirs AND pre-keying flat cache
+    # files) so the workspace cache never grows one dead copy per source
+    # change — and a stale program can never be picked up again.
+    if os.path.isdir(_cache_root):
+        import shutil
+
+        for entry in os.listdir(_cache_root):
+            if entry != _cache_key:
+                full = os.path.join(_cache_root, entry)
+                try:
+                    shutil.rmtree(full) if os.path.isdir(full) else os.remove(full)
+                except OSError:
+                    pass
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 # Threshold 0: the suite compiles hundreds of SMALL programs (0.05-0.2s
 # each) across ~220 tests; caching them all is worth far more than the
