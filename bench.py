@@ -634,14 +634,18 @@ def _bench_validation() -> None:
 def _bench_recovery() -> None:
     """Checkpoint write/restore overhead micro-bench (``--mode recovery``).
 
-    Fits the shared synthetic GAME fixture three ways on one estimator:
-    plain (no checkpointing), with per-outer-iteration descent checkpoints
-    (``photon_tpu/fault/checkpoint.py`` — models + residual score rows +
-    best tracking, atomic publish), and resumed from the completed
-    checkpoint (pure load + rebuild, no solves).  Emits one JSON line whose
-    value is the mean checkpoint WRITE seconds per outer iteration — the
-    per-iteration insurance premium a preemptible run pays — with the
-    restore wall clock and the fit overhead in detail.
+    Fits the shared synthetic GAME fixture four ways on one estimator:
+    plain (no checkpointing), with SYNCHRONOUS per-outer-iteration descent
+    checkpoints (``--checkpoint-async off`` — the inline serialize + fsync
+    + rename the loop used to pay), with the ASYNC publisher (staging on
+    the loop, publish behind the next iteration's compute), and resumed
+    from the completed checkpoint (pure load + rebuild, no solves).  Emits
+    ``game_checkpoint_secs`` (mean loop-side write seconds per iteration,
+    sync mode — the insurance premium baseline) and
+    ``game_checkpoint_overhead_pct`` — the async fit's measured
+    per-iteration checkpoint premium as a percentage of the sync fit's
+    (the ISSUE 5 acceptance number: <= 20 means the publisher hides at
+    least 80% of the premium).
     """
     import shutil
     import tempfile
@@ -664,30 +668,56 @@ def _bench_recovery() -> None:
         estimator.fit([config])
         plain = time.perf_counter() - t0
 
-        ckpt_dir = os.path.join(tmp, "ckpt")
+        ckpt_sync = os.path.join(tmp, "ckpt-sync")
         t0 = time.perf_counter()
-        estimator.fit([config], checkpoint_dir=ckpt_dir)
-        with_ckpt = time.perf_counter() - t0
-        write_hist = session.histogram("checkpoint.write_seconds")
+        estimator.fit([config], checkpoint_dir=ckpt_sync,
+                      checkpoint_async="off")
+        with_sync = time.perf_counter() - t0
+        # Snapshot the mean NOW: the histogram is live on the shared
+        # session, and the async fit below observes its own near-zero
+        # loop-side write times into it (same reason saves is int()-ed).
+        sync_write_mean = float(
+            session.histogram("checkpoint.write_seconds").mean or 0.0
+        )
+        sync_writes = int(session.counter("checkpoint.saves").value)
+
+        ckpt_async = os.path.join(tmp, "ckpt-async")
+        t0 = time.perf_counter()
+        estimator.fit([config], checkpoint_dir=ckpt_async,
+                      checkpoint_async="on")
+        with_async = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        estimator.fit([config], checkpoint_dir=ckpt_dir, resume="auto")
+        estimator.fit([config], checkpoint_dir=ckpt_sync, resume="auto")
         restore = time.perf_counter() - t0
 
-        _emit("game_checkpoint_secs", write_hist.mean or 0.0, "s/iter", {
+        sync_premium = max(with_sync - plain, 0.0)
+        async_premium = max(with_async - plain, 0.0)
+        overhead_pct = (
+            100.0 * async_premium / sync_premium if sync_premium > 0 else 0.0
+        )
+        detail = {
             "rows": data.num_examples,
             "entities": n_entities,
             "coordinates": 3,
             "descent_iterations": iters,
             "plain_fit_seconds": round(plain, 4),
-            "checkpointed_fit_seconds": round(with_ckpt, 4),
-            "checkpoint_overhead_seconds": round(with_ckpt - plain, 4),
+            "sync_fit_seconds": round(with_sync, 4),
+            "async_fit_seconds": round(with_async, 4),
+            "sync_premium_seconds": round(sync_premium, 4),
+            "async_premium_seconds": round(async_premium, 4),
             "restore_seconds": round(restore, 4),
-            "checkpoint_writes": int(
-                session.counter("checkpoint.saves").value
+            "checkpoint_writes": sync_writes,
+            "publish_lag_mean_s": round(
+                session.histogram("checkpoint.publish_lag_s").mean or 0.0, 4
+            ),
+            "blocked_mean_s": round(
+                session.histogram("checkpoint.blocked_s").mean or 0.0, 4
             ),
             "platform": platform,
-        })
+        }
+        _emit("game_checkpoint_secs", sync_write_mean, "s/iter", detail)
+        _emit("game_checkpoint_overhead_pct", overhead_pct, "%", detail)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -45,6 +45,7 @@ from photon_tpu.core.optimizers.base import (
 )
 from photon_tpu.core.optimizers.lbfgs import _two_loop_direction
 from photon_tpu.data.batch import SparseBatch
+from photon_tpu.fault.injection import fault_point
 
 # Module-level jit: a per-call `jax.jit(...)` wrapper would carry a fresh
 # trace cache, re-tracing the two-loop recursion for every lambda in a
@@ -356,6 +357,10 @@ def streaming_lbfgs(
     objective: StreamingObjective,
     w0: Array,
     config: OptimizerConfig = OptimizerConfig(),
+    checkpointer=None,
+    checkpoint_every: int = 1,
+    resume_state=None,
+    fingerprint: Optional[dict] = None,
 ) -> OptimizerResult:
     """Host-loop L-BFGS for datasets that only fit on the host.
 
@@ -364,32 +369,167 @@ def streaming_lbfgs(
     evaluation is a streamed pass, so the outer loop lives in Python — the
     shape of the reference's driver loop, where every evaluation is a
     cluster scan (SURVEY.md §3.4).
+
+    ``checkpointer`` (a :class:`photon_tpu.fault.checkpoint.
+    StreamCheckpointer`) snapshots the COMPLETE loop state — iterate,
+    gradient, curvature-pair ring buffer, convergence history, and the
+    host scalars — every ``checkpoint_every`` iterations plus a final
+    ``completed`` snapshot, published through the same atomic protocol and
+    async publisher as the GAME descent checkpoints.  ``resume_state``
+    restores a snapshot: a resumed fit continues EXACTLY where the
+    interrupted one stopped (every streamed pass already run is skipped,
+    including the initial evaluation), and a completed snapshot rebuilds
+    the result without streaming a single pass.  ``fingerprint`` is
+    stamped into each snapshot; compatibility checks are the caller's.
     """
     m = config.history_length
     d = w0.shape[0]
     dtype = w0.dtype
     direction = _jitted_direction
 
-    w = w0
-    f, g = objective.value_and_grad(w)
-    f, gnorm0 = float(f), float(jnp.linalg.norm(g))
-    hv, hg, hvalid = init_history(
-        config.max_iterations, jnp.asarray(f), jnp.asarray(gnorm0)
+    if resume_state is not None and resume_state.completed:
+        if (_stream_converged(resume_state.reason)
+                or resume_state.reason == ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+                or resume_state.iteration >= config.max_iterations):
+            # The fit genuinely finished (converged, line search dead, or
+            # this run's budget already spent): rebuild the result from the
+            # final snapshot — zero streamed passes.  A fit that stopped on
+            # MAX_ITERATIONS resumed with a LARGER budget falls through and
+            # continues — same rule as descent checkpoints (the iteration
+            # budget is deliberately outside the fingerprint).
+            return _result_from_stream_state(resume_state)
+
+    if resume_state is not None:
+        arrays, scalars = resume_state.arrays, resume_state.scalars
+        w = jnp.asarray(arrays["w"], dtype)
+        g = jnp.asarray(arrays["g"], dtype)
+        S = jnp.asarray(arrays["S"], dtype)
+        Y = jnp.asarray(arrays["Y"], dtype)
+        rho = jnp.asarray(arrays["rho"], dtype)
+        hv, hg, hvalid = (
+            np.array(arrays["hv"]), np.array(arrays["hg"]),
+            np.array(arrays["hvalid"]),
+        )
+        f, gnorm0 = float(scalars["f"]), float(scalars["gnorm0"])
+        num_pairs = int(scalars["num_pairs"])
+        insert_pos = int(scalars["insert_pos"])
+        gamma = float(scalars["gamma"])
+        it = resume_state.iteration
+        reason = ConvergenceReason.NOT_CONVERGED
+    else:
+        w = w0
+        f, g = objective.value_and_grad(w)
+        f, gnorm0 = float(f), float(jnp.linalg.norm(g))
+        hv, hg, hvalid = init_history(
+            config.max_iterations, jnp.asarray(f), jnp.asarray(gnorm0)
+        )
+        # np.array (copy): asarray of a jax array is a read-only view.
+        hv, hg, hvalid = np.array(hv), np.array(hg), np.array(hvalid)
+
+        S = jnp.zeros((m, d), dtype)
+        Y = jnp.zeros((m, d), dtype)
+        rho = jnp.zeros(m, dtype)
+        num_pairs, insert_pos, gamma = 0, 0, 1.0
+        reason = ConvergenceReason.NOT_CONVERGED
+        it = 0
+
+        if gnorm0 == 0.0:
+            reason = ConvergenceReason.GRADIENT_TOLERANCE
+
+    def snapshot(completed: bool):
+        from photon_tpu.fault.checkpoint import StreamState
+
+        return StreamState(
+            iteration=it,
+            # The history buffers are the loop's MUTABLE scratch — copy at
+            # snapshot time so the async publisher serializes a frozen
+            # view, not whatever the next iteration wrote into them.
+            arrays={
+                "w": w, "g": g, "S": S, "Y": Y, "rho": rho,
+                "hv": hv.copy(), "hg": hg.copy(), "hvalid": hvalid.copy(),
+            },
+            scalars={
+                "f": f, "gnorm0": gnorm0, "num_pairs": num_pairs,
+                "insert_pos": insert_pos, "gamma": gamma,
+            },
+            completed=completed,
+            reason=int(reason),
+            fingerprint=fingerprint or {},
+        )
+
+    try:
+        while reason == ConvergenceReason.NOT_CONVERGED:
+            # The streamed-GLM preemption site: a killed fit restarts from
+            # the last published mid-fit snapshot (the descent:kill analog).
+            fault_point("stream:kill", iteration=it)
+            reason, w, f, g, S, Y, rho, num_pairs, insert_pos, gamma, it = (
+                _stream_lbfgs_step(
+                    objective, config, direction, m, dtype, reason, w, f, g,
+                    gnorm0, S, Y, rho, num_pairs, insert_pos, gamma, it,
+                    hv, hg, hvalid,
+                )
+            )
+            if (checkpointer is not None and checkpoint_every
+                    and reason == ConvergenceReason.NOT_CONVERGED
+                    and it % checkpoint_every == 0):
+                checkpointer.save(snapshot(completed=False))
+    except BaseException:
+        if checkpointer is not None:
+            checkpointer.drain(reraise=False)
+        raise
+    if checkpointer is not None:
+        # Final snapshot: resume rebuilds the finished result without a
+        # single streamed pass; the drain is the final-iteration barrier.
+        checkpointer.save(snapshot(completed=True))
+        checkpointer.drain()
+
+    return OptimizerResult(
+        w=w,
+        value=jnp.asarray(f),
+        grad_norm=jnp.linalg.norm(g),
+        iterations=jnp.asarray(it, jnp.int32),
+        converged=jnp.asarray(_stream_converged(reason)),
+        reason=jnp.asarray(reason, jnp.int32),
+        history_value=jnp.asarray(hv),
+        history_grad_norm=jnp.asarray(hg),
+        history_valid=jnp.asarray(hvalid),
     )
-    # np.array (copy): asarray of a jax array is a read-only view.
-    hv, hg, hvalid = np.array(hv), np.array(hg), np.array(hvalid)
 
-    S = jnp.zeros((m, d), dtype)
-    Y = jnp.zeros((m, d), dtype)
-    rho = jnp.zeros(m, dtype)
-    num_pairs, insert_pos, gamma = 0, 0, 1.0
-    reason = ConvergenceReason.NOT_CONVERGED
-    it = 0
 
-    if gnorm0 == 0.0:
-        reason = ConvergenceReason.GRADIENT_TOLERANCE
+def _stream_converged(reason) -> bool:
+    """The ONE definition of 'this streamed fit converged' — shared by the
+    live loop's result and the completed-checkpoint rebuild, so the two can
+    never drift apart on what counts as converged."""
+    return reason in (
+        ConvergenceReason.GRADIENT_TOLERANCE,
+        ConvergenceReason.FUNCTION_VALUES_TOLERANCE,
+    )
 
-    while reason == ConvergenceReason.NOT_CONVERGED:
+
+def _result_from_stream_state(state) -> OptimizerResult:
+    """OptimizerResult rebuilt from a ``completed`` stream snapshot."""
+    reason = int(state.reason)
+    g = np.asarray(state.arrays["g"])
+    return OptimizerResult(
+        w=jnp.asarray(state.arrays["w"]),
+        value=jnp.asarray(float(state.scalars["f"])),
+        grad_norm=jnp.asarray(float(np.linalg.norm(g))),
+        iterations=jnp.asarray(state.iteration, jnp.int32),
+        converged=jnp.asarray(_stream_converged(reason)),
+        reason=jnp.asarray(reason, jnp.int32),
+        history_value=jnp.asarray(state.arrays["hv"]),
+        history_grad_norm=jnp.asarray(state.arrays["hg"]),
+        history_valid=jnp.asarray(state.arrays["hvalid"]),
+    )
+
+
+def _stream_lbfgs_step(
+    objective, config, direction, m, dtype, reason, w, f, g, gnorm0,
+    S, Y, rho, num_pairs, insert_pos, gamma, it, hv, hg, hvalid,
+):
+    """One host-loop L-BFGS iteration (direction, line search, pair
+    update, convergence check); history buffers mutate in place."""
+    while True:  # single pass; structured as a loop for early breaks
         dvec = direction(
             g, S, Y, rho,
             jnp.asarray(num_pairs, jnp.int32),
@@ -438,23 +578,9 @@ def streaming_lbfgs(
         elif it >= config.max_iterations:
             reason = ConvergenceReason.MAX_ITERATIONS
         w, f, g = w_try, f_try, g_try
+        break
 
-    return OptimizerResult(
-        w=w,
-        value=jnp.asarray(f),
-        grad_norm=jnp.linalg.norm(g),
-        iterations=jnp.asarray(it, jnp.int32),
-        converged=jnp.asarray(
-            reason in (
-                ConvergenceReason.GRADIENT_TOLERANCE,
-                ConvergenceReason.FUNCTION_VALUES_TOLERANCE,
-            )
-        ),
-        reason=jnp.asarray(reason, jnp.int32),
-        history_value=jnp.asarray(hv),
-        history_grad_norm=jnp.asarray(hg),
-        history_valid=jnp.asarray(hvalid),
-    )
+    return reason, w, f, g, S, Y, rho, num_pairs, insert_pos, gamma, it
 
 
 def _scan_rows_nnz(path: str) -> tuple[int, int]:

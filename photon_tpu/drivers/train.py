@@ -75,6 +75,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --stream: known feature dimension (e.g. from "
                    "a feature-indexing run) — skips the full metadata "
                    "parse in favor of a cheap row/nnz scan")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="with --stream: preemption-safe mid-fit L-BFGS "
+                   "checkpoints — every --checkpoint-every iterations the "
+                   "full loop state (iterate, gradient, curvature pairs, "
+                   "history) is published atomically under this directory "
+                   "(one lam-NNN chain per sweep weight; rank 0 writes)")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="with --stream + --checkpoint-dir: snapshot every "
+                   "N L-BFGS iterations (each iteration is >= one full "
+                   "streamed pass, so the default checkpoints every "
+                   "iteration)")
+    p.add_argument("--checkpoint-async", default=None, choices=("on", "off"),
+                   help="publish streamed checkpoints from a background "
+                   "thread (default on, or PHOTON_CHECKPOINT_ASYNC); "
+                   "'off' restores inline synchronous writes")
+    p.add_argument("--resume", default=None, choices=("auto", "latest"),
+                   help="with --stream + --checkpoint-dir: restore the "
+                   "sweep from its checkpoints — completed weights are "
+                   "rebuilt from their final snapshots without streaming "
+                   "a pass, the interrupted weight continues mid-fit; "
+                   "'latest' requires a published checkpoint, 'auto' "
+                   "starts fresh when there is none")
     return p
 
 
@@ -108,6 +130,23 @@ def _run_streaming(args: argparse.Namespace, logger, session) -> dict:
     if args.optimizer != "lbfgs" or args.reg_type in ("l1", "elastic_net"):
         raise ValueError("--stream supports the lbfgs optimizer with l2/none "
                          "regularization")
+    from photon_tpu.fault.checkpoint import (
+        CheckpointError,
+        StreamCheckpointer,
+        has_published_checkpoint,
+    )
+
+    if args.resume and not args.checkpoint_dir:
+        raise ValueError("--resume needs --checkpoint-dir")
+    if args.resume == "latest" and not has_published_checkpoint(
+        args.checkpoint_dir
+    ):
+        # Same strictness rule as the GAME driver: 'latest' means a
+        # PUBLISHED checkpoint, not .tmp debris from a pre-publish kill.
+        raise ValueError(
+            f"--resume latest: no published checkpoint under "
+            f"{args.checkpoint_dir!r}"
+        )
 
     if os.path.isdir(args.input):
         files = sorted(
@@ -225,15 +264,62 @@ def _run_streaming(args: argparse.Namespace, logger, session) -> dict:
 
     sweep = []
     w_start = jnp.zeros(source.dim, jnp.float32)
-    for lam in common.parse_weights_list(args.reg_weights):
+    for i, lam in enumerate(common.parse_weights_list(args.reg_weights)):
         reg = RegularizationContext(args.reg_type, lam, args.elastic_net_alpha)
         objective = StreamingObjective(
             GlmObjective.create(args.task, reg), source.chunk_iter_factory,
             all_reduce=all_reduce,
         )
+        # Mid-fit checkpointing: one chain per sweep weight, published
+        # through the shared (async-capable) checkpoint publisher.  The
+        # fingerprint pins what makes a snapshot THIS fit's state — the
+        # iteration budget is deliberately excluded (resuming with more
+        # iterations continues the fit, same rule as descent checkpoints).
+        checkpointer = resume_state = None
+        fingerprint = {
+            "kind": StreamCheckpointer.KIND,
+            "task": args.task,
+            "reg_type": args.reg_type,
+            "lambda": lam,
+            "alpha": args.elastic_net_alpha,
+            "dim": int(source.dim),
+            "num_examples": int(source.num_examples),
+            "intercept": bool(args.intercept),
+            "warm_start": bool(args.sweep_warm_start),
+            # Optimizer state-shape/semantics: the snapshot's S/Y/rho ring
+            # buffers are sized by history_length, and tolerance changes
+            # what "converged" means — a resume across either must refuse
+            # loudly, not continue with mismatched curvature state.
+            "history_length": int(opt_config.history_length),
+            "tolerance": float(opt_config.tolerance),
+        }
+        if args.checkpoint_dir:
+            checkpointer = StreamCheckpointer(
+                os.path.join(args.checkpoint_dir, f"lam-{i:03d}"),
+                telemetry=session, logger=logger,
+                async_publish=args.checkpoint_async,
+            )
+            if args.resume:
+                # Per-weight resume is auto-style: weights the interrupted
+                # run never reached have no chain and start fresh (the
+                # 'latest' strictness was enforced up front).
+                resume_state = checkpointer.load("auto")
+                if (resume_state is not None
+                        and resume_state.fingerprint != fingerprint):
+                    raise CheckpointError(
+                        f"checkpoint fingerprint {resume_state.fingerprint} "
+                        f"does not match lambda={lam:g} ({fingerprint}); "
+                        "refusing to resume"
+                    )
         with logger.timed(f"train-lambda-{lam}"):
             t0 = time.monotonic()
-            result = streaming_lbfgs(objective, w_start, opt_config)
+            result = streaming_lbfgs(
+                objective, w_start, opt_config,
+                checkpointer=checkpointer,
+                checkpoint_every=max(1, args.checkpoint_every),
+                resume_state=resume_state,
+                fingerprint=fingerprint,
+            )
             jax.block_until_ready(result.w)
             wall = time.monotonic() - t0
         if args.sweep_warm_start:
@@ -279,6 +365,14 @@ def run(args: argparse.Namespace) -> dict:
 
     logger = PhotonLogger("photon_tpu.train", args.log_file)
     with common.telemetry_run(args, "train", logger) as session:
+        if not getattr(args, "stream", False) and (
+            args.checkpoint_dir or args.resume
+        ):
+            raise ValueError(
+                "--checkpoint-dir/--resume apply to --stream training "
+                "(the resident-data sweep re-fits in seconds; mid-fit "
+                "checkpoints exist for streamed passes that cost minutes)"
+            )
         if getattr(args, "stream", False):
             return _run_streaming(args, logger, session)
         if distributed:

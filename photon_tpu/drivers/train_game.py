@@ -124,6 +124,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "published atomically under this directory (one "
                    "subdirectory per sweep entry; rank 0 writes under "
                    "multi-controller)")
+    p.add_argument("--checkpoint-async", default=None, choices=("on", "off"),
+                   help="publish descent checkpoints from a background "
+                   "thread (default on, or PHOTON_CHECKPOINT_ASYNC): the "
+                   "loop stages the d2h copies (copy_to_host_async) and "
+                   "the serialize+fsync+rename runs behind the next "
+                   "iteration's compute; LATEST may lag the loop by one "
+                   "iteration.  'off' restores inline synchronous writes")
     p.add_argument("--resume", default=None, metavar="auto|latest|PATH",
                    help="restore a descent mid-sweep from --checkpoint-dir: "
                    "'auto' resumes whatever is checkpointed (fresh start "
@@ -363,15 +370,10 @@ def parse_bags_and_id_columns(args) -> tuple[dict, list]:
 
 def _has_published_checkpoint(checkpoint_dir) -> bool:
     """True when any descent checkpoint chain under ``checkpoint_dir`` has
-    a published version (its LATEST pointer exists)."""
-    from photon_tpu.fault.checkpoint import LATEST_NAME
+    a published version (shared strictness rule — fault.checkpoint)."""
+    from photon_tpu.fault.checkpoint import has_published_checkpoint
 
-    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
-        return False
-    for _dirpath, _dirnames, filenames in os.walk(checkpoint_dir):
-        if LATEST_NAME in filenames:
-            return True
-    return False
+    return has_published_checkpoint(checkpoint_dir)
 
 
 def run(args: argparse.Namespace) -> dict:
@@ -585,6 +587,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
             checkpoint_fn=checkpoint_fn,
             checkpoint_dir=ckpt_dir, resume=resume,
             max_quarantined=max_quarantined,
+            checkpoint_async=args.checkpoint_async,
         )[0]
         results.append(result)
         if (args.checkpoint or args.save_all_models) and is_primary:

@@ -29,9 +29,14 @@ from photon_tpu.fault.atomic import (  # noqa: F401
     write_manifest,
 )
 from photon_tpu.fault.checkpoint import (  # noqa: F401
+    AsyncPublisher,
     CheckpointError,
     DescentCheckpointer,
     DescentState,
+    StreamCheckpointer,
+    StreamState,
+    has_published_checkpoint,
+    resolve_checkpoint_async,
 )
 from photon_tpu.fault.injection import (  # noqa: F401
     FaultPlan,

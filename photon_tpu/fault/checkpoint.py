@@ -21,6 +21,24 @@ their native dtype: f32 for the device engine, f64 for the host escape
 hatch).  Under multi-controller runs every rank LOADS the checkpoint (the
 directory must be on storage all ranks can read) but only rank 0 WRITES —
 the same primary-writes rule the drivers use for models and reports.
+
+Async publishing (``PHOTON_CHECKPOINT_ASYNC`` / ``--checkpoint-async``,
+default on): the per-iteration snapshot is split into a cheap STAGING step
+on the descent thread — ``copy_to_host_async()`` starts the d2h copies of
+every score row and model table together, then gathers them (the transfers
+overlap in flight instead of fetching serially) — and the expensive
+serialize + fsync + atomic-rename publish, which runs on a dedicated
+publisher thread with bounded depth 1.  The training loop blocks only when
+the PREVIOUS publish is still in flight (``checkpoint.blocked_s``); a
+publish failure is re-raised at the next save (or the final drain) — never
+swallowed; and the final iteration drains the publisher before the fit
+returns, so a completed run always ends with its last checkpoint published.
+Durability window: under async publishing ``LATEST`` may lag the training
+loop by one iteration — a kill can lose at most the single snapshot that
+was still in flight (the previous published checkpoint stays intact; the
+same atomic temp+fsync+rename protocol runs on the publisher thread, and
+the ``checkpoint:stage`` / ``checkpoint:write`` fault sites keep firing
+inside its staging and torn-write windows).
 """
 
 from __future__ import annotations
@@ -29,6 +47,7 @@ import dataclasses
 import json
 import os
 import shutil
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -51,6 +70,172 @@ LATEST_NAME = "LATEST"
 
 class CheckpointError(RuntimeError):
     """A checkpoint could not be loaded (missing, corrupt, or mismatched)."""
+
+
+def resolve_checkpoint_async(mode=None) -> bool:
+    """Resolve the checkpoint-publishing mode: True = async publisher.
+
+    Precedence: explicit ``mode`` (driver flag / bool) over the
+    ``PHOTON_CHECKPOINT_ASYNC`` env var over the default (``on``): the
+    async publisher is the steady state, synchronous publishing is the
+    escape hatch (``--checkpoint-async off``) for storage that misbehaves
+    under concurrent writers."""
+    if isinstance(mode, bool):
+        return mode
+    resolved = (
+        (mode or "").strip().lower()
+        or os.environ.get("PHOTON_CHECKPOINT_ASYNC", "").strip().lower()
+        or "on"
+    )
+    if resolved not in ("on", "off"):
+        raise ValueError(
+            f"checkpoint-async must be 'on' or 'off', got {resolved!r}"
+        )
+    return resolved == "on"
+
+
+def has_published_checkpoint(checkpoint_dir: Optional[str]) -> bool:
+    """True when any checkpoint chain under ``checkpoint_dir`` has a
+    PUBLISHED version (a LATEST pointer exists) — .tmp-* debris from a run
+    killed before its first publish does not count."""
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return False
+    for _dirpath, _dirnames, filenames in os.walk(checkpoint_dir):
+        if LATEST_NAME in filenames:
+            return True
+    return False
+
+
+def stage_to_host(arrays: Dict[str, object], telemetry=None) -> Dict[str, np.ndarray]:
+    """Two-pass d2h staging of a checkpoint's array dict.
+
+    First pass starts ``copy_to_host_async()`` on every device leaf — all
+    the transfers go in flight together; second pass gathers them into
+    numpy (each gather blocks only on a copy that is already running).
+    Host leaves pass straight through.  The gathered bytes are counted as
+    ``descent.host_transfer_bytes{path=checkpoint}`` — the sanctioned
+    off-hot-path fetch."""
+    import jax
+
+    for value in arrays.values():
+        if isinstance(value, jax.Array) and value.is_fully_addressable:
+            try:
+                value.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # backends without async d2h fall back to the gather
+    staged: Dict[str, np.ndarray] = {}
+    d2h_bytes = 0
+    for key, value in arrays.items():
+        if isinstance(value, jax.Array):
+            from photon_tpu.parallel.mesh import to_host
+
+            # host-sync: checkpoint staging — the async copies above put
+            # these transfers in flight; this gather is the sanctioned
+            # once-per-iteration off-hot-path fetch.
+            host = to_host(value)
+            d2h_bytes += host.nbytes
+        else:
+            # host-sync: host leaves (host-engine rows, key vocabularies)
+            # normalize through numpy without touching a device.
+            host = np.asarray(value)
+        staged[key] = host
+    if telemetry is not None and d2h_bytes:
+        telemetry.counter(
+            "descent.host_transfer_bytes", direction="d2h", path="checkpoint"
+        ).inc(d2h_bytes)
+    return staged
+
+
+class AsyncPublisher:
+    """Dedicated checkpoint-publisher thread with bounded depth 1.
+
+    ``submit(fn)`` first waits out any in-flight publish (the wait is the
+    ONLY place the training loop can block on checkpoint IO —
+    ``checkpoint.blocked_s`` observes it) and re-raises a previous publish
+    failure at the submission site: a failed publish surfaces on the next
+    iteration, never silently.  ``drain()`` is the final-iteration barrier —
+    it waits for the in-flight publish, stops the thread, and raises any
+    pending failure.  ``checkpoint.publish_lag_s`` observes enqueue→landed
+    latency per publish."""
+
+    def __init__(self, telemetry=None, name: str = "checkpoint-publisher"):
+        self.telemetry = telemetry or NULL_SESSION
+        self._name = name
+        self._job = None
+        self._job_ready = threading.Condition()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+
+    # -- worker --------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._job_ready:
+                while self._job is None and not self._stop:
+                    self._job_ready.wait()
+                if self._stop and self._job is None:
+                    return
+                fn, enqueued = self._job
+                self._job = None
+            try:
+                with self.telemetry.span("checkpoint.publish"):
+                    fn()
+            except BaseException as e:  # surfaced at the next save/drain
+                self._error = e
+            finally:
+                self.telemetry.histogram("checkpoint.publish_lag_s").observe(
+                    time.monotonic() - enqueued
+                )
+                self._idle.set()
+
+    def _wait_idle(self) -> None:
+        t0 = time.monotonic()
+        self._idle.wait()
+        self.telemetry.histogram("checkpoint.blocked_s").observe(
+            time.monotonic() - t0
+        )
+
+    def _raise_pending(self) -> None:
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    # -- API -----------------------------------------------------------------
+    def submit(self, fn) -> None:
+        """Enqueue one publish; blocks while the previous one is in flight
+        (bounded depth 1) and re-raises its failure here."""
+        self._wait_idle()
+        self._raise_pending()
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._run, name=self._name, daemon=True
+            )
+            self._thread.start()
+        self._idle.clear()
+        with self._job_ready:
+            self._job = (fn, time.monotonic())
+            self._job_ready.notify()
+
+    def drain(self, reraise: bool = True) -> None:
+        """Wait out the in-flight publish and stop the thread.  With
+        ``reraise`` (the final-iteration barrier) a pending publish failure
+        propagates; ``reraise=False`` (error paths) preserves the caller's
+        original exception while still quiescing the publisher."""
+        self._idle.wait()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            with self._job_ready:
+                self._stop = True
+                self._job_ready.notify()
+            thread.join()
+        self._thread = None
+        if reraise:
+            self._raise_pending()
+        else:
+            self._error = None
 
 
 def descent_fingerprint(
@@ -96,7 +281,11 @@ def configuration_key(coordinate_configs: dict) -> str:
 @dataclasses.dataclass
 class DescentState:
     """One outer iteration's complete restart state (live model objects;
-    (de)serialization to arrays happens in the checkpointer)."""
+    (de)serialization to arrays happens in the checkpointer).
+
+    ``residual_rows`` may hold host numpy rows (the host engine, or a
+    pre-fetched sync snapshot) or DEVICE row handles (the async staging
+    path) — the checkpointer's :func:`stage_to_host` gathers either."""
 
     iteration: int              # last COMPLETED outer iteration
     num_iterations: int         # the run's target iteration count
@@ -120,27 +309,29 @@ class DescentState:
 
 def _models_to_arrays(prefix: str, models: Dict[str, object]):
     """(arrays, meta) for one model dict; array keys are
-    ``<prefix><i>__<field>`` (npz-safe, order = meta order)."""
+    ``<prefix><i>__<field>`` (npz-safe, order = meta order).  Device arrays
+    are returned AS DEVICE HANDLES — :func:`stage_to_host` fetches them in
+    one overlapped staging pass, not one blocking fetch per table."""
     from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
-    from photon_tpu.parallel.mesh import to_host
 
     arrays, meta = {}, []
     for i, (name, model) in enumerate(models.items()):
         key = f"{prefix}{i}__"
         if isinstance(model, FixedEffectModel):
             coeff = model.coefficients
-            arrays[key + "means"] = to_host(coeff.means)
+            arrays[key + "means"] = coeff.means
             if coeff.variances is not None:
-                arrays[key + "variances"] = to_host(coeff.variances)
+                arrays[key + "variances"] = coeff.variances
             meta.append({
                 "name": name, "kind": "fixed", "shard_name": model.shard_name,
                 "has_variances": coeff.variances is not None,
             })
         elif isinstance(model, RandomEffectModel):
-            arrays[key + "table"] = to_host(model.table)
+            arrays[key + "table"] = model.table
+            # host-sync: entity-key vocabularies already live on host.
             arrays[key + "keys"] = np.asarray(model.keys)
             if model.variances is not None:
-                arrays[key + "variances"] = to_host(model.variances)
+                arrays[key + "variances"] = model.variances
             meta.append({
                 "name": name, "kind": "random", "shard_name": model.shard_name,
                 "entity_column": model.entity_column,
@@ -172,6 +363,7 @@ def _models_from_arrays(prefix: str, meta: List[dict], arrays, task_type: str):
         else:
             models[m["name"]] = RandomEffectModel(
                 table=jnp.asarray(arrays[key + "table"]),
+                # host-sync: checkpointed key vocabularies are host data.
                 keys=np.asarray(arrays[key + "keys"]),
                 entity_column=m["entity_column"],
                 shard_name=m["shard_name"],
@@ -181,21 +373,33 @@ def _models_from_arrays(prefix: str, meta: List[dict], arrays, task_type: str):
     return models
 
 
-class DescentCheckpointer:
-    """Writes/reads versioned descent checkpoints under one directory.
+class CheckpointPublisherBase:
+    """Shared checkpoint publication machinery: versioned directories under
+    one root, the atomic temp+fsync+rename protocol with a manifest written
+    last, a LATEST pointer, keep-N pruning, rank-0-writes — and the sync or
+    async publish path.  :class:`DescentCheckpointer` (GAME descent state)
+    and :class:`StreamCheckpointer` (streamed-GLM L-BFGS state) both
+    publish through it.
 
     ``write`` defaults to ``jax.process_index() == 0`` at save time
     (rank-0-writes); every rank may load.  ``keep`` bounds on-disk versions
     (older checkpoints are pruned after a successful publish).
+    ``async_publish`` (default: :func:`resolve_checkpoint_async`) routes
+    publishes through a dedicated :class:`AsyncPublisher` thread.
     """
 
     def __init__(self, directory: str, telemetry=None, logger=None,
-                 keep: int = 2, write: Optional[bool] = None):
+                 keep: int = 2, write: Optional[bool] = None,
+                 async_publish=None):
         self.directory = directory
         self.telemetry = telemetry or NULL_SESSION
         self.logger = logger
         self.keep = max(1, keep)
         self._write = write
+        self.async_publish = resolve_checkpoint_async(async_publish)
+        self._publisher = (
+            AsyncPublisher(self.telemetry) if self.async_publish else None
+        )
 
     # -- helpers -------------------------------------------------------------
     def _should_write(self) -> bool:
@@ -219,64 +423,67 @@ class DescentCheckpointer:
         return path if os.path.isdir(path) else None
 
     # -- save ----------------------------------------------------------------
-    def save(self, state: DescentState) -> Optional[str]:
-        """Publish ``state`` atomically; returns the checkpoint path (None
-        on non-writing ranks).  Checkpoint IO retries like any other
-        guarded write; an exhausted retry raises — a run that cannot
-        checkpoint is a failed run, not a silently unprotected one."""
+    def save_arrays(self, iteration: int, arrays: Dict[str, object],
+                    payload: dict) -> Optional[str]:
+        """Stage + publish one checkpoint version; returns its final path
+        (None on non-writing ranks).
+
+        Staging (the overlapped d2h gather) always happens HERE, on the
+        calling thread — device buffers may be donated or mutated the
+        moment the training loop resumes, so the host copies must exist
+        before this returns.  The publish (serialize + fsync + rename +
+        prune) runs synchronously, or on the publisher thread when async:
+        the call then blocks only if the PREVIOUS publish is still in
+        flight, and a publish failure surfaces at the next save or the
+        final :meth:`drain` — never silently.  Checkpoint IO retries like
+        any other guarded write; an exhausted retry raises — a run that
+        cannot checkpoint is a failed run, not a silently unprotected one."""
         if not self._should_write():
             return None
         t0 = time.monotonic()
-        path = retry_call(
-            lambda: self._save_once(state), site="checkpoint:io",
-            telemetry=self.telemetry, logger=self.logger,
-        )
+        # The d2h-staging fault window: a kill here (or anywhere before the
+        # publish rename) leaves the previously published chain untouched.
+        fault_point("checkpoint:stage", iteration=iteration)
+        staged = stage_to_host(arrays, telemetry=self.telemetry)
+        final = os.path.join(self.directory, self._ckpt_name(iteration))
+
+        def publish() -> str:
+            return retry_call(
+                lambda: self._publish_once(final, staged, payload),
+                site="checkpoint:io",
+                telemetry=self.telemetry, logger=self.logger,
+            )
+
+        if self._publisher is None:
+            publish()
+        else:
+            self._publisher.submit(publish)
+        # In async mode this histogram observes the LOOP-SIDE cost (staging
+        # + any wait on the previous publish) — the per-iteration premium
+        # the descent actually pays; the publisher's own wall clock is
+        # checkpoint.publish_lag_s.
         self.telemetry.histogram("checkpoint.write_seconds").observe(
             time.monotonic() - t0
         )
         self.telemetry.counter("checkpoint.saves").inc()
         if self.logger is not None:
             self.logger.info(
-                "checkpoint: iteration %d -> %s", state.iteration, path
+                "checkpoint: iteration %d -> %s%s", iteration, final,
+                " (async publish)" if self._publisher is not None else "",
             )
-        return path
+        return final
 
-    def _save_once(self, state: DescentState) -> str:
-        final = os.path.join(self.directory, self._ckpt_name(state.iteration))
-        arrays, models_meta = _models_to_arrays("m", state.models)
-        # When the best model IS the current iterate (the common improving-
-        # run case), its coordinate models are the same objects as
-        # state.models' — store name references instead of fetching and
-        # hashing every table twice.
-        best_shared = sorted(
-            name for name, model in state.best_models.items()
-            if state.models.get(name) is model
-        )
-        best_arrays, best_meta = _models_to_arrays(
-            "b",
-            {
-                name: model for name, model in state.best_models.items()
-                if name not in set(best_shared)
-            },
-        )
-        arrays.update(best_arrays)
-        for j, (name, row) in enumerate(state.residual_rows.items()):
-            arrays[f"r{j}__row"] = np.asarray(row)
-        payload = {
-            "version": STATE_VERSION,
-            "iteration": state.iteration,
-            "num_iterations": state.num_iterations,
-            "task_type": state.task_type,
-            "models": models_meta,
-            "best_models": best_meta,
-            "best_shared": best_shared,
-            "best_metrics": state.best_metrics,
-            "best_iteration": state.best_iteration,
-            "history": state.history,
-            "residual_rows": list(state.residual_rows),
-            "quarantined": state.quarantined,
-            "fingerprint": state.fingerprint,
-        }
+    def drain(self, reraise: bool = True) -> None:
+        """Final-iteration barrier: wait for the in-flight async publish
+        (no-op in sync mode) and surface its failure.  ``reraise=False``
+        quiesces the publisher on error paths without masking the original
+        exception."""
+        if self._publisher is not None:
+            self._publisher.drain(reraise=reraise)
+
+    def _publish_once(self, final: str, arrays: Dict[str, np.ndarray],
+                      payload: dict) -> str:
+        iteration = int(payload.get("iteration", 0))
         with atomic_dir(final) as tmp:
             with open(os.path.join(tmp, "arrays.npz"), "wb") as f:
                 np.savez(f, **arrays)
@@ -285,9 +492,11 @@ class DescentCheckpointer:
             # The torn-write window fault injection aims at: payload files
             # exist, manifest/publish has not happened.  A kill here leaves
             # only an invisible .tmp dir — LATEST still names the previous
-            # complete checkpoint.
-            fault_point("checkpoint:write", iteration=state.iteration)
-            write_manifest(tmp, extra={"iteration": state.iteration})
+            # complete checkpoint.  The site fires on the publisher thread
+            # in async mode, so the atomicity tests exercise the real
+            # concurrent window.
+            fault_point("checkpoint:write", iteration=iteration)
+            write_manifest(tmp, extra={"iteration": iteration})
         atomic_write_bytes(
             os.path.join(self.directory, LATEST_NAME),
             os.path.basename(final).encode(),
@@ -318,24 +527,10 @@ class DescentCheckpointer:
                 )
 
     # -- load ----------------------------------------------------------------
-    def load(self, resume: str) -> Optional[DescentState]:
-        """Resolve ``resume`` and load: ``auto`` returns None when nothing
-        is checkpointed yet, ``latest`` requires a checkpoint, anything else
-        is an explicit checkpoint-version directory path."""
-        if resume in ("auto", "latest"):
-            path = self.latest_path()
-            if path is None:
-                if resume == "latest":
-                    raise CheckpointError(
-                        f"--resume latest: no checkpoint under {self.directory}"
-                    )
-                return None
-            return self.load_path(path)
-        return self.load_path(resume)
-
     @staticmethod
-    def load_path(path: str) -> DescentState:
-        """Load one checkpoint-version directory, verifying its manifest."""
+    def read_payload(path: str) -> tuple:
+        """(payload, arrays) of one checkpoint-version directory, manifest
+        verified first and the read retried like any guarded IO."""
         if not os.path.isdir(path):
             raise CheckpointError(f"no checkpoint directory at {path!r}")
         verify_manifest(path)
@@ -353,6 +548,83 @@ class DescentCheckpointer:
                 f"{path}: checkpoint version {payload.get('version')!r} "
                 f"!= supported {STATE_VERSION}"
             )
+        return payload, arrays
+
+    def resolve_resume(self, resume: str) -> Optional[str]:
+        """Resolve a ``resume`` spec to a checkpoint-version path: ``auto``
+        returns None when nothing is checkpointed yet, ``latest`` requires a
+        published checkpoint, anything else is an explicit path."""
+        if resume in ("auto", "latest"):
+            path = self.latest_path()
+            if path is None and resume == "latest":
+                raise CheckpointError(
+                    f"--resume latest: no checkpoint under {self.directory}"
+                )
+            return path
+        return resume
+
+
+class DescentCheckpointer(CheckpointPublisherBase):
+    """Versioned GAME-descent checkpoints (see module docstring): the
+    descent's full restart state serialized through the shared publisher."""
+
+    # -- save ----------------------------------------------------------------
+    def save(self, state: DescentState) -> Optional[str]:
+        """Stage + publish ``state``; returns the checkpoint path (None on
+        non-writing ranks).  See :meth:`CheckpointPublisherBase.save_arrays`
+        for the sync/async semantics."""
+        if not self._should_write():
+            return None
+        arrays, models_meta = _models_to_arrays("m", state.models)
+        # When the best model IS the current iterate (the common improving-
+        # run case), its coordinate models are the same objects as
+        # state.models' — store name references instead of fetching and
+        # hashing every table twice.
+        best_shared = sorted(
+            name for name, model in state.best_models.items()
+            if state.models.get(name) is model
+        )
+        best_arrays, best_meta = _models_to_arrays(
+            "b",
+            {
+                name: model for name, model in state.best_models.items()
+                if name not in set(best_shared)
+            },
+        )
+        arrays.update(best_arrays)
+        for j, (name, row) in enumerate(state.residual_rows.items()):
+            arrays[f"r{j}__row"] = row
+        payload = {
+            "version": STATE_VERSION,
+            "iteration": state.iteration,
+            "num_iterations": state.num_iterations,
+            "task_type": state.task_type,
+            "models": models_meta,
+            "best_models": best_meta,
+            "best_shared": best_shared,
+            "best_metrics": state.best_metrics,
+            "best_iteration": state.best_iteration,
+            "history": state.history,
+            "residual_rows": list(state.residual_rows),
+            "quarantined": state.quarantined,
+            "fingerprint": state.fingerprint,
+        }
+        return self.save_arrays(state.iteration, arrays, payload)
+
+    # -- load ----------------------------------------------------------------
+    def load(self, resume: str) -> Optional[DescentState]:
+        """Resolve ``resume`` and load: ``auto`` returns None when nothing
+        is checkpointed yet, ``latest`` requires a checkpoint, anything else
+        is an explicit checkpoint-version directory path."""
+        path = self.resolve_resume(resume)
+        if path is None:
+            return None
+        return self.load_path(path)
+
+    @staticmethod
+    def load_path(path: str) -> DescentState:
+        """Load one checkpoint-version directory, verifying its manifest."""
+        payload, arrays = CheckpointPublisherBase.read_payload(path)
         task = payload["task_type"]
         models = _models_from_arrays("m", payload["models"], arrays, task)
         best_models = _models_from_arrays(
@@ -382,5 +654,67 @@ class DescentCheckpointer:
                 for j, name in enumerate(payload["residual_rows"])
             },
             quarantined=int(payload.get("quarantined", 0)),
+            fingerprint=payload.get("fingerprint", {}),
+        )
+
+
+# -- streamed-GLM L-BFGS checkpoints ----------------------------------------
+
+
+@dataclasses.dataclass
+class StreamState:
+    """Mid-fit (or completed) streamed L-BFGS state: everything
+    :func:`photon_tpu.data.streaming.streaming_lbfgs` needs to continue a
+    fit exactly where it left off — iterate, gradient, curvature-pair ring
+    buffer, convergence history, and the host-loop scalars.  ``completed``
+    marks a final snapshot (the fit converged; resume rebuilds the result
+    without streaming a single pass)."""
+
+    iteration: int
+    arrays: Dict[str, np.ndarray]   # w, g, S, Y, rho, hv, hg, hvalid
+    scalars: dict                   # f, gnorm0, num_pairs, insert_pos, gamma
+    completed: bool
+    reason: int
+    fingerprint: dict
+
+
+class StreamCheckpointer(CheckpointPublisherBase):
+    """Streamed-GLM L-BFGS checkpoints through the same atomic protocol
+    and async publisher as the descent checkpoints (the ROADMAP's
+    streamed-GLM mid-fit edge).  One instance owns one lambda's chain."""
+
+    KIND = "stream-lbfgs"
+
+    def save(self, state: StreamState) -> Optional[str]:
+        if not self._should_write():
+            return None
+        payload = {
+            "version": STATE_VERSION,
+            "kind": self.KIND,
+            "iteration": state.iteration,
+            "scalars": state.scalars,
+            "completed": state.completed,
+            "reason": state.reason,
+            "arrays": sorted(state.arrays),
+            "fingerprint": state.fingerprint,
+        }
+        return self.save_arrays(state.iteration, dict(state.arrays), payload)
+
+    def load(self, resume: str) -> Optional[StreamState]:
+        path = self.resolve_resume(resume)
+        if path is None:
+            return None
+        payload, arrays = self.read_payload(path)
+        if payload.get("kind") != self.KIND:
+            raise CheckpointError(
+                f"{path}: not a streamed-GLM checkpoint "
+                f"(kind={payload.get('kind')!r})"
+            )
+        return StreamState(
+            iteration=int(payload["iteration"]),
+            arrays={k: arrays[k] for k in payload["arrays"]},
+            scalars=dict(payload["scalars"]),
+            completed=bool(payload.get("completed", False)),
+            reason=int(payload.get("reason", 0)),
             fingerprint=payload.get("fingerprint", {}),
         )

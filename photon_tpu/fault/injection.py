@@ -22,11 +22,18 @@ Sites and their actions:
 - ``descent:kill`` — raise :class:`InjectedKillError` at the top of a GAME
   outer iteration, simulating a preempted process between iterations.
   Params: ``iter`` (fire when the iteration counter equals this), ``times``
-  (default 1).
+  (default 1).  ``stream:kill`` is the streamed-GLM analog (top of an
+  L-BFGS host-loop iteration).
 - ``checkpoint:write`` — raise :class:`InjectedKillError` in the middle of
   a checkpoint write (after payload files, before the manifest/publish),
-  the torn-write window the atomic protocol must survive.  Params:
+  the torn-write window the atomic protocol must survive.  Under the async
+  publisher this site fires ON THE PUBLISHER THREAD and the failure
+  surfaces at the training loop's next save (or final drain).  Params:
   ``times`` (default 1), ``p``.
+- ``checkpoint:stage`` — raise :class:`InjectedKillError` at the start of a
+  checkpoint's d2h staging step (before anything is written), the other
+  async-publish kill window: the previously published checkpoint must stay
+  the loadable LATEST.  Params: ``iter``, ``times`` (default 1), ``p``.
 - ``solve:nan`` — corrupt a coordinate's solve output with NaNs (consumed
   via :func:`consume_nan_injection`, which returns True instead of
   raising).  Params: ``coord`` (coordinate name, or ``*`` for any),
@@ -213,9 +220,9 @@ def fault_point(site: str, **ctx) -> None:
     raises the site's error type when a rule fires.
 
     ``io:*`` and ``checkpoint:read`` sites raise :class:`InjectedIOError`
-    (retriable); ``*:kill`` and ``checkpoint:write`` raise
-    :class:`InjectedKillError` (fatal — the atomic-write/ checkpoint-resume
-    machinery, not a retry loop, must absorb these).
+    (retriable); ``*:kill``, ``checkpoint:write``, and ``checkpoint:stage``
+    raise :class:`InjectedKillError` (fatal — the atomic-write/
+    checkpoint-resume machinery, not a retry loop, must absorb these).
     """
     plan = active_plan()
     if plan is None:
@@ -224,7 +231,7 @@ def fault_point(site: str, **ctx) -> None:
     if rule is None:
         return
     scope, _, action = site.partition(":")
-    if action == "kill" or site == "checkpoint:write":
+    if action == "kill" or site in ("checkpoint:write", "checkpoint:stage"):
         raise InjectedKillError(f"injected kill at {site} ({ctx or rule.params})")
     raise InjectedIOError(f"injected IO fault at {site} ({ctx or rule.params})")
 

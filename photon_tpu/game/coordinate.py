@@ -88,6 +88,85 @@ def _gather_bucket_offsets(offsets: Array, row_index: Array, mask: Array) -> Arr
     return offsets[row_index] * mask
 
 
+@jax.jit
+def _accumulate_solve_stats(
+    acc: Array, entity_index: Array, num_entities, converged: Array,
+    iterations: Array, good: Array,
+) -> Array:
+    """Fold one bucket's solve results into the per-coordinate ``[4]``
+    int32 stats accumulator ``[entities, converged, iterations_max,
+    quarantined]`` — entirely on device, so a coordinate's train() emits NO
+    host sync of its own: the descent loop drains every coordinate's
+    accumulator (plus the score-table guard flags) in ONE ``device_get``
+    per outer iteration.  Padded entities (``entity_index >=
+    num_entities``) are masked out of every component."""
+    real = entity_index < num_entities
+    real_i = real.astype(jnp.int32)
+    return jnp.stack([
+        acc[0] + real_i.sum(),
+        acc[1] + (converged.astype(jnp.int32) * real_i).sum(),
+        jnp.maximum(
+            acc[2],
+            jnp.max(jnp.where(real, iterations.astype(jnp.int32), 0)),
+        ),
+        acc[3] + ((~good).astype(jnp.int32) * real_i).sum(),
+    ])
+
+
+@jax.jit
+def _count_quarantined(acc: Array, good: Array) -> Array:
+    """Add a non-finite-row count to the accumulator's quarantined slot
+    (the factored coordinate's materialized-table guard)."""
+    return acc.at[3].add((~good).astype(jnp.int32).sum())
+
+
+class DeferredSolveStats:
+    """A coordinate train()'s convergence stats as ONE device int32 vector.
+
+    The descent loop collects these per coordinate and drains them all in
+    a single host sync at the outer-iteration boundary
+    (``descent.host_syncs``); :meth:`resolve` turns the fetched vector into
+    the stats dict the telemetry/logging paths consume.  Direct callers
+    (tests, benches) can index it like the old dict — the first access
+    lazily fetches.  ``extra`` carries static host-side entries (e.g. the
+    factored coordinate's ``latent_iterations``)."""
+
+    KEYS = ("entities", "converged", "iterations_max", "quarantined")
+
+    def __init__(self, device: Array, extra: Optional[dict] = None):
+        self.device = device
+        self.extra = dict(extra or {})
+        self._resolved: Optional[dict] = None
+
+    def resolve(self, host_vec=None) -> dict:
+        """The stats dict; ``host_vec`` is the pre-fetched ``[4]`` vector
+        from the descent boundary drain (without it, direct callers pay
+        their own fetch here — off the descent hot loop)."""
+        if self._resolved is None:
+            if host_vec is None:
+                # host-sync: direct-caller fetch (tests/benches) — the
+                # descent loop always passes the batched host_vec instead.
+                host_vec = np.asarray(self.device)
+            stats = {k: int(host_vec[i]) for i, k in enumerate(self.KEYS)}
+            stats.update(self.extra)
+            self._resolved = stats
+        return self._resolved
+
+    def __getitem__(self, key):
+        return self.resolve()[key]
+
+    def get(self, key, default=None):
+        return self.resolve().get(key, default)
+
+    def __contains__(self, key):
+        return key in self.resolve()
+
+    def __str__(self):
+        return str(self.resolve()) if self._resolved is not None else (
+            f"DeferredSolveStats(pending, extra={self.extra})"
+        )
+
+
 def _bucket_offsets(device_data, i: int, bucket, offsets) -> Array:
     """Training offsets for bucket ``i``: a jitted device gather when the
     residual engine hands a device vector, the seed's host fancy-index +
@@ -191,6 +270,8 @@ def _random_score_device(coord, model) -> Array:
         entity_idx = put_sharded(
             pad_idx(entity_index_for(
                 coord.data.id_columns[coord.config.entity_column],
+                # host-sync: foreign-vocabulary key join (host keys; the
+                # warm-start path — not the descent steady state).
                 np.asarray(model.keys),
             )),
             coord.mesh,
@@ -608,6 +689,7 @@ class FixedEffectCoordinate:
         self.mesh = mesh
         self.device_data = device_data or FixedEffectDeviceData(data, config, mesh)
         self.dim = self.device_data.dim
+        # host-sync: one-time construction check of host-side factors.
         if normalization is not None and len(
             np.asarray(normalization.factors_or_ones(self.dim))
         ) != self.dim:
@@ -753,6 +835,7 @@ class RandomEffectCoordinate:
         aligned = np.zeros((self.dataset.num_entities + 1, self.dim), np.float32)
         src_idx = entity_index_for(self.dataset.keys, np.asarray(initial_model.keys))
         found = src_idx >= 0
+        # host-sync: same foreign warm start — the table fetch of the join.
         aligned[:-1][found] = to_host(initial_model.table)[src_idx[found]]
         return jnp.asarray(aligned)
 
@@ -771,8 +854,12 @@ class RandomEffectCoordinate:
         init_table = (
             None if initial_model is None else self._initial_table(initial_model)
         )
-        stats = {"entities": 0, "converged": 0, "iterations_max": 0,
-                 "quarantined": 0}
+        # Per-coordinate device stats accumulator: entities / converged /
+        # iterations_max / quarantined fold in per bucket ON DEVICE, and
+        # train() returns the handle — no host sync here at all.  The
+        # descent loop drains every coordinate's accumulator in its single
+        # per-iteration stats/quarantine sync (descent.host_syncs).
+        acc = jnp.zeros(4, jnp.int32)
         from photon_tpu.fault.injection import consume_nan_injection
         from photon_tpu.game.projection import (
             IndexMapBucketProjection,
@@ -780,12 +867,6 @@ class RandomEffectCoordinate:
         )
 
         inject_nan = consume_nan_injection(getattr(self, "fault_name", None))
-
-        # Per-bucket convergence results stay on device until all bucket
-        # solves have been DISPATCHED: the stats collection below is the one
-        # host sync of the whole train() call, so bucket i+1's solve is
-        # enqueued while bucket i still runs.
-        pending = []
         for i, bucket in enumerate(self.device_data.buckets):
             offsets_b = _bucket_offsets(self.device_data, i, bucket, offsets)
             batch = self.device_data.batch_for(i, offsets_b)
@@ -866,19 +947,10 @@ class RandomEffectCoordinate:
                     var_table = var_table.at[entity_idx].set(
                         jnp.where(good[:, None], proj.lift_variance(variances), 0.0)
                     )
-            pending.append(
-                (bucket.entity_index < num_entities, result.converged,
-                 result.iterations, good)
+            acc = _accumulate_solve_stats(
+                acc, entity_idx, num_entities, result.converged,
+                result.iterations, good,
             )
-        for real, converged, iterations, good in pending:
-            stats["entities"] += int(real.sum())
-            stats["converged"] += int(to_host(converged)[real].sum())
-            stats["quarantined"] += int((~to_host(good))[real].sum())
-            if real.any():
-                stats["iterations_max"] = max(
-                    stats["iterations_max"],
-                    int(to_host(iterations)[real].max()),
-                )
         model = RandomEffectModel(
             table=table[:num_entities],
             keys=self.dataset.keys,
@@ -887,7 +959,7 @@ class RandomEffectCoordinate:
             task_type=self.task_type,
             variances=None if var_table is None else var_table[:num_entities],
         )
-        return model, stats
+        return model, DeferredSolveStats(acc)
 
     def score(self, model: RandomEffectModel) -> np.ndarray:
         return model.score(self.data)
@@ -1019,17 +1091,27 @@ class FactoredRandomEffectCoordinate:
     def _warm_start(self, initial_model: RandomEffectModel):
         """Recover (L, z) from a previous model's full-dim table via rank-r
         SVD (coordinate descent passes the previous iteration's model; a
-        fresh random restart would discard all alternation progress)."""
+        fresh random restart would discard all alternation progress).  Also
+        returns the key-aligned previous table — the quarantine fallback
+        rows — since the SVD fetched it to host anyway (the factored warm
+        start is a known host-resident edge, see ROADMAP)."""
         aligned = np.zeros((self.dataset.num_entities + 1, self.dim), np.float32)
+        # host-sync: factored warm start — the rank-r SVD of the previous
+        # table runs in numpy, once per warm start (not per iteration).
         src_idx = entity_index_for(self.dataset.keys, np.asarray(initial_model.keys))
         found = src_idx >= 0
+        # host-sync: same factored warm start — the table fetch of the join.
         aligned[:-1][found] = to_host(initial_model.table)[src_idx[found]]
         u, s, vt = np.linalg.svd(aligned, full_matrices=False)
         r = self.r
         sq = np.sqrt(s[:r])
         latent = (vt[:r].T * sq[None, :]).astype(np.float32)  # [d, r]
         z = (u[:, :r] * sq[None, :]).astype(np.float32)  # [E+1, r]
-        return jnp.asarray(latent), jnp.asarray(z)
+        # The aligned previous table stays HOST numpy: it is only needed
+        # once, at the final quarantine-fallback where — uploading it here
+        # would pin a full [E, dim] device copy through every alternation
+        # of the train (the exact residency factoring exists to avoid).
+        return jnp.asarray(latent), jnp.asarray(z), aligned[:-1]
 
     def train(
         self, offsets: np.ndarray, initial_model: Optional[RandomEffectModel] = None
@@ -1043,19 +1125,24 @@ class FactoredRandomEffectCoordinate:
         offsets_j = jnp.asarray(offsets, jnp.float32)
         entity_of_row = jnp.asarray(self.dataset.entity_idx_per_row, jnp.int32)
         z_table = jnp.zeros((num_entities + 1, self.r), jnp.float32)
+        prev_table = None
         if initial_model is not None:
-            latent, z_table = self._warm_start(initial_model)
+            latent, z_table, prev_table = self._warm_start(initial_model)
             # Warm-started L is already informed: refresh it from the new
             # offsets before the first z solve.
             latent = self._solve_latent(
                 z_table[entity_of_row], offsets_j, latent
             )
-        stats = {"entities": 0, "converged": 0, "iterations_max": 0,
-                 "latent_iterations": self.config.latent_iterations}
 
+        # Per-coordinate device stats accumulator (see
+        # _accumulate_solve_stats): reset each latent alternation so the
+        # reported counts cover the FINAL z pass, like the dict the seed
+        # rebuilt per alternation; drained by the descent loop's one
+        # boundary sync.
+        acc = jnp.zeros(4, jnp.int32)
         for it in range(self.config.latent_iterations):
             last = it == self.config.latent_iterations - 1
-            stats.update({"entities": 0, "converged": 0, "iterations_max": 0})
+            acc = jnp.zeros(4, jnp.int32)
             for i, bucket in enumerate(self.device_data.buckets):
                 dev = self.device_data.device_buckets[i]
                 offsets_b = self.device_data._place(
@@ -1067,14 +1154,11 @@ class FactoredRandomEffectCoordinate:
                 w0 = self.device_data._place(z_table[entity_idx])
                 coefficients, result = self._z_solver(batch, w0)
                 z_table = z_table.at[entity_idx].set(coefficients.means)
-                real = bucket.entity_index < num_entities
-                stats["entities"] += int(real.sum())
-                stats["converged"] += int(to_host(result.converged)[real].sum())
-                if real.any():
-                    stats["iterations_max"] = max(
-                        stats["iterations_max"],
-                        int(to_host(result.iterations)[real].max()),
-                    )
+                acc = _accumulate_solve_stats(
+                    acc, entity_idx, num_entities, result.converged,
+                    result.iterations,
+                    jnp.ones_like(result.converged, bool),
+                )
             if not last:
                 z_rows = z_table[entity_of_row]
                 latent = self._solve_latent(z_rows, offsets_j, latent)
@@ -1087,23 +1171,17 @@ class FactoredRandomEffectCoordinate:
             table = table.at[0].set(jnp.nan)
         # Non-finite guard: entities whose materialized coefficients are
         # NaN/Inf (a diverged latent alternation) fall back to the
-        # warm-start model's rows, or zeros on a cold start — the factored
-        # analog of the bucketed quarantine (train() already syncs per-
-        # bucket stats above, so this adds no new hot-loop transfer).
+        # warm-start model's rows (aligned during the warm start's SVD
+        # fetch), or zeros on a cold start — applied unconditionally on
+        # device, and COUNTED into the accumulator's quarantined slot, so
+        # the guard adds no host transfer at all.
         good = jnp.all(jnp.isfinite(table), axis=1)
-        stats["quarantined"] = int((~to_host(good)).sum())
-        if stats["quarantined"]:
-            if initial_model is not None:
-                aligned = np.zeros((num_entities, self.dim), np.float32)
-                src_idx = entity_index_for(
-                    self.dataset.keys, np.asarray(initial_model.keys)
-                )
-                found = src_idx >= 0
-                aligned[found] = to_host(initial_model.table)[src_idx[found]]
-                prev = jnp.asarray(aligned)
-            else:
-                prev = jnp.zeros_like(table)
-            table = jnp.where(good[:, None], table, prev)
+        acc = _count_quarantined(acc, good)
+        prev = (
+            jnp.asarray(prev_table) if prev_table is not None
+            else jnp.zeros_like(table)
+        )
+        table = jnp.where(good[:, None], table, prev)
         model = RandomEffectModel(
             table=table,
             keys=self.dataset.keys,
@@ -1111,7 +1189,9 @@ class FactoredRandomEffectCoordinate:
             shard_name=self.config.shard_name,
             task_type=self.task_type,
         )
-        return model, stats
+        return model, DeferredSolveStats(
+            acc, extra={"latent_iterations": self.config.latent_iterations}
+        )
 
     def score(self, model: RandomEffectModel) -> np.ndarray:
         return model.score(self.data)

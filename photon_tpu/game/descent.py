@@ -23,6 +23,7 @@ from photon_tpu.evaluation.evaluators import MultiEvaluator
 from photon_tpu.fault import QuarantineBudgetError
 from photon_tpu.fault.checkpoint import CheckpointError, DescentState
 from photon_tpu.fault.injection import fault_point
+from photon_tpu.game.coordinate import DeferredSolveStats
 from photon_tpu.game.data import GameDataset
 from photon_tpu.game.model import DeviceScoringCache, GameModel
 from photon_tpu.game.residuals import (
@@ -251,13 +252,53 @@ class CoordinateDescent:
         ``checkpointer`` (a :class:`~photon_tpu.fault.checkpoint.
         DescentCheckpointer`) snapshots the FULL restart state — models,
         residual score rows, best-model tracking, history — after every
-        outer iteration; ``resume_state`` restores a snapshot mid-sweep
-        (device tables rebuilt from the saved rows), so a resumed fit
-        matches an uninterrupted one.  ``max_quarantined`` bounds how many
-        non-finite solves/score rows may be quarantined (previous iterate
-        kept) before the run fails with :class:`QuarantineBudgetError`
-        (None = unlimited).
+        outer iteration; with its async publisher (the default) the loop
+        only stages the d2h copies and the serialize+fsync+rename runs
+        behind the next iteration's compute.  ``resume_state`` restores a
+        snapshot mid-sweep (device tables rebuilt from the saved rows), so
+        a resumed fit matches an uninterrupted one.  ``max_quarantined``
+        bounds how many non-finite solves/score rows may be quarantined
+        (previous iterate kept) before the run fails with
+        :class:`QuarantineBudgetError` (None = unlimited).
         """
+        try:
+            result = self._run(
+                num_iterations,
+                initial_model=initial_model,
+                locked_coordinates=locked_coordinates,
+                checkpoint_fn=checkpoint_fn,
+                checkpointer=checkpointer,
+                resume_state=resume_state,
+                max_quarantined=max_quarantined,
+                config_key=config_key,
+            )
+        except BaseException:
+            # Quiesce the async publisher without masking the real error
+            # (an InjectedKillError must surface as itself; the in-flight
+            # publish is allowed to land — a checkpoint more is strictly
+            # better than one fewer).
+            if checkpointer is not None and hasattr(checkpointer, "drain"):
+                checkpointer.drain(reraise=False)
+            raise
+        if checkpointer is not None and hasattr(checkpointer, "drain"):
+            # The final iteration drains: a completed fit returns only
+            # after its last checkpoint is PUBLISHED, and a publish failure
+            # from the tail iteration surfaces here, never silently.
+            with self.telemetry.span("descent.checkpoint.drain"):
+                checkpointer.drain()
+        return result
+
+    def _run(
+        self,
+        num_iterations: int,
+        initial_model: Optional[GameModel] = None,
+        locked_coordinates: Sequence[str] = (),
+        checkpoint_fn=None,
+        checkpointer=None,
+        resume_state: Optional[DescentState] = None,
+        max_quarantined: Optional[int] = None,
+        config_key: Optional[str] = None,
+    ) -> DescentResult:
         locked = set(locked_coordinates)
         unknown = locked - set(self.coordinates)
         if unknown:
@@ -381,6 +422,10 @@ class CoordinateDescent:
             coord_logs = {}
             trained = 0
             prev_iterates: Dict[str, object] = {}
+            # Coordinates whose train() returned a device stats accumulator
+            # (DeferredSolveStats): their telemetry/log/quarantine
+            # accounting waits for the ONE boundary drain below.
+            deferred: Dict[str, object] = {}
             with telemetry.span("descent.iteration", iteration=it) as iter_span:
                 for name, coord in self.coordinates.items():
                     if name in locked:
@@ -398,15 +443,18 @@ class CoordinateDescent:
                         # just trained touches its validation score row.
                         val_engine.update(name, val_cache.score(model))
                     trained += 1
-                    q = _quarantine_count(info)
-                    if q:
-                        # Non-finite solves quarantined inside train():
-                        # those buckets kept their previous iterate.
-                        telemetry.counter(
-                            "descent.quarantined", coordinate=name,
-                            stage="solve",
-                        ).inc(q)
-                        quarantined_total += q
+                    if isinstance(info, DeferredSolveStats):
+                        deferred[name] = info
+                    else:
+                        q = _quarantine_count(info)
+                        if q:
+                            # Non-finite solves quarantined inside train():
+                            # those buckets kept their previous iterate.
+                            telemetry.counter(
+                                "descent.quarantined", coordinate=name,
+                                stage="solve",
+                            ).inc(q)
+                            quarantined_total += q
                     cache_bytes = getattr(
                         getattr(coord, "device_data", None),
                         "_score_cache_bytes", 0,
@@ -423,27 +471,71 @@ class CoordinateDescent:
                     telemetry.counter(
                         "descent.coordinate_updates", coordinate=name
                     ).inc()
-                    _record_coordinate_info(telemetry, name, info)
-                    summary = (
-                        info.summary().splitlines()[0]
-                        if hasattr(info, "summary")
-                        else str(info)
-                    )
-                    coord_logs[name] = summary
-                    self.logger.info("iter %d coordinate %s: %s", it, name, summary)
+                    if name not in deferred:
+                        _record_coordinate_info(telemetry, name, info)
+                        summary = (
+                            info.summary().splitlines()[0]
+                            if hasattr(info, "summary")
+                            else str(info)
+                        )
+                        coord_logs[name] = summary
+                        self.logger.info(
+                            "iter %d coordinate %s: %s", it, name, summary
+                        )
 
-                # Drain the score tables' non-finite guards (one tiny sync
-                # per iteration): a rejected row means the coordinate's
-                # fresh scores were poisoned even though its solve looked
-                # fine.  Roll the model back to the previous iterate (drop
-                # it entirely on a cold start) and re-sync BOTH engines'
-                # rows to the rolled-back model, so composite, residual
-                # offsets, validation rows, and any checkpoint stay
-                # consistent.  A coordinate rejected by both engines is ONE
-                # quarantine event.
-                rejected = set(residuals.poll_quarantined())
+                # THE one stats/quarantine host sync of the iteration: the
+                # per-coordinate device stats accumulators and BOTH score
+                # tables' non-finite guard flags come to host in a single
+                # batched device_get (the seed paid one deferred sync per
+                # coordinate train instead).  A rejected row means the
+                # coordinate's fresh scores were poisoned even though its
+                # solve looked fine: roll the model back to the previous
+                # iterate (drop it entirely on a cold start) and re-sync
+                # BOTH engines' rows to the rolled-back model, so
+                # composite, residual offsets, validation rows, and any
+                # checkpoint stay consistent.  A coordinate rejected by
+                # both engines is ONE quarantine event.
+                import jax as _jax
+
+                res_flags = residuals.drain_guard_flags()
+                val_flags = (
+                    val_engine.drain_guard_flags()
+                    if val_engine is not None else []
+                )
+                # host-sync: the sanctioned once-per-iteration stats/
+                # quarantine drain (descent.host_syncs counts it).
+                stats_host, res_ok, val_ok = _jax.device_get((
+                    {name: ds.device for name, ds in deferred.items()},
+                    [ok for _, ok in res_flags],
+                    [ok for _, ok in val_flags],
+                ))
+                telemetry.counter("descent.host_syncs", kind="stats").inc()
+                for name, ds in deferred.items():
+                    info = ds.resolve(stats_host[name])
+                    q = int(info.get("quarantined", 0))
+                    if q:
+                        telemetry.counter(
+                            "descent.quarantined", coordinate=name,
+                            stage="solve",
+                        ).inc(q)
+                        quarantined_total += q
+                    _record_coordinate_info(telemetry, name, info)
+                    coord_logs[name] = str(info)
+                    self.logger.info(
+                        "iter %d coordinate %s: %s", it, name, info
+                    )
+                rejected = {
+                    name for (name, _), ok in zip(res_flags, res_ok)
+                    if not bool(ok)
+                }
+                residuals.record_rejected(sorted(rejected))
                 if val_engine is not None:
-                    rejected |= set(val_engine.poll_quarantined())
+                    val_rejected = {
+                        name for (name, _), ok in zip(val_flags, val_ok)
+                        if not bool(ok)
+                    }
+                    val_engine.record_rejected(sorted(val_rejected))
+                    rejected |= val_rejected
                 bad_locked = sorted(rejected & locked)
                 if bad_locked:
                     # A locked coordinate's scores come straight from the
@@ -530,6 +622,15 @@ class CoordinateDescent:
                     best_model, best_metrics, best_iteration = game_model, metrics, it
 
             if checkpointer is not None:
+                # Async publishing: hand the checkpointer DEVICE row
+                # handles — its staging step starts copy_to_host_async on
+                # rows and model tables together and gathers once, instead
+                # of the blocking per-table fetches the sync path keeps.
+                rows = (
+                    residuals.snapshot_rows_async()
+                    if getattr(checkpointer, "async_publish", False)
+                    else residuals.snapshot_rows()
+                )
                 state = DescentState(
                     iteration=it,
                     num_iterations=num_iterations,
@@ -539,7 +640,7 @@ class CoordinateDescent:
                     best_metrics=dict(best_metrics),
                     best_iteration=best_iteration,
                     history=list(history),
-                    residual_rows=residuals.snapshot_rows(),
+                    residual_rows=rows,
                     quarantined=quarantined_total,
                     fingerprint=self._fingerprint(
                         config_key, locked=locked,

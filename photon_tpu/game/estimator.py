@@ -181,6 +181,7 @@ class GameEstimator:
         checkpoint_dir: Optional[str] = None,
         resume: Optional[str] = None,
         max_quarantined: Optional[int] = None,
+        checkpoint_async=None,
     ) -> List[GameResult]:
         """``checkpoint_fn(iteration, model)`` is forwarded to each descent
         run (per-iteration intermediate model output — SURVEY.md §5).
@@ -194,7 +195,10 @@ class GameEstimator:
         covers its final iteration is rebuilt from the snapshot without
         re-running — mid-sweep resume skips finished work.
         ``max_quarantined`` is the descent quarantine budget (None =
-        unlimited; see :meth:`CoordinateDescent.run`).
+        unlimited; see :meth:`CoordinateDescent.run`).  ``checkpoint_async``
+        gates the background checkpoint publisher (``'on'``/``'off'``/bool;
+        None defers to ``PHOTON_CHECKPOINT_ASYNC``, default on — see
+        :func:`photon_tpu.fault.checkpoint.resolve_checkpoint_async`).
         """
         if not configurations:
             raise ValueError("fit() needs at least one configuration")
@@ -223,6 +227,7 @@ class GameEstimator:
                 checkpointer = DescentCheckpointer(
                     os.path.join(checkpoint_dir, f"cfg-{i:03d}"),
                     telemetry=self.telemetry, logger=self.logger,
+                    async_publish=checkpoint_async,
                 )
             if resume:
                 if resume in ("auto", "latest"):

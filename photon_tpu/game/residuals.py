@@ -315,19 +315,45 @@ class _DeviceScoreTable:
         padding trimmed)."""
         return self.scores[self._row[name], : self.n]
 
-    def poll_quarantined(self) -> list:
-        """Names whose row updates were rejected (non-finite) since the
-        last poll.  ONE host sync of tiny bool scalars per outer iteration —
-        the quarantine accounting the budget check runs on."""
+    def drain_guard_flags(self) -> list:
+        """Hand the pending ``(name, ok)`` guard flags to the caller and
+        clear them — NO host access: the ok values are device bool scalars
+        the descent loop batches into its single per-iteration stats/
+        quarantine drain (``jax.device_get`` over everything at once)
+        instead of one blocking ``bool()`` per flag."""
         pending, self._pending_guard = self._pending_guard, []
-        # host-sync: draining the per-update ok flags — bool scalars, once
-        # per outer iteration, the sanctioned quarantine-accounting sync.
-        bad = [name for name, ok in pending if not bool(ok)]
+        return pending
+
+    def record_rejected(self, bad: Sequence[str]) -> None:
+        """Count rejected row updates (called by whoever drained the
+        flags — poll_quarantined below, or the descent boundary drain)."""
         for name in bad:
             self.telemetry.counter(
                 f"{self._PATH}.nonfinite_rows", coordinate=name
             ).inc()
+
+    def poll_quarantined(self) -> list:
+        """Names whose row updates were rejected (non-finite) since the
+        last poll — the standalone-caller form of the guard drain (the
+        descent loop batches drain_guard_flags into its one boundary
+        sync instead)."""
+        pending = self.drain_guard_flags()
+        # host-sync: draining the per-update ok flags — bool scalars, the
+        # sanctioned quarantine-accounting sync for direct callers.
+        bad = [name for name, ok in pending if not bool(ok)]
+        self.record_rejected(bad)
         return bad
+
+    def snapshot_rows_async(self) -> dict:
+        """Device row handles ``{name: [n]}`` for the ASYNC checkpoint
+        staging path: the checkpointer starts ``copy_to_host_async`` on
+        them together with the model tables and gathers once — no blocking
+        per-row fetch here.  The handles must be materialized before the
+        next ``update`` donates the table (the checkpointer stages them
+        synchronously inside ``save``, before the loop resumes)."""
+        return {
+            name: self.scores[self._row[name], : self.n] for name in self.names
+        }
 
     def snapshot_rows(self) -> dict:
         """All score rows as host float32 arrays ``{name: [n]}`` — the
@@ -458,14 +484,24 @@ class HostResiduals:
         ).inc(out.nbytes)
         return out
 
-    def poll_quarantined(self) -> list:
-        """Names whose updates were rejected (non-finite) since last poll —
-        same contract as the device engines' guarded rows."""
+    def drain_guard_flags(self) -> list:
+        """Pending ``(name, ok)`` flags (host bools here — the escape hatch
+        rejected on host at update time); same batching contract as the
+        device engines'."""
         bad, self._pending_guard = self._pending_guard, []
+        return [(name, False) for name in bad]
+
+    def record_rejected(self, bad) -> None:
         for name in bad:
             self.telemetry.counter(
                 "residuals.nonfinite_rows", coordinate=name
             ).inc()
+
+    def poll_quarantined(self) -> list:
+        """Names whose updates were rejected (non-finite) since last poll —
+        same contract as the device engines' guarded rows."""
+        bad = [name for name, _ok in self.drain_guard_flags()]
+        self.record_rejected(bad)
         return bad
 
     def snapshot_rows(self) -> dict:
@@ -473,6 +509,10 @@ class HostResiduals:
         Saved at the path's native dtype so a resumed host-mode fit is
         bit-identical to an uninterrupted one."""
         return {name: s.copy() for name, s in self.scores.items()}
+
+    def snapshot_rows_async(self) -> dict:
+        """Host engine: rows already live on host — staging is a copy."""
+        return self.snapshot_rows()
 
     def load_rows(self, rows: dict) -> None:
         """Restore checkpointed rows (resume path).  Stored directly —

@@ -84,6 +84,59 @@ def _fmt_labels(labels: dict) -> str:
     return ", ".join(f"{k}={v}" for k, v in sorted(labels.items())) or "—"
 
 
+def _render_pipeline_section(report: dict) -> list:
+    """The checkpoint-publisher / io-pool pipeline at a glance: how long
+    the training loop actually blocked on checkpoint IO vs how long the
+    background publishes took, plus the host-IO pool's live shape.  Empty
+    when the run neither checkpointed nor pooled reads."""
+    metrics = report.get("metrics") or {}
+    hists = {
+        (h["name"], tuple(sorted(h.get("labels", {}).items()))): h
+        for h in metrics.get("histograms") or []
+    }
+    scalars = {
+        (m["name"], tuple(sorted(m.get("labels", {}).items()))): m["value"]
+        for m in (metrics.get("counters") or []) + (metrics.get("gauges") or [])
+    }
+
+    def hist(name):
+        return hists.get((name, ()))
+
+    def scalar(name):
+        return scalars.get((name, ()))
+
+    lines = []
+    ckpt_rows = []
+    for name, label in (
+        ("checkpoint.write_seconds", "loop-side save (stage + submit)"),
+        ("checkpoint.blocked_s", "loop blocked on previous publish"),
+        ("checkpoint.publish_lag_s", "background publish (enqueue→landed)"),
+    ):
+        h = hist(name)
+        if h and h.get("count"):
+            ckpt_rows.append(
+                f"| {name} | {label} | {h['count']} | {_fmt(h['mean'])} "
+                f"| {_fmt(h['max'])} |"
+            )
+    if ckpt_rows or scalar("checkpoint.saves"):
+        lines += ["", "## Checkpoint pipeline", ""]
+        if scalar("checkpoint.saves") is not None:
+            lines.append(f"- **saves**: {_fmt(scalar('checkpoint.saves'))}")
+        if ckpt_rows:
+            lines += ["", "| metric | meaning | count | mean (s) | max (s) |",
+                      "|---|---|---|---|---|", *ckpt_rows]
+    pool = {
+        name: scalar(name)
+        for name in ("io_pool.workers", "io_pool.in_flight_peak")
+        if scalar(name) is not None
+    }
+    if pool:
+        lines += ["", "## Host-IO pool", ""]
+        for name, value in pool.items():
+            lines.append(f"- **{name}**: {_fmt(value)}")
+    return lines
+
+
 def render_markdown(report: dict) -> str:
     """Human-readable view of a run report dict."""
     lines = [
@@ -118,6 +171,8 @@ def render_markdown(report: dict) -> str:
                   "| phase | total (s) |", "|---|---|"]
         for name, secs in sorted(totals.items(), key=lambda kv: -kv[1]):
             lines.append(f"| {name} | {secs:.3f} |")
+
+    lines += _render_pipeline_section(report)
 
     metrics = report.get("metrics") or {}
     counters = metrics.get("counters") or []

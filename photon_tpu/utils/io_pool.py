@@ -64,6 +64,12 @@ def map_ordered(
     ``io.retries{site=retry_site}`` on ``telemetry`` — a flaky part file
     costs backoff, not the whole pooled read.
 
+    With ``telemetry`` given, the pool's live shape lands in the run
+    report: ``io_pool.workers`` (configured width), ``io_pool.in_flight``
+    (submitted-but-unharvested calls, updated as the window slides) and
+    ``io_pool.in_flight_peak`` (the high-water mark — how much of the
+    window a read actually used).
+
     With ``workers <= 1`` (or a single item) this degrades to a plain lazy
     map — no threads, no queueing.  An exception from any call is re-raised
     at its in-order position.  Abandoning the iterator cancels calls that
@@ -103,6 +109,19 @@ def map_ordered(
         finally:
             _worker_ctx.active = False
 
+    if telemetry is not None:
+        telemetry.gauge("io_pool.workers").set(workers)
+    in_flight_peak = 0
+
+    def _note_in_flight(n: int) -> None:
+        nonlocal in_flight_peak
+        if telemetry is None:
+            return
+        telemetry.gauge("io_pool.in_flight").set(n)
+        if n > in_flight_peak:
+            in_flight_peak = n
+            telemetry.gauge("io_pool.in_flight_peak").set(n)
+
     ex = ThreadPoolExecutor(max_workers=workers)
     try:
         futs: deque = deque()
@@ -111,6 +130,9 @@ def map_ordered(
             while idx < len(items) and len(futs) < window:
                 futs.append(ex.submit(run_marked, items[idx]))
                 idx += 1
-            yield futs.popleft().result()
+            _note_in_flight(len(futs))
+            result = futs.popleft().result()
+            _note_in_flight(len(futs))
+            yield result
     finally:
         ex.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,258 @@
+"""ISSUE 5: async checkpoint publisher + one-sync-per-iteration descent.
+
+Pins the two tentpole contracts: (1) the async publisher stages d2h on the
+loop thread and publishes in the background with bounded depth 1, surfacing
+failures on the next save/drain and keeping kill-window atomicity; (2) the
+descent loop performs exactly ONE stats/quarantine host sync per outer
+iteration (``descent.host_syncs``) — the per-coordinate train() stats drain
+is gone."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu.core.objective import RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.problem import ProblemConfig
+from photon_tpu.data.synthetic import make_game_dataset
+from photon_tpu.fault.checkpoint import (
+    AsyncPublisher,
+    DescentCheckpointer,
+    resolve_checkpoint_async,
+)
+from photon_tpu.fault.injection import (
+    FaultPlan,
+    InjectedKillError,
+    set_plan,
+)
+from photon_tpu.game.coordinate import (
+    DeferredSolveStats,
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import split_game_dataset
+from photon_tpu.game.estimator import GameEstimator, GameOptimizationConfiguration
+from photon_tpu.telemetry import TelemetrySession
+
+
+@pytest.fixture(autouse=True)
+def _fault_hygiene(monkeypatch):
+    monkeypatch.setenv("PHOTON_IO_RETRY_BASE_S", "0")
+    set_plan(None)
+    yield
+    set_plan(None)
+
+
+def _problem(lam: float, iters: int) -> ProblemConfig:
+    return ProblemConfig(
+        regularization=RegularizationContext("l2", lam),
+        optimizer_config=OptimizerConfig(max_iterations=iters),
+    )
+
+
+def _game_fixture(seed: int = 7, iters: int = 3):
+    data, _ = make_game_dataset(40, 5, 6, 3, seed=seed)
+    train, val = split_game_dataset(data, 0.25)
+    config = GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", _problem(0.01, 8)),
+            "re0": RandomEffectCoordinateConfig("re0", "re0", _problem(1.0, 6)),
+        },
+        descent_iterations=iters,
+        name="async-ckpt",
+    )
+    return train, val, config
+
+
+def _coordinate_arrays(model):
+    out = {}
+    for name, coord in model.coordinates.items():
+        if hasattr(coord, "table"):
+            out[name] = np.asarray(coord.table)
+        else:
+            out[name] = np.asarray(coord.coefficients.means)
+    return out
+
+
+# -- resolve gate ------------------------------------------------------------
+
+
+def test_resolve_checkpoint_async(monkeypatch):
+    assert resolve_checkpoint_async(None) is True  # default on
+    assert resolve_checkpoint_async("off") is False
+    assert resolve_checkpoint_async("on") is True
+    assert resolve_checkpoint_async(False) is False
+    monkeypatch.setenv("PHOTON_CHECKPOINT_ASYNC", "off")
+    assert resolve_checkpoint_async(None) is False
+    assert resolve_checkpoint_async("on") is True  # flag wins over env
+    with pytest.raises(ValueError):
+        resolve_checkpoint_async("maybe")
+
+
+# -- publisher unit ----------------------------------------------------------
+
+
+def test_publisher_failure_surfaces_on_next_submit():
+    pub = AsyncPublisher(TelemetrySession("t"))
+
+    def boom():
+        raise RuntimeError("publish died")
+
+    pub.submit(boom)
+    with pytest.raises(RuntimeError, match="publish died"):
+        pub.submit(lambda: None)
+    # The failed slot was consumed: the replacement publish goes through.
+    ran = []
+    pub.submit(lambda: ran.append(1))
+    pub.drain()
+    assert ran == [1]
+
+
+def test_publisher_drain_raises_tail_failure():
+    pub = AsyncPublisher(TelemetrySession("t"))
+    pub.submit(lambda: (_ for _ in ()).throw(RuntimeError("tail")))
+    with pytest.raises(RuntimeError, match="tail"):
+        pub.drain()
+    # drain(reraise=False) never raises and clears the error.
+    pub.submit(lambda: (_ for _ in ()).throw(RuntimeError("tail2")))
+    pub.drain(reraise=False)
+    pub.submit(lambda: None)
+    pub.drain()
+
+
+def test_publisher_bounded_depth_blocks_until_previous_lands():
+    session = TelemetrySession("t")
+    pub = AsyncPublisher(session)
+    order = []
+
+    def slow():
+        time.sleep(0.15)
+        order.append("first-done")
+
+    pub.submit(slow)
+    t0 = time.monotonic()
+    pub.submit(lambda: order.append("second"))  # must wait for slow()
+    waited = time.monotonic() - t0
+    pub.drain()
+    assert order == ["first-done", "second"]
+    assert waited >= 0.1
+    # The wait is visible as checkpoint.blocked_s.
+    assert session.histogram("checkpoint.blocked_s").max >= 0.1
+
+
+# -- kill windows (tentpole acceptance) --------------------------------------
+
+
+@pytest.mark.parametrize("window", ["checkpoint:stage", "checkpoint:write"])
+@pytest.mark.parametrize("mode", ["device", "host"])
+def test_async_kill_windows_keep_previous_checkpoint_loadable(
+    tmp_path, window, mode
+):
+    """A kill during the d2h-staging or torn-write window of an ASYNC
+    publish leaves the previous checkpoint the loadable LATEST, and
+    ``--resume latest`` parity with an uninterrupted fit is EXACT (0.0)."""
+    train, val, config = _game_fixture()
+
+    def fit(**kw):
+        return GameEstimator(
+            "logistic_regression", train, val, residual_mode=mode
+        ).fit([config], checkpoint_async="on", **kw)[0]
+
+    baseline = GameEstimator(
+        "logistic_regression", train, val, residual_mode=mode
+    ).fit([config])[0]
+
+    ckpt = str(tmp_path / "ckpt")
+    set_plan(FaultPlan.parse(f"{window}:iter=1"))
+    with pytest.raises(InjectedKillError):
+        fit(checkpoint_dir=ckpt)
+    set_plan(None)
+
+    # Iteration 0's checkpoint survived the kill and is the LATEST.
+    chain = DescentCheckpointer(os.path.join(ckpt, "cfg-000"))
+    latest = chain.latest_path()
+    assert latest is not None and latest.endswith("ckpt-000000")
+    state = DescentCheckpointer.load_path(latest)
+    assert state.iteration == 0
+
+    resumed = fit(checkpoint_dir=ckpt, resume="latest")
+    assert baseline.metrics == resumed.metrics
+    base_arrays = _coordinate_arrays(baseline.model)
+    res_arrays = _coordinate_arrays(resumed.model)
+    for name in base_arrays:
+        np.testing.assert_array_equal(base_arrays[name], res_arrays[name])
+
+
+def test_final_iteration_drains_before_fit_returns(tmp_path):
+    """A completed fit's LAST checkpoint is published (not in flight) by
+    the time fit() returns — the final-iteration drain."""
+    train, val, config = _game_fixture()
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator("logistic_regression", train, val).fit(
+        [config], checkpoint_dir=ckpt, checkpoint_async="on"
+    )
+    chain = DescentCheckpointer(os.path.join(ckpt, "cfg-000"))
+    latest = chain.latest_path()
+    assert latest is not None and latest.endswith(
+        f"ckpt-{config.descent_iterations - 1:06d}"
+    )
+    DescentCheckpointer.load_path(latest)  # manifest-complete
+
+
+def test_async_publish_telemetry(tmp_path):
+    train, val, config = _game_fixture()
+    session = TelemetrySession("t")
+    GameEstimator(
+        "logistic_regression", train, val, telemetry=session
+    ).fit([config], checkpoint_dir=str(tmp_path / "c"), checkpoint_async="on")
+    saves = session.counter("checkpoint.saves").value
+    assert saves == config.descent_iterations
+    assert session.histogram("checkpoint.publish_lag_s").count == saves
+    assert session.histogram("checkpoint.blocked_s").count == saves
+    # The publisher thread's spans land in the session's trace.
+    assert sum(
+        1 for sp in session.tracer.finished if sp.name == "checkpoint.publish"
+    ) == saves
+
+
+# -- one-sync-per-iteration (tentpole acceptance) ----------------------------
+
+
+@pytest.mark.parametrize("mode", ["device", "host"])
+def test_exactly_one_stats_sync_per_outer_iteration(mode):
+    """``descent.host_syncs`` counts exactly one stats/quarantine drain per
+    outer iteration — the per-coordinate train() stats sync is gone — and
+    the drained stats still feed the re_solver telemetry."""
+    train, val, config = _game_fixture(iters=3)
+    session = TelemetrySession("t")
+    GameEstimator(
+        "logistic_regression", train, val, residual_mode=mode,
+        telemetry=session,
+    ).fit([config])
+    counters = {
+        (c["name"], tuple(sorted(c["labels"].items()))): c["value"]
+        for c in session.registry.snapshot()["counters"]
+    }
+    assert counters[("descent.host_syncs", (("kind", "stats"),))] == 3
+    # Deferred stats resolved at the boundary still record solver telemetry.
+    assert counters[("re_solver.entities", (("coordinate", "re0"),))] > 0
+    assert counters[("descent.iterations", ())] == 3
+
+
+def test_deferred_stats_direct_caller_resolves_lazily():
+    from photon_tpu.game.coordinate import RandomEffectCoordinate
+
+    data, _ = make_game_dataset(20, 4, 5, 3, seed=3)
+    coord = RandomEffectCoordinate(
+        data, RandomEffectCoordinateConfig("re0", "re0", _problem(1.0, 5)),
+        "logistic_regression",
+    )
+    model, stats = coord.train(np.zeros(data.num_examples, np.float32))
+    assert isinstance(stats, DeferredSolveStats)
+    # Dict-style access resolves on first touch (off the descent loop).
+    assert stats["entities"] == coord.dataset.num_entities
+    assert stats["quarantined"] == 0
+    assert stats.get("converged") <= stats["entities"]
+    assert "iterations_max" in stats

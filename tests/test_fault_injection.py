@@ -564,6 +564,82 @@ def test_driver_kill_resume_roundtrip_matches_uninterrupted(tmp_path):
         assert abs(v - resumed["best_metrics"][k]) <= 1e-6
 
 
+# -- streamed GLM: kill -> resume through the driver -------------------------
+
+
+def _stream_files(tmp_path, n_files=2, rows=80, d=12):
+    from photon_tpu.data.synthetic import make_glm_data, write_libsvm
+
+    paths = []
+    for i in range(n_files):
+        b, _ = make_glm_data(rows, d, seed=11 + i, weight_seed=7)
+        p = str(tmp_path / f"part-{i}.libsvm")
+        write_libsvm(p, np.asarray(b.x)[:, :-1], np.asarray(b.label))
+        paths.append(p)
+    return str(tmp_path / "part-*.libsvm")
+
+
+def test_streamed_driver_kill_resume_roundtrip(tmp_path):
+    from photon_tpu.drivers import train
+
+    glob_spec = _stream_files(tmp_path)
+
+    def stream_args(out, extra=()):
+        return train.build_parser().parse_args([
+            "--backend", "cpu", "--stream", "--input", glob_spec,
+            "--task", "logistic_regression", "--reg-weights", "0.5,2.0",
+            "--max-iterations", "12",
+            "--output-dir", str(tmp_path / out), *extra,
+        ])
+
+    baseline = train.run(stream_args("base"))
+
+    ckpt = str(tmp_path / "ckpt")
+    with pytest.raises(InjectedKillError):
+        train.run(stream_args("killed", [
+            "--checkpoint-dir", ckpt, "--faults", "stream:kill:iter=4",
+        ]))
+    set_plan(None)  # the driver installed the plan process-wide
+
+    resumed = train.run(stream_args("resumed", [
+        "--checkpoint-dir", ckpt, "--resume", "latest",
+    ]))
+    # Optimizer trajectories are EXACTLY the uninterrupted ones — for the
+    # completed weight (rebuilt from its final snapshot) and the
+    # interrupted one (continued mid-fit) alike.
+    for ea, eb in zip(baseline["sweep"], resumed["sweep"]):
+        assert ea["final_value"] == eb["final_value"]
+        assert ea["iterations"] == eb["iterations"]
+        assert ea["convergence_reason"] == eb["convergence_reason"]
+
+
+def test_streamed_resume_latest_requires_published_checkpoint(tmp_path):
+    from photon_tpu.drivers import train
+
+    glob_spec = _stream_files(tmp_path)
+    args = train.build_parser().parse_args([
+        "--backend", "cpu", "--stream", "--input", glob_spec,
+        "--max-iterations", "4",
+        "--output-dir", str(tmp_path / "out"),
+        "--checkpoint-dir", str(tmp_path / "empty"), "--resume", "latest",
+    ])
+    with pytest.raises(ValueError, match="no published checkpoint"):
+        train.run(args)
+
+
+def test_resident_driver_rejects_stream_checkpoint_flags(tmp_path):
+    from photon_tpu.drivers import train
+
+    args = train.build_parser().parse_args([
+        "--backend", "cpu",
+        "--input", "synthetic:logistic_regression:100:10:3:5",
+        "--output-dir", str(tmp_path / "out"),
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ])
+    with pytest.raises(ValueError, match="--stream"):
+        train.run(args)
+
+
 # -- atomic model export -----------------------------------------------------
 
 

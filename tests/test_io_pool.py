@@ -157,3 +157,27 @@ def test_pooled_stream_chunks_matches_sequential(tmp_path, monkeypatch):
     v4, g4 = run()
     assert v1 == v4
     np.testing.assert_array_equal(g1, g4)
+
+
+def test_map_ordered_telemetry_gauges():
+    """With a telemetry session the pool exports its live shape: configured
+    workers, current in-flight, and the in-flight high-water mark (ISSUE 5
+    satellite: io_pool gauges in run reports)."""
+    from photon_tpu.telemetry import TelemetrySession
+    from photon_tpu.utils.io_pool import map_ordered
+
+    session = TelemetrySession("t")
+    out = list(map_ordered(
+        lambda i: i + 1, range(20), workers=4, window=6, telemetry=session,
+    ))
+    assert out == list(range(1, 21))
+    assert session.gauge("io_pool.workers").value == 4
+    peak = session.gauge("io_pool.in_flight_peak").value
+    assert 1 <= peak <= 6
+    # After the last harvest the window is drained.
+    assert session.gauge("io_pool.in_flight").value == 0
+
+    # Sequential fallback (workers=1) never touches the pool gauges.
+    seq = TelemetrySession("t2")
+    list(map_ordered(lambda i: i, range(3), workers=1, telemetry=seq))
+    assert seq.gauge("io_pool.in_flight_peak").value is None

@@ -274,6 +274,124 @@ def test_streaming_lbfgs_matches_in_memory(tmp_path):
     )
 
 
+def test_streaming_lbfgs_kill_and_resume_exact(tmp_path):
+    """A streamed fit killed mid-loop and resumed from its mid-fit L-BFGS
+    snapshot matches the uninterrupted fit EXACTLY (ISSUE 5 satellite: the
+    ROADMAP's streamed-GLM checkpoint edge)."""
+    from photon_tpu.fault.checkpoint import StreamCheckpointer
+    from photon_tpu.fault.injection import (
+        FaultPlan,
+        InjectedKillError,
+        set_plan,
+    )
+
+    paths, _, _ = _write_files(tmp_path)
+    source = LibsvmFileSource(paths)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    config = OptimizerConfig(max_iterations=25)
+
+    def objective():
+        return StreamingObjective(obj, source.chunk_iter_factory)
+
+    w0 = jnp.zeros(source.dim, jnp.float32)
+    baseline = streaming_lbfgs(objective(), w0, config)
+
+    ckpt = StreamCheckpointer(str(tmp_path / "ckpt"))
+    set_plan(FaultPlan.parse("stream:kill:iter=3"))
+    try:
+        with pytest.raises(InjectedKillError):
+            streaming_lbfgs(objective(), w0, config, checkpointer=ckpt)
+    finally:
+        set_plan(None)
+
+    state = ckpt.load("latest")
+    assert state is not None and not state.completed
+    assert state.iteration <= 3
+    resumed = streaming_lbfgs(
+        objective(), w0, config, checkpointer=ckpt, resume_state=state
+    )
+    np.testing.assert_array_equal(np.asarray(baseline.w), np.asarray(resumed.w))
+    assert int(baseline.iterations) == int(resumed.iterations)
+    assert int(baseline.reason) == int(resumed.reason)
+    np.testing.assert_array_equal(
+        np.asarray(baseline.history_value), np.asarray(resumed.history_value)
+    )
+
+
+def test_streaming_completed_checkpoint_rebuilds_without_passes(tmp_path):
+    """Resuming a COMPLETED streamed fit rebuilds the result from the final
+    snapshot with zero streamed passes."""
+    from photon_tpu.fault.checkpoint import StreamCheckpointer
+
+    paths, _, _ = _write_files(tmp_path)
+    source = LibsvmFileSource(paths)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+    config = OptimizerConfig(max_iterations=25)
+    passes = {"n": 0}
+
+    def counting_factory():
+        passes["n"] += 1
+        return source.chunk_iter_factory()
+
+    ckpt = StreamCheckpointer(str(tmp_path / "ckpt"))
+    w0 = jnp.zeros(source.dim, jnp.float32)
+    fitted = streaming_lbfgs(
+        StreamingObjective(obj, counting_factory), w0, config,
+        checkpointer=ckpt,
+    )
+    state = ckpt.load("latest")
+    assert state is not None and state.completed
+
+    passes["n"] = 0
+    rebuilt = streaming_lbfgs(
+        StreamingObjective(obj, counting_factory), w0, config,
+        checkpointer=ckpt, resume_state=state,
+    )
+    assert passes["n"] == 0  # not a single streamed pass
+    np.testing.assert_array_equal(np.asarray(fitted.w), np.asarray(rebuilt.w))
+    assert float(fitted.value) == float(rebuilt.value)
+    assert bool(fitted.converged) == bool(rebuilt.converged)
+
+
+def test_streaming_max_iterations_checkpoint_continues_with_larger_budget(
+    tmp_path,
+):
+    """A streamed fit that stopped on MAX_ITERATIONS is 'completed' for its
+    own budget, but resuming with a LARGER budget continues the loop (same
+    rule as descent checkpoints) instead of short-circuiting stale."""
+    from photon_tpu.fault.checkpoint import StreamCheckpointer
+
+    paths, _, _ = _write_files(tmp_path)
+    source = LibsvmFileSource(paths)
+    obj = GlmObjective.create("logistic", RegularizationContext("l2", 1.0))
+
+    def objective():
+        return StreamingObjective(obj, source.chunk_iter_factory)
+
+    w0 = jnp.zeros(source.dim, jnp.float32)
+    small = OptimizerConfig(max_iterations=3)
+    ckpt = StreamCheckpointer(str(tmp_path / "ckpt"))
+    capped = streaming_lbfgs(objective(), w0, small, checkpointer=ckpt)
+    assert int(capped.iterations) == 3 and not bool(capped.converged)
+
+    state = ckpt.load("latest")
+    assert state is not None and state.completed
+
+    # Same budget: rebuilt without passes (stale short-circuit is correct).
+    same = streaming_lbfgs(
+        objective(), w0, small, checkpointer=ckpt, resume_state=state
+    )
+    assert int(same.iterations) == 3
+
+    # Larger budget: the loop CONTINUES past the snapshot.
+    grown = streaming_lbfgs(
+        objective(), w0, OptimizerConfig(max_iterations=25),
+        checkpointer=ckpt, resume_state=state,
+    )
+    assert int(grown.iterations) > 3
+    assert float(grown.value) < float(capped.value)  # it kept optimizing
+
+
 def test_source_with_files_and_known_dim(tmp_path):
     """Global metadata + per-process file restriction; known feature_dim
     skips the full parse but yields identical layout."""

@@ -340,6 +340,36 @@ def test_render_markdown_and_cli(tmp_path, capsys):
     assert "# Run report: render-test" in capsys.readouterr().out
 
 
+def test_render_markdown_checkpoint_pipeline_section(tmp_path):
+    """Publisher lag/blocked histograms and io-pool gauges surface as their
+    own section (ISSUE 5 satellite); absent metrics -> absent section."""
+    session = TelemetrySession("pipeline-test")
+    session.counter("checkpoint.saves").inc(3)
+    session.histogram("checkpoint.write_seconds").observe(0.01)
+    session.histogram("checkpoint.blocked_s").observe(0.0)
+    session.histogram("checkpoint.publish_lag_s").observe(0.2)
+    session.gauge("io_pool.workers").set(4)
+    session.gauge("io_pool.in_flight_peak").set(8)
+    session.finalize(str(tmp_path))
+    text = render_markdown(
+        json.load(open(tmp_path / "telemetry" / "run_report.json"))
+    )
+    assert "## Checkpoint pipeline" in text
+    assert "**saves**: 3" in text
+    assert "checkpoint.publish_lag_s" in text
+    assert "## Host-IO pool" in text
+    assert "**io_pool.in_flight_peak**: 8" in text
+
+    plain = TelemetrySession("no-pipeline")
+    plain.counter("rows").inc()
+    plain.finalize(str(tmp_path / "plain"))
+    text2 = render_markdown(
+        json.load(open(tmp_path / "plain" / "telemetry" / "run_report.json"))
+    )
+    assert "## Checkpoint pipeline" not in text2
+    assert "## Host-IO pool" not in text2
+
+
 # ------------------------------------------------------ driver integration
 
 

@@ -27,13 +27,17 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-# The GAME hot loop: the score engines and the descent loop that drives
-# them.  Coordinate/model scoring helpers keep legitimate host paths (the
-# escape hatch, model export) and are covered by their host-sync markers
-# where they intersect the loop.
+# The GAME hot loop: the score engines, the descent loop that drives
+# them, the coordinate train/score paths (whose per-train stats now stay
+# on device — the descent boundary drain is the one sanctioned sync), and
+# the checkpoint module (whose async staging pass is the one sanctioned
+# off-hot-path fetch).  Legitimate host paths (the escape hatch, warm
+# starts, model export) carry host-sync markers.
 DEFAULT_FILES = (
     "photon_tpu/game/residuals.py",
     "photon_tpu/game/descent.py",
+    "photon_tpu/game/coordinate.py",
+    "photon_tpu/fault/checkpoint.py",
 )
 
 SYNC_PATTERN = re.compile(
