@@ -459,7 +459,8 @@ def _bench_config(num: int) -> None:
     })
 
 
-def _game_bench_fixture(n_random_coords: int, descent_iterations: int):
+def _game_bench_fixture(n_random_coords: int, descent_iterations: int,
+                        sizes=None):
     """Shared synthetic-fit fixture of the GAME micro-benches: one dataset
     + configuration sized so the path under test (residual passing /
     validation) is a visible slice of the wall clock — solver work is
@@ -480,8 +481,14 @@ def _game_bench_fixture(n_random_coords: int, descent_iterations: int):
     from photon_tpu.game.estimator import GameOptimizationConfiguration
 
     platform = jax.devices()[0].platform
-    big = platform != "cpu"
-    n_entities, rows_mean = (20_000, 50) if big else (8000, 25)
+    if sizes is None:
+        big = platform != "cpu"
+        n_entities, rows_mean = (20_000, 50) if big else (8000, 25)
+    else:
+        # Explicit sizes: the resharded-restore subprocess must rebuild
+        # the PARENT's fixture (its own platform is forced CPU, so the
+        # platform-derived sizes could differ from the checkpoint's).
+        n_entities, rows_mean = sizes
     data, _ = make_game_dataset(
         n_entities, rows_mean, 32, 8, seed=0,
         n_random_coords=n_random_coords,
@@ -501,7 +508,10 @@ def _game_bench_fixture(n_random_coords: int, descent_iterations: int):
     config = GameOptimizationConfiguration(
         coordinates=coordinates, descent_iterations=descent_iterations
     )
-    return platform, n_entities, data, config
+    # Sizes ride the return so subprocess rebuilds (the resharded-restore
+    # worker) use the PARENT's fixture shape verbatim instead of
+    # re-deriving it from their own (forced-CPU) platform.
+    return platform, (n_entities, rows_mean), data, config
 
 
 def _bench_descent() -> None:
@@ -518,7 +528,7 @@ def _bench_descent() -> None:
     from photon_tpu.game.estimator import GameEstimator
 
     iters = 3
-    platform, n_entities, data, config = _game_bench_fixture(
+    platform, (n_entities, _rows_mean), data, config = _game_bench_fixture(
         n_random_coords=3, descent_iterations=iters
     )
 
@@ -654,9 +664,10 @@ def _bench_recovery() -> None:
     from photon_tpu.telemetry import TelemetrySession
 
     iters = 3
-    platform, n_entities, data, config = _game_bench_fixture(
+    platform, sizes, data, config = _game_bench_fixture(
         n_random_coords=2, descent_iterations=iters
     )
+    n_entities, rows_mean = sizes
     tmp = tempfile.mkdtemp(prefix="photon-bench-recovery-")
     try:
         session = TelemetrySession("bench-recovery")
@@ -691,6 +702,32 @@ def _bench_recovery() -> None:
         estimator.fit([config], checkpoint_dir=ckpt_sync, resume="auto")
         restore = time.perf_counter() - t0
 
+        # Elastic restore: the SAME checkpoint restored in a subprocess
+        # under a forced 2-device CPU mesh — a different device count than
+        # wrote it (checkpoints are mesh-shape portable; the restored
+        # tables re-pad/re-shard onto the new mesh).  Subprocess because a
+        # device count cannot change after jax initializes in-process.
+        resharded_restore = None
+        worker_err = None
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import bench; bench._resharded_restore_worker"
+                 f"({ckpt_sync!r}, {n_entities}, {rows_mean}, {iters})"],
+                capture_output=True, text=True, timeout=900, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if proc.returncode == 0 and proc.stdout.strip():
+                payload = json.loads(proc.stdout.strip().splitlines()[-1])
+                resharded_restore = float(payload["restore_secs"])
+            else:
+                worker_err = (proc.stderr or "worker failed").strip()[-500:]
+        except Exception as ex:  # noqa: BLE001 — sub-metric isolation
+            worker_err = f"{type(ex).__name__}: {ex}"[:500]
+
         sync_premium = max(with_sync - plain, 0.0)
         async_premium = max(with_async - plain, 0.0)
         overhead_pct = (
@@ -718,8 +755,45 @@ def _bench_recovery() -> None:
         }
         _emit("game_checkpoint_secs", sync_write_mean, "s/iter", detail)
         _emit("game_checkpoint_overhead_pct", overhead_pct, "%", detail)
+        if resharded_restore is not None:
+            _emit("game_resharded_restore_secs", resharded_restore, "s", {
+                **detail,
+                "restore_devices": 2,
+                "restore_platform": "cpu (forced 2-device)",
+            })
+        else:
+            _emit("game_resharded_restore_error", 0.0, "error", {
+                "error": worker_err or "unknown",
+            })
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _resharded_restore_worker(ckpt_dir: str, n_entities: int,
+                              rows_mean: int, iters: int) -> None:
+    """Subprocess entry of the ``--mode recovery`` resharded-restore
+    sub-metric: rebuild the recovery fixture, construct a mesh over this
+    process's (forced, different) device count, and restore the completed
+    checkpoint chain onto it — no solves, pure load + re-pad + re-shard +
+    rebuild.  Prints one JSON line ``{"restore_secs": ...}``."""
+    import jax
+
+    from photon_tpu.game.estimator import GameEstimator
+    from photon_tpu.parallel.mesh import create_mesh
+
+    platform, _, data, config = _game_bench_fixture(
+        n_random_coords=2, descent_iterations=iters,
+        sizes=(n_entities, rows_mean),
+    )
+    assert platform == "cpu", "resharded restore is a forced-CPU check"
+    mesh = create_mesh()
+    estimator = GameEstimator("logistic_regression", data, mesh=mesh)
+    t0 = time.perf_counter()
+    estimator.fit([config], checkpoint_dir=ckpt_dir, resume="auto")
+    secs = time.perf_counter() - t0
+    print(json.dumps({
+        "restore_secs": round(secs, 4), "devices": len(jax.devices()),
+    }))
 
 
 def _generate_stream_files(

@@ -457,11 +457,38 @@ def streaming_lbfgs(
             fingerprint=fingerprint or {},
         )
 
+    from photon_tpu.fault.preemption import (
+        PreemptedError,
+        consume_preempt_injection,
+        preemption_requested,
+        preemption_reason,
+    )
+    from photon_tpu.fault.watchdog import heartbeat
+
     try:
         while reason == ConvergenceReason.NOT_CONVERGED:
             # The streamed-GLM preemption site: a killed fit restarts from
             # the last published mid-fit snapshot (the descent:kill analog).
             fault_point("stream:kill", iteration=it)
+            # Preemption-aware shutdown (SIGTERM, or the injected `preempt`
+            # site): the loop state is consistent here, so snapshot it NOW
+            # — off the checkpoint_every cadence if need be — drain the
+            # publisher so the save is durably published, and exit with
+            # the distinct preemption error the driver maps to exit 75.
+            consume_preempt_injection(it)
+            if preemption_requested():
+                if checkpointer is not None:
+                    checkpointer.save(snapshot(completed=False))
+                    checkpointer.drain()
+                    hint = "resume with --resume auto"
+                else:
+                    hint = ("no checkpointer configured — a restart begins "
+                            "from scratch (set --checkpoint-dir)")
+                raise PreemptedError(
+                    f"preempted ({preemption_reason()}) before streamed "
+                    f"L-BFGS iteration {it}; {hint}"
+                )
+            heartbeat("stream.iteration")
             reason, w, f, g, S, Y, rho, num_pairs, insert_pos, gamma, it = (
                 _stream_lbfgs_step(
                     objective, config, direction, m, dtype, reason, w, f, g,
@@ -477,6 +504,12 @@ def streaming_lbfgs(
         if checkpointer is not None:
             checkpointer.drain(reraise=False)
         raise
+    finally:
+        # Retire the iteration heartbeat: a finished (or dead) fit going
+        # quiet is not a stall the watchdog should flag.
+        from photon_tpu.fault.watchdog import complete
+
+        complete("stream.iteration")
     if checkpointer is not None:
         # Final snapshot: resume rebuilds the finished result without a
         # single streamed pass; the drain is the final-iteration barrier.

@@ -60,14 +60,33 @@ def add_telemetry_arg(parser: argparse.ArgumentParser) -> None:
 
 
 def add_fault_args(parser: argparse.ArgumentParser) -> None:
-    """Fault-injection flags shared by every driver (the CLI face of
-    :mod:`photon_tpu.fault.injection`; overrides ``PHOTON_FAULTS``)."""
+    """Fault-tolerance flags shared by every driver: fault injection (the
+    CLI face of :mod:`photon_tpu.fault.injection`; overrides
+    ``PHOTON_FAULTS``), preemption handling, and the run watchdog."""
     parser.add_argument("--faults", default=None, metavar="SPEC",
                         help="inject deterministic faults for recovery "
                         "testing, e.g. 'io:read:p=0.3,descent:kill:iter=2,"
-                        "solve:nan:coord=per_item' (overrides PHOTON_FAULTS)")
+                        "preempt:iter=1,solve:nan:coord=per_item' "
+                        "(overrides PHOTON_FAULTS)")
     parser.add_argument("--faults-seed", type=int, default=0,
                         help="seed of the fault plan's RNG streams")
+    parser.add_argument("--on-preempt", default="checkpoint",
+                        choices=("checkpoint", "ignore"),
+                        help="SIGTERM/SIGINT handling: 'checkpoint' "
+                        "(default) finishes the current iteration, "
+                        "publishes its checkpoint, and exits with code 75 "
+                        "(EX_TEMPFAIL) so wrappers can resubmit; 'ignore' "
+                        "leaves the default signal behavior (the atomic "
+                        "checkpoint protocol still preserves the previous "
+                        "published checkpoint)")
+    parser.add_argument("--stall-timeout", type=float, default=None,
+                        metavar="SECONDS",
+                        help="run watchdog: emit watchdog.stalled telemetry "
+                        "when iteration/IO progress heartbeats go silent "
+                        "for this long, and escalate a guarded-IO call "
+                        "hung past it to a retriable timeout (retried with "
+                        "backoff like any transient fault).  Default: "
+                        "PHOTON_STALL_TIMEOUT_S, else off")
 
 
 def add_common_args(parser: argparse.ArgumentParser) -> None:
@@ -151,24 +170,65 @@ def init_telemetry(args: argparse.Namespace, driver: str, logger) -> TelemetrySe
 
 
 @contextlib.contextmanager
-def telemetry_run(args: argparse.Namespace, driver: str, logger):
+def telemetry_run(args: argparse.Namespace, driver: str, logger,
+                  preemptible: bool = False):
     """Run-report bracket around a driver body: yields the session, then
     finalizes it into ``<output-dir>/telemetry/`` — with status "error" and
     the exception recorded when the body raises (failed runs leave a report
     saying where they died, the observability the reference gets from
-    trawling driver logs).  Bodies of multi-process drivers set
-    ``session.write = (process_index == 0)`` once they know their rank;
-    until then the operator-declared ``--process-id`` gates writing, so a
-    failure before that point (bad input path on every rank) cannot have N
-    processes concurrently writing the same run_report.json."""
+    trawling driver logs), or status "preempted" when the body stopped at
+    an iteration boundary on a preemption request.  Bodies of multi-process
+    drivers set ``session.write = (process_index == 0)`` once they know
+    their rank; until then the operator-declared ``--process-id`` gates
+    writing, so a failure before that point (bad input path on every rank)
+    cannot have N processes concurrently writing the same run_report.json.
+
+    Also the one installation point of the run-scoped resilience machinery
+    every driver shares: the ``--on-preempt`` SIGTERM/SIGINT handler
+    (restored on exit), the ``--stall-timeout`` watchdog thread, and the
+    stall-timeout override the guarded-IO retry layer reads.
+
+    ``preemptible``: only the TRAINING drivers pass True — their loops
+    poll the preemption flag at iteration boundaries.  Everything else
+    keeps stock signal behavior: installing a flag-setting handler in a
+    driver nothing polls would swallow Ctrl-C outright."""
     from photon_tpu.fault.injection import install_from_args, set_plan
+    from photon_tpu.fault.preemption import PreemptedError, PreemptionHandler
+    from photon_tpu.fault.watchdog import (
+        Watchdog,
+        clear_heartbeats,
+        set_stall_timeout,
+        stall_timeout,
+    )
 
     install_from_args(args)  # --faults SPEC (no-op without the flag)
     session = init_telemetry(args, driver, logger)
     if getattr(args, "coordinator", None) is not None:
         session.write = (getattr(args, "process_id", None) or 0) == 0
+    flag_timeout = getattr(args, "stall_timeout", None)
+    if flag_timeout is not None:
+        set_stall_timeout(flag_timeout)
+    watchdog = None
+    if stall_timeout() > 0:
+        watchdog = Watchdog(
+            stall_timeout(), telemetry=session, logger=logger
+        ).start()
+    handler = PreemptionHandler(
+        (getattr(args, "on_preempt", None) or "checkpoint")
+        if preemptible else "ignore",
+        logger=logger,
+    )
     try:
-        yield session
+        with handler:
+            yield session
+    except PreemptedError as e:
+        # A preemption is a CLEAN exit (checkpoint published, distinct
+        # exit code) — the report says so instead of reading like a crash.
+        session.finalize(
+            getattr(args, "output_dir", None), status="preempted",
+            error=str(e),
+        )
+        raise
     except BaseException as e:
         session.finalize(
             getattr(args, "output_dir", None), status="error",
@@ -178,10 +238,36 @@ def telemetry_run(args: argparse.Namespace, driver: str, logger):
     else:
         session.finalize(getattr(args, "output_dir", None))
     finally:
+        if watchdog is not None:
+            watchdog.stop()
+        # Run-scoped: the stall timeout, progress heartbeats, and any
+        # --faults plan must not leak into a later in-process run.
+        set_stall_timeout(None)
+        clear_heartbeats()
         if getattr(args, "faults", None):
             # A --faults plan is scoped to THIS run: clear it so a later
             # in-process driver run without the flag is not injected.
             set_plan(None)
+
+
+def run_cli(run_fn, args: argparse.Namespace) -> None:
+    """Driver ``main()`` tail: run the driver and map a preemption stop to
+    the distinct :data:`~photon_tpu.fault.preemption.PREEMPTED_EXIT_CODE`
+    (75, EX_TEMPFAIL) — schedulers and run wrappers can then resubmit a
+    preempted run instead of treating it as a crash.  Everything else
+    propagates unchanged."""
+    from photon_tpu.fault.preemption import (
+        PREEMPTED_EXIT_CODE,
+        PreemptedError,
+    )
+
+    try:
+        run_fn(args)
+    except PreemptedError as e:
+        import sys
+
+        print(f"preempted: {e}", file=sys.stderr)
+        raise SystemExit(PREEMPTED_EXIT_CODE)
 
 
 def add_data_args(parser: argparse.ArgumentParser) -> None:

@@ -187,6 +187,7 @@ def run(args: argparse.Namespace) -> dict:
 
 
 def _run(args: argparse.Namespace, logger, session) -> dict:
+    from photon_tpu.fault.injection import fault_point
     from photon_tpu.fault.retry import retry_call
     from photon_tpu.game.model_io import load_game_model
 
@@ -209,8 +210,14 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
 
     with logger.timed("load-data"):
         # Index scoring features through the model's training-time maps —
-        # unseen features drop, matching the reference's fixed-index scoring.
-        data, _ = _load_game_data(args.input, args, index_maps=index_maps)
+        # unseen features drop, matching the reference's fixed-index
+        # scoring.  The session rides along so the guarded Avro reads'
+        # io.retries land in THIS run's report — the same fault/retry
+        # visibility the train drivers have (the streamed path below
+        # already plumbed it).
+        data, _ = _load_game_data(
+            args.input, args, index_maps=index_maps, telemetry=session
+        )
         logger.info("scoring %d examples", data.num_examples)
         session.gauge("score.num_scored").set(data.num_examples)
 
@@ -226,7 +233,38 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
             )
         else:
             out_scores = raw_scores
-    np.savetxt(os.path.join(args.output_dir, "scores.txt"), out_scores, fmt="%.8g")
+
+    def _write_scores():
+        # io:write fault window + retry, published ATOMICALLY: each attempt
+        # writes a fresh temp file and renames it into place.  Plain
+        # in-place rewrites would be retry-safe only for sequential
+        # attempts — under a stall-timeout escalation the abandoned hung
+        # attempt can unwedge later and keep writing, and two writers
+        # interleaving into one truncated file is silent corruption.  With
+        # per-attempt temps the late writer at worst re-publishes identical
+        # complete content.
+        import tempfile
+
+        fault_point("io:write", path="scores.txt")
+        fd, tmp = tempfile.mkstemp(
+            prefix=".scores-", suffix=".tmp", dir=args.output_dir
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                np.savetxt(f, out_scores, fmt="%.8g")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(args.output_dir, "scores.txt"))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    retry_call(
+        _write_scores, site="scores:write", telemetry=session, logger=logger
+    )
 
     metrics = {}
     if args.evaluators:

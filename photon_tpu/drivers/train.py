@@ -76,27 +76,35 @@ def build_parser() -> argparse.ArgumentParser:
                    "a feature-indexing run) — skips the full metadata "
                    "parse in favor of a cheap row/nnz scan")
     p.add_argument("--checkpoint-dir", default=None,
-                   help="with --stream: preemption-safe mid-fit L-BFGS "
-                   "checkpoints — every --checkpoint-every iterations the "
-                   "full loop state (iterate, gradient, curvature pairs, "
-                   "history) is published atomically under this directory "
-                   "(one lam-NNN chain per sweep weight; rank 0 writes)")
+                   help="preemption-safe sweep checkpoints under this "
+                   "directory (one lam-NNN chain per sweep weight; rank 0 "
+                   "writes).  With --stream: the full mid-fit L-BFGS loop "
+                   "state every --checkpoint-every iterations.  Resident "
+                   "path: one completed snapshot per finished lambda, so "
+                   "a killed sweep resumes without re-fitting finished "
+                   "weights")
     p.add_argument("--checkpoint-every", type=int, default=1,
                    help="with --stream + --checkpoint-dir: snapshot every "
                    "N L-BFGS iterations (each iteration is >= one full "
                    "streamed pass, so the default checkpoints every "
-                   "iteration)")
+                   "iteration).  The resident path checkpoints per "
+                   "completed lambda and ignores this")
     p.add_argument("--checkpoint-async", default=None, choices=("on", "off"),
-                   help="publish streamed checkpoints from a background "
-                   "thread (default on, or PHOTON_CHECKPOINT_ASYNC); "
-                   "'off' restores inline synchronous writes")
+                   help="publish checkpoints from a background thread "
+                   "(default on, or PHOTON_CHECKPOINT_ASYNC); 'off' "
+                   "restores inline synchronous writes")
+    p.add_argument("--checkpoint-max-staged-mb", type=float, default=None,
+                   help="cap the async publisher's staged host copies: a "
+                   "snapshot over this many MB publishes blocking instead "
+                   "of holding a second snapshot-sized host allocation "
+                   "(PHOTON_CHECKPOINT_MAX_STAGED_MB; default unbounded)")
     p.add_argument("--resume", default=None, choices=("auto", "latest"),
-                   help="with --stream + --checkpoint-dir: restore the "
-                   "sweep from its checkpoints — completed weights are "
-                   "rebuilt from their final snapshots without streaming "
-                   "a pass, the interrupted weight continues mid-fit; "
-                   "'latest' requires a published checkpoint, 'auto' "
-                   "starts fresh when there is none")
+                   help="with --checkpoint-dir: restore the sweep from its "
+                   "checkpoints — completed weights are rebuilt from their "
+                   "final snapshots without re-fitting (streamed: without "
+                   "streaming a pass; the interrupted streamed weight "
+                   "continues mid-fit); 'latest' requires a published "
+                   "checkpoint, 'auto' starts fresh when there is none")
     return p
 
 
@@ -130,23 +138,7 @@ def _run_streaming(args: argparse.Namespace, logger, session) -> dict:
     if args.optimizer != "lbfgs" or args.reg_type in ("l1", "elastic_net"):
         raise ValueError("--stream supports the lbfgs optimizer with l2/none "
                          "regularization")
-    from photon_tpu.fault.checkpoint import (
-        CheckpointError,
-        StreamCheckpointer,
-        has_published_checkpoint,
-    )
-
-    if args.resume and not args.checkpoint_dir:
-        raise ValueError("--resume needs --checkpoint-dir")
-    if args.resume == "latest" and not has_published_checkpoint(
-        args.checkpoint_dir
-    ):
-        # Same strictness rule as the GAME driver: 'latest' means a
-        # PUBLISHED checkpoint, not .tmp debris from a pre-publish kill.
-        raise ValueError(
-            f"--resume latest: no published checkpoint under "
-            f"{args.checkpoint_dir!r}"
-        )
+    from photon_tpu.fault.checkpoint import StreamCheckpointer
 
     if os.path.isdir(args.input):
         files = sorted(
@@ -298,19 +290,18 @@ def _run_streaming(args: argparse.Namespace, logger, session) -> dict:
                 os.path.join(args.checkpoint_dir, f"lam-{i:03d}"),
                 telemetry=session, logger=logger,
                 async_publish=args.checkpoint_async,
+                max_staged_mb=args.checkpoint_max_staged_mb,
             )
             if args.resume:
                 # Per-weight resume is auto-style: weights the interrupted
                 # run never reached have no chain and start fresh (the
                 # 'latest' strictness was enforced up front).
-                resume_state = checkpointer.load("auto")
-                if (resume_state is not None
-                        and resume_state.fingerprint != fingerprint):
-                    raise CheckpointError(
-                        f"checkpoint fingerprint {resume_state.fingerprint} "
-                        f"does not match lambda={lam:g} ({fingerprint}); "
-                        "refusing to resume"
-                    )
+                from photon_tpu.fault.checkpoint import require_fingerprint
+
+                resume_state = require_fingerprint(
+                    checkpointer.load("auto"), fingerprint,
+                    f"lambda={lam:g}",
+                )
         with logger.timed(f"train-lambda-{lam}"):
             t0 = time.monotonic()
             result = streaming_lbfgs(
@@ -364,15 +355,22 @@ def run(args: argparse.Namespace) -> dict:
     from photon_tpu.utils import PhotonLogger
 
     logger = PhotonLogger("photon_tpu.train", args.log_file)
-    with common.telemetry_run(args, "train", logger) as session:
-        if not getattr(args, "stream", False) and (
-            args.checkpoint_dir or args.resume
-        ):
-            raise ValueError(
-                "--checkpoint-dir/--resume apply to --stream training "
-                "(the resident-data sweep re-fits in seconds; mid-fit "
-                "checkpoints exist for streamed passes that cost minutes)"
-            )
+    with common.telemetry_run(
+        args, "train", logger, preemptible=True
+    ) as session:
+        # Shared --resume strictness of BOTH data paths ('latest' means a
+        # PUBLISHED checkpoint, not .tmp debris) — validated before any
+        # data work.
+        if args.resume and not args.checkpoint_dir:
+            raise ValueError("--resume needs --checkpoint-dir")
+        if args.resume == "latest":
+            from photon_tpu.fault.checkpoint import has_published_checkpoint
+
+            if not has_published_checkpoint(args.checkpoint_dir):
+                raise ValueError(
+                    f"--resume latest: no published checkpoint under "
+                    f"{args.checkpoint_dir!r}"
+                )
         if getattr(args, "stream", False):
             return _run_streaming(args, logger, session)
         if distributed:
@@ -419,6 +417,9 @@ def _run_resident(args: argparse.Namespace, logger, session) -> dict:
             avro_field=args.avro_feature_field, index_map=index_map,
         )
         logger.info("train: %d examples, %d features", batch.num_examples, dim)
+        # Logical row count, captured BEFORE any mesh padding below: the
+        # resident checkpoint fingerprint must be mesh-shape independent.
+        n_examples = batch.num_examples
         session.gauge("train.num_examples").set(batch.num_examples)
         session.gauge("train.num_features").set(dim)
 
@@ -482,42 +483,169 @@ def _run_resident(args: argparse.Namespace, logger, session) -> dict:
         logger.warning("reg-type %s requires owlqn; switching optimizer", args.reg_type)
         optimizer = "owlqn"
 
+    # Minimal resident checkpoint/resume (ROADMAP known edge): one
+    # COMPLETED snapshot per finished lambda, in the StreamCheckpointer's
+    # state shape — a killed sweep resumes by rebuilding finished weights
+    # from their snapshots instead of re-fitting them.  (Mid-fit
+    # granularity stays a --stream feature: a resident fit is one jitted
+    # optimizer run with no interior host loop to snapshot.)
+    from photon_tpu.core.optimizers.base import OptimizerResult
+    from photon_tpu.fault.checkpoint import (
+        StreamCheckpointer,
+        StreamState,
+        require_fingerprint,
+    )
+    from photon_tpu.fault.preemption import (
+        PreemptedError,
+        preemption_requested,
+        preemption_reason,
+    )
+
     sweep = []
     w_start = jnp.zeros(dim, jnp.float32)
-    for lam in lambdas:
+    for i, lam in enumerate(lambdas):
+        # The resident path's preemption boundary: between lambdas (each
+        # lambda is one jitted solve with no interior host loop).  Every
+        # finished lambda is already checkpointed, so stopping here loses
+        # nothing resumable.
+        if preemption_requested():
+            hint = (
+                "resume with --resume auto" if args.checkpoint_dir
+                else "no --checkpoint-dir — a restart begins from scratch"
+            )
+            raise PreemptedError(
+                f"preempted ({preemption_reason()}) before lambda={lam:g}; "
+                f"{hint}"
+            )
         reg = RegularizationContext(args.reg_type, lam, args.elastic_net_alpha)
-        obj = GlmObjective.create(args.task, reg, normalization=norm)
-        objective = obj if mesh is None else DistributedGlmObjective(obj, mesh)
-        problem = GlmOptimizationProblem(
-            objective,
-            ProblemConfig(
-                optimizer=optimizer,
-                regularization=reg,
-                optimizer_config=opt_config,
-                variance_computation=args.variance_computation,
-            ),
-        )
-        with logger.timed(f"train-lambda-{lam}"), maybe_profile(args.profile_dir):
-            t0 = time.monotonic()
-            coefficients, result = problem.run(batch, w_start)
-            jax.block_until_ready(coefficients.means)
-            wall = time.monotonic() - t0
-        if args.sweep_warm_start:
-            # Next lambda starts from this optimum (normalized space — the
-            # original-space conversion below works on copies).
-            w_start = coefficients.means
+        # What makes a snapshot THIS lambda's completed fit.  Unlike the
+        # streamed fingerprint, max_iterations IS pinned: only the final
+        # state is snapshotted, so a raised budget cannot continue a
+        # completed resident fit — it must refuse and re-fit.
+        fingerprint = {
+            "kind": StreamCheckpointer.KIND,
+            "path": "resident",
+            "task": args.task,
+            "optimizer": optimizer,
+            "reg_type": args.reg_type,
+            "lambda": lam,
+            "alpha": args.elastic_net_alpha,
+            "dim": int(dim),
+            "num_examples": int(n_examples),
+            "intercept": bool(args.intercept),
+            "normalization": args.normalization,
+            "dtype": args.dtype,
+            "variance": args.variance_computation,
+            "warm_start": bool(args.sweep_warm_start),
+            "max_iterations": int(opt_config.max_iterations),
+            "tolerance": float(opt_config.tolerance),
+        }
+        checkpointer = resume_state = None
+        if args.checkpoint_dir:
+            checkpointer = StreamCheckpointer(
+                os.path.join(args.checkpoint_dir, f"lam-{i:03d}"),
+                telemetry=session, logger=logger,
+                async_publish=args.checkpoint_async,
+                max_staged_mb=args.checkpoint_max_staged_mb,
+            )
+            if args.resume:
+                resume_state = require_fingerprint(
+                    checkpointer.load("auto"), fingerprint,
+                    f"lambda={lam:g}",
+                )
+        if resume_state is not None and resume_state.completed:
+            # Finished weight: rebuild model + convergence record from the
+            # snapshot, zero solves.  The solver-space iterate (w_opt)
+            # restores the warm-start chain exactly, so later un-resumed
+            # lambdas fit from the same start the uninterrupted sweep used.
+            arrays_ = resume_state.arrays
+            result = OptimizerResult(
+                w=jnp.asarray(arrays_["w_opt"]),
+                value=jnp.asarray(float(resume_state.scalars["f"])),
+                grad_norm=jnp.asarray(float(resume_state.scalars["gnorm"])),
+                iterations=jnp.asarray(resume_state.iteration, jnp.int32),
+                converged=jnp.asarray(
+                    bool(resume_state.scalars.get("converged", False))
+                ),
+                reason=jnp.asarray(int(resume_state.reason), jnp.int32),
+                history_value=jnp.asarray(arrays_["hv"]),
+                history_grad_norm=jnp.asarray(arrays_["hg"]),
+                history_valid=jnp.asarray(arrays_["hvalid"]),
+            )
+            wall = 0.0
+            means = jnp.asarray(arrays_["means"])
+            variances = (
+                jnp.asarray(arrays_["variances"])
+                if "variances" in arrays_ else None
+            )
+            if args.sweep_warm_start:
+                w_start = result.w
+            session.counter("train.lambdas_resumed").inc()
+            logger.info(
+                "lambda=%g restored from completed checkpoint (no refit)",
+                lam,
+            )
+        else:
+            obj = GlmObjective.create(args.task, reg, normalization=norm)
+            objective = (
+                obj if mesh is None else DistributedGlmObjective(obj, mesh)
+            )
+            problem = GlmOptimizationProblem(
+                objective,
+                ProblemConfig(
+                    optimizer=optimizer,
+                    regularization=reg,
+                    optimizer_config=opt_config,
+                    variance_computation=args.variance_computation,
+                ),
+            )
+            with logger.timed(f"train-lambda-{lam}"), \
+                    maybe_profile(args.profile_dir):
+                t0 = time.monotonic()
+                coefficients, result = problem.run(batch, w_start)
+                jax.block_until_ready(coefficients.means)
+                wall = time.monotonic() - t0
+            if args.sweep_warm_start:
+                # Next lambda starts from this optimum (normalized space —
+                # the original-space conversion below works on copies).
+                w_start = coefficients.means
+            # Store the model in the original feature space (variances too
+            # — mixing original-space means with normalized-space variances
+            # would mis-scale the GLMix posterior by factor^2/coordinate).
+            means = coefficients.means
+            variances = coefficients.variances
+            if norm is not None:
+                means = norm.model_to_original_space(means)
+                variances = norm.variances_to_original_space(variances)
+            if checkpointer is not None:
+                arrays_ = {
+                    # Solver-space iterate (the warm-start chain) AND the
+                    # original-space model are both snapshotted; history
+                    # buffers make the convergence trace restorable.
+                    "w_opt": coefficients.means,
+                    "means": means,
+                    "hv": result.history_value,
+                    "hg": result.history_grad_norm,
+                    "hvalid": result.history_valid,
+                }
+                if variances is not None:
+                    arrays_["variances"] = variances
+                checkpointer.save(StreamState(
+                    iteration=int(result.iterations),
+                    arrays=arrays_,
+                    scalars={
+                        "f": float(result.value),
+                        "gnorm": float(result.grad_norm),
+                        "converged": bool(result.converged),
+                    },
+                    completed=True,
+                    reason=int(result.reason),
+                    fingerprint=fingerprint,
+                ))
+                checkpointer.drain()
         tracker = OptimizationStatesTracker(result, wall)
         tracker.record_to(session.registry, optimizer=optimizer, lam=f"{lam:g}")
         logger.info("lambda=%g %s", lam, tracker.summary().splitlines()[0])
-
-        # Store the model in the original feature space (variances too —
-        # mixing original-space means with normalized-space variances would
-        # mis-scale the GLMix posterior by factor^2 per coordinate).
-        means = coefficients.means
-        variances = coefficients.variances
-        if norm is not None:
-            means = norm.model_to_original_space(means)
-            variances = norm.variances_to_original_space(variances)
         model = model_for_task(args.task, Coefficients(means, variances))
 
         metrics = {}
@@ -547,7 +675,9 @@ def _run_resident(args: argparse.Namespace, logger, session) -> dict:
 
 
 def main(argv=None) -> None:
-    run(build_parser().parse_args(argv))
+    # PreemptedError -> exit 75 (EX_TEMPFAIL): a preempted run is a clean,
+    # resumable stop, not a crash.
+    common.run_cli(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

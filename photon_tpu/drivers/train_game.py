@@ -131,13 +131,22 @@ def build_parser() -> argparse.ArgumentParser:
                    "the serialize+fsync+rename runs behind the next "
                    "iteration's compute; LATEST may lag the loop by one "
                    "iteration.  'off' restores inline synchronous writes")
+    p.add_argument("--checkpoint-max-staged-mb", type=float, default=None,
+                   help="cap the async publisher's staged host copies "
+                   "(checkpoint.staged_bytes): a snapshot over this many "
+                   "MB publishes blocking on the loop thread instead of "
+                   "holding a second snapshot-sized host allocation while "
+                   "training runs ahead.  Default: "
+                   "PHOTON_CHECKPOINT_MAX_STAGED_MB, else unbounded")
     p.add_argument("--resume", default=None, metavar="auto|latest|PATH",
                    help="restore a descent mid-sweep from --checkpoint-dir: "
                    "'auto' resumes whatever is checkpointed (fresh start "
                    "otherwise), 'latest' requires a checkpoint, a path "
                    "names one checkpoint version directory.  Completed "
                    "sweep entries are rebuilt from their snapshots without "
-                   "re-running; a resumed fit matches an uninterrupted one")
+                   "re-running; a resumed fit matches an uninterrupted one "
+                   "— including on a DIFFERENT device/process count "
+                   "(checkpoints are mesh-shape portable)")
     p.add_argument("--max-quarantined", type=int, default=8,
                    help="how many non-finite solves/score rows may be "
                    "quarantined (previous iterate kept, descent.quarantined "
@@ -381,7 +390,9 @@ def run(args: argparse.Namespace) -> dict:
     from photon_tpu.utils import PhotonLogger
 
     logger = PhotonLogger("photon_tpu.train_game", args.log_file)
-    with common.telemetry_run(args, "train_game", logger) as session:
+    with common.telemetry_run(
+        args, "train_game", logger, preemptible=True
+    ) as session:
         return _run(args, logger, session)
 
 
@@ -588,6 +599,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
             checkpoint_dir=ckpt_dir, resume=resume,
             max_quarantined=max_quarantined,
             checkpoint_async=args.checkpoint_async,
+            checkpoint_max_staged_mb=args.checkpoint_max_staged_mb,
         )[0]
         results.append(result)
         if (args.checkpoint or args.save_all_models) and is_primary:
@@ -693,7 +705,9 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
 
 
 def main(argv=None) -> None:
-    run(build_parser().parse_args(argv))
+    # PreemptedError -> exit 75 (EX_TEMPFAIL): a preempted run is a clean,
+    # resumable stop, not a crash.
+    common.run_cli(run, build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

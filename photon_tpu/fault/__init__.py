@@ -10,9 +10,16 @@ The pieces (see each module's docstring for the full story):
   inter-iteration kills, and NaN solves at named sites, so the recovery
   paths are CI-testable.
 - :mod:`photon_tpu.fault.retry` — jittered, capped, telemetry-counted
-  exponential backoff around guarded IO.
+  exponential backoff around guarded IO (with optional per-attempt stall
+  timeouts escalating hung calls to retriable failures).
 - :mod:`photon_tpu.fault.atomic` — write-to-temp + fsync + rename
   publication and content-hash manifests.
+- :mod:`photon_tpu.fault.preemption` — SIGTERM/SIGINT → checkpoint at the
+  next iteration boundary → exit :data:`PREEMPTED_EXIT_CODE` (the elastic
+  spot/preemptible-capacity story; ``--on-preempt``).
+- :mod:`photon_tpu.fault.watchdog` — heartbeat-based stall detection
+  (``watchdog.stalled`` telemetry) and the guarded-IO timeout
+  (``--stall-timeout``).
 
 :class:`QuarantineBudgetError` is raised by the descent loop when more
 buckets/coordinates were quarantined (non-finite solves or score rows kept
@@ -39,6 +46,7 @@ from photon_tpu.fault.checkpoint import (  # noqa: F401
     resolve_checkpoint_async,
 )
 from photon_tpu.fault.injection import (  # noqa: F401
+    KNOWN_FAULT_SITES,
     FaultPlan,
     InjectedFaultError,
     InjectedIOError,
@@ -49,11 +57,25 @@ from photon_tpu.fault.injection import (  # noqa: F401
     install_from_args,
     set_plan,
 )
+from photon_tpu.fault.preemption import (  # noqa: F401
+    PREEMPTED_EXIT_CODE,
+    PreemptedError,
+    PreemptionHandler,
+    clear_preemption,
+    preemption_requested,
+    request_preemption,
+)
 from photon_tpu.fault.retry import (  # noqa: F401
     RETRY_TOTALS,
     RetryPolicy,
     default_policy,
     retry_call,
+)
+from photon_tpu.fault.watchdog import (  # noqa: F401
+    IOStallTimeoutError,
+    Watchdog,
+    call_with_timeout,
+    heartbeat,
 )
 
 

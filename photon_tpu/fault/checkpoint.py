@@ -39,6 +39,28 @@ was still in flight (the previous published checkpoint stays intact; the
 same atomic temp+fsync+rename protocol runs on the publisher thread, and
 the ``checkpoint:stage`` / ``checkpoint:write`` fault sites keep firing
 inside its staging and torn-write windows).
+
+Mesh-shape portability (elastic resume): a checkpoint records only the
+LOGICAL layout of the fit — unpadded row counts, per-coordinate entity
+vocabularies and dimensions (the ``layout`` payload section, digested into
+the manifest) — never the mesh shape that wrote it.  Score rows are
+snapshotted trimmed to the logical length, model tables at their logical
+``[entities, dim]`` shape, and every padded/sharded device buffer is
+rebuilt at load time against the RESUMING run's mesh
+(:func:`photon_tpu.parallel.mesh.reshard_to_mesh` and the engines'
+``load_rows``).  The compatibility fingerprint pins the logical layout and
+deliberately contains NO device-, process-, or mesh-shape component — so a
+fit written on N processes/devices resumes on M (preemptible capacity,
+mid-sweep mesh resizes), and the resumed state is bit-identical to the
+saved one.
+
+Host-side RSS bound: the async publisher holds one in-flight snapshot's
+staged host copies.  ``checkpoint.staged_bytes`` gauges that residency,
+and ``max_staged_mb`` (``--checkpoint-max-staged-mb``;
+``PHOTON_CHECKPOINT_MAX_STAGED_MB``) caps it — a snapshot over the cap
+publishes BLOCKING on the loop thread (``checkpoint.staged_fallback_sync``
+counts the fallbacks) instead of holding a second GB-scale snapshot while
+the loop runs ahead.
 """
 
 from __future__ import annotations
@@ -219,6 +241,15 @@ class AsyncPublisher:
             self._job = (fn, time.monotonic())
             self._job_ready.notify()
 
+    def wait(self, reraise: bool = True) -> None:
+        """Block until the in-flight publish (if any) lands, WITHOUT
+        stopping the thread — the blocking-save fallback's barrier (the
+        staged-bytes cap publishes synchronously but keeps the publisher
+        alive for later, smaller snapshots)."""
+        self._idle.wait()
+        if reraise:
+            self._raise_pending()
+
     def drain(self, reraise: bool = True) -> None:
         """Wait out the in-flight publish and stop the thread.  With
         ``reraise`` (the final-iteration barrier) a pending publish failure
@@ -238,25 +269,61 @@ class AsyncPublisher:
             self._error = None
 
 
+def logical_layout(num_examples: int, coordinate_kinds=None) -> dict:
+    """The MESH-INDEPENDENT layout of a descent run: logical (unpadded)
+    training row count plus each coordinate's kind in update order.  This —
+    not any padded shape, shard count, or device count — is what a
+    checkpoint pins: padding and sharding are derived from whatever mesh
+    the resuming run constructs (reshard_to_mesh)."""
+    return {
+        "rows": int(num_examples),
+        "coordinates": {
+            str(name): str(kind)
+            for name, kind in (coordinate_kinds or {}).items()
+        },
+    }
+
+
+def layout_digest(layout: dict) -> str:
+    """Stable digest of a logical layout.  Stamped into the checkpoint
+    manifest so tools (and operators) can identify a checkpoint's logical
+    shape without opening ``arrays.npz``; the descent load path
+    cross-checks it against the payload's layout, so the two can never
+    silently disagree (mixed-version artifacts, writer bugs)."""
+    import hashlib
+
+    return hashlib.sha256(
+        json.dumps(layout, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
 def descent_fingerprint(
     task_type: str, coordinate_names, num_examples: int, residual_mode: str,
     config_key: Optional[str] = None,
     validation_key: Optional[str] = None,
     locked=(),
     warm_start: bool = False,
+    coordinate_kinds=None,
 ) -> dict:
     """The ONE definition of a descent run's checkpoint-compatibility
     fingerprint (descent and estimator both check against it): a resumed
     run must be the same descent — same task, coordinate update sequence,
-    data size, residual mode, optimization configuration (when the caller
-    supplies a key), validation setup (primary evaluator, or None for an
-    unevaluated fit), lock list, and warm-start-ness — or the restored
-    state would silently be another run's model (or crash on a
-    best-metrics shape it never tracked)."""
+    LOGICAL layout (row count + per-coordinate kinds, via
+    :func:`logical_layout`), residual mode, optimization configuration
+    (when the caller supplies a key), validation setup (primary evaluator,
+    or None for an unevaluated fit), lock list, and warm-start-ness — or
+    the restored state would silently be another run's model (or crash on
+    a best-metrics shape it never tracked).
+
+    Deliberately ABSENT: any device-count-, process-count-, or mesh-shape-
+    dependent component.  Mesh shape is an execution choice, not an
+    identity of the fit — dropping it from the fingerprint is what makes
+    checkpoints elastic (a fit written on N devices resumes on M; the
+    padded/sharded buffers are rebuilt for the resuming mesh at load)."""
     fp = {
         "task_type": task_type,
         "coordinates": list(coordinate_names),
-        "num_examples": int(num_examples),
+        "layout": logical_layout(num_examples, coordinate_kinds),
         "residual_mode": residual_mode,
         "validation": validation_key,
         "locked": sorted(locked),
@@ -265,6 +332,19 @@ def descent_fingerprint(
     if config_key is not None:
         fp["config"] = config_key
     return fp
+
+
+def require_fingerprint(state, expected: dict, what: str):
+    """The ONE refusal: pass ``state`` through unless its fingerprint
+    differs from ``expected``, in which case raise :class:`CheckpointError`
+    naming ``what`` the checkpoint failed to match.  ``state`` may be None
+    (nothing checkpointed yet — auto resume starts fresh)."""
+    if state is not None and state.fingerprint != expected:
+        raise CheckpointError(
+            f"checkpoint fingerprint {state.fingerprint} does not match "
+            f"{what} ({expected}); refusing to resume"
+        )
+    return state
 
 
 def configuration_key(coordinate_configs: dict) -> str:
@@ -342,27 +422,40 @@ def _models_to_arrays(prefix: str, models: Dict[str, object]):
     return arrays, meta
 
 
-def _models_from_arrays(prefix: str, meta: List[dict], arrays, task_type: str):
+def _models_from_arrays(prefix: str, meta: List[dict], arrays, task_type: str,
+                        mesh=None):
+    """Rebuild coordinate models from checkpointed host arrays.
+
+    Tables come back at their LOGICAL ``[entities, dim]`` shapes; with a
+    ``mesh`` they are placed replicated over it (the SPMD-correct placement
+    for model state every shard reads whole — the elastic-resume leg: the
+    mesh here is the RESUMING run's, any shape), single-device otherwise.
+    Bulk per-row state (score rows) is re-padded/re-sharded separately by
+    the engines' ``load_rows``."""
     from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
     from photon_tpu.models.glm import Coefficients, model_for_task
+    from photon_tpu.parallel.mesh import put_replicated
+
+    def place(host):
+        return put_replicated(jnp.asarray(host), mesh)
 
     models = {}
     for i, m in enumerate(meta):
         key = f"{prefix}{i}__"
         variances = (
-            jnp.asarray(arrays[key + "variances"]) if m["has_variances"] else None
+            place(arrays[key + "variances"]) if m["has_variances"] else None
         )
         if m["kind"] == "fixed":
             glm = model_for_task(
                 task_type,
-                Coefficients(jnp.asarray(arrays[key + "means"]), variances),
+                Coefficients(place(arrays[key + "means"]), variances),
             )
             models[m["name"]] = FixedEffectModel(
                 model=glm, shard_name=m["shard_name"]
             )
         else:
             models[m["name"]] = RandomEffectModel(
-                table=jnp.asarray(arrays[key + "table"]),
+                table=place(arrays[key + "table"]),
                 # host-sync: checkpointed key vocabularies are host data.
                 keys=np.asarray(arrays[key + "keys"]),
                 entity_column=m["entity_column"],
@@ -386,11 +479,14 @@ class CheckpointPublisherBase:
     (older checkpoints are pruned after a successful publish).
     ``async_publish`` (default: :func:`resolve_checkpoint_async`) routes
     publishes through a dedicated :class:`AsyncPublisher` thread.
+    ``max_staged_mb`` (default ``PHOTON_CHECKPOINT_MAX_STAGED_MB``, else
+    unbounded) caps the host RSS the async path may hold in staged
+    snapshot copies: a snapshot over the cap publishes BLOCKING instead.
     """
 
     def __init__(self, directory: str, telemetry=None, logger=None,
                  keep: int = 2, write: Optional[bool] = None,
-                 async_publish=None):
+                 async_publish=None, max_staged_mb: Optional[float] = None):
         self.directory = directory
         self.telemetry = telemetry or NULL_SESSION
         self.logger = logger
@@ -399,6 +495,18 @@ class CheckpointPublisherBase:
         self.async_publish = resolve_checkpoint_async(async_publish)
         self._publisher = (
             AsyncPublisher(self.telemetry) if self.async_publish else None
+        )
+        if max_staged_mb is None:
+            raw = os.environ.get(
+                "PHOTON_CHECKPOINT_MAX_STAGED_MB", ""
+            ).strip()
+            try:
+                max_staged_mb = float(raw) if raw else None
+            except ValueError:
+                max_staged_mb = None
+        self.max_staged_bytes = (
+            None if max_staged_mb is None or max_staged_mb < 0
+            else int(max_staged_mb * (1 << 20))
         )
 
     # -- helpers -------------------------------------------------------------
@@ -445,6 +553,10 @@ class CheckpointPublisherBase:
         # publish rename) leaves the previously published chain untouched.
         fault_point("checkpoint:stage", iteration=iteration)
         staged = stage_to_host(arrays, telemetry=self.telemetry)
+        staged_bytes = sum(a.nbytes for a in staged.values())
+        # The async publisher's extra host residency is exactly one staged
+        # snapshot (bounded depth 1): make it visible, and bound it.
+        self.telemetry.gauge("checkpoint.staged_bytes").set(staged_bytes)
         final = os.path.join(self.directory, self._ckpt_name(iteration))
 
         def publish() -> str:
@@ -455,6 +567,22 @@ class CheckpointPublisherBase:
             )
 
         if self._publisher is None:
+            publish()
+        elif (self.max_staged_bytes is not None
+                and staged_bytes > self.max_staged_bytes):
+            # Over the staged-RSS cap: publish BLOCKING on the loop thread
+            # (after surfacing any previous in-flight failure) — the loop
+            # pays the serialize+fsync wall clock, and the process never
+            # holds this snapshot's host copies while running ahead.
+            self._publisher.wait()
+            self.telemetry.counter("checkpoint.staged_fallback_sync").inc()
+            if self.logger is not None:
+                self.logger.info(
+                    "checkpoint: staged snapshot %.1f MB over the "
+                    "--checkpoint-max-staged-mb cap (%.1f MB); publishing "
+                    "blocking", staged_bytes / (1 << 20),
+                    self.max_staged_bytes / (1 << 20),
+                )
             publish()
         else:
             self._publisher.submit(publish)
@@ -484,6 +612,11 @@ class CheckpointPublisherBase:
     def _publish_once(self, final: str, arrays: Dict[str, np.ndarray],
                       payload: dict) -> str:
         iteration = int(payload.get("iteration", 0))
+        manifest_extra = {"iteration": iteration}
+        if "layout" in payload:
+            # The logical-layout digest rides the manifest: a resuming run
+            # can check layout compatibility before touching arrays.npz.
+            manifest_extra["layout_digest"] = layout_digest(payload["layout"])
         with atomic_dir(final) as tmp:
             with open(os.path.join(tmp, "arrays.npz"), "wb") as f:
                 np.savez(f, **arrays)
@@ -496,7 +629,7 @@ class CheckpointPublisherBase:
             # in async mode, so the atomicity tests exercise the real
             # concurrent window.
             fault_point("checkpoint:write", iteration=iteration)
-            write_manifest(tmp, extra={"iteration": iteration})
+            write_manifest(tmp, extra=manifest_extra)
         atomic_write_bytes(
             os.path.join(self.directory, LATEST_NAME),
             os.path.basename(final).encode(),
@@ -564,6 +697,37 @@ class CheckpointPublisherBase:
         return resume
 
 
+def _state_layout(state: "DescentState") -> dict:
+    """The snapshot's logical (mesh-independent) layout, recorded in the
+    payload and digested into the manifest: unpadded score-row lengths plus
+    each coordinate model's entity-vocabulary size and dimension.  Padded
+    and sharded shapes are deliberately ABSENT — they belong to the mesh
+    that happens to execute the fit, and the resuming run derives its own
+    (reshard_to_mesh / the engines' load_rows)."""
+    from photon_tpu.game.model import RandomEffectModel
+
+    coords = {}
+    for name, model in state.models.items():
+        if isinstance(model, RandomEffectModel):
+            coords[name] = {
+                "kind": "random",
+                "entities": int(model.num_entities),
+                "dim": int(model.dim),
+            }
+        else:
+            coords[name] = {
+                "kind": "fixed",
+                "dim": int(model.coefficients.means.shape[0]),
+            }
+    return {
+        "rows": {
+            name: int(row.shape[0])
+            for name, row in state.residual_rows.items()
+        },
+        "coordinates": coords,
+    }
+
+
 class DescentCheckpointer(CheckpointPublisherBase):
     """Versioned GAME-descent checkpoints (see module docstring): the
     descent's full restart state serialized through the shared publisher."""
@@ -608,27 +772,54 @@ class DescentCheckpointer(CheckpointPublisherBase):
             "residual_rows": list(state.residual_rows),
             "quarantined": state.quarantined,
             "fingerprint": state.fingerprint,
+            "layout": _state_layout(state),
         }
         return self.save_arrays(state.iteration, arrays, payload)
 
     # -- load ----------------------------------------------------------------
-    def load(self, resume: str) -> Optional[DescentState]:
+    def load(self, resume: str, mesh=None) -> Optional[DescentState]:
         """Resolve ``resume`` and load: ``auto`` returns None when nothing
         is checkpointed yet, ``latest`` requires a checkpoint, anything else
-        is an explicit checkpoint-version directory path."""
+        is an explicit checkpoint-version directory path.  ``mesh`` is the
+        RESUMING run's mesh (any shape — checkpoints are mesh-portable):
+        restored model state is placed for it."""
         path = self.resolve_resume(resume)
         if path is None:
             return None
-        return self.load_path(path)
+        return self.load_path(path, mesh=mesh)
 
     @staticmethod
-    def load_path(path: str) -> DescentState:
-        """Load one checkpoint-version directory, verifying its manifest."""
+    def load_path(path: str, mesh=None) -> DescentState:
+        """Load one checkpoint-version directory, verifying its manifest.
+        Model tables come back at their logical shapes, placed for ``mesh``
+        (the resuming run's — NOT necessarily the writing run's)."""
         payload, arrays = CheckpointPublisherBase.read_payload(path)
+        layout = payload.get("layout")
+        if layout is not None:
+            # Cross-check the manifest's advertised layout digest against
+            # the payload it actually shipped: the manifest hash catches
+            # corruption, this catches a writer bug / mixed-version
+            # artifact where the two were written inconsistently.  The
+            # re-read is guarded IO like every other checkpoint read.
+            def _read_manifest():
+                with open(os.path.join(path, "manifest.json")) as f:
+                    return json.load(f)
+
+            advertised = retry_call(
+                _read_manifest, site="checkpoint:io"
+            ).get("extra", {}).get("layout_digest")
+            if advertised is not None and advertised != layout_digest(layout):
+                raise CheckpointError(
+                    f"{path}: manifest layout digest {advertised!r} does "
+                    "not match the payload layout — inconsistent checkpoint "
+                    "artifact; refusing to resume"
+                )
         task = payload["task_type"]
-        models = _models_from_arrays("m", payload["models"], arrays, task)
+        models = _models_from_arrays(
+            "m", payload["models"], arrays, task, mesh=mesh
+        )
         best_models = _models_from_arrays(
-            "b", payload["best_models"], arrays, task
+            "b", payload["best_models"], arrays, task, mesh=mesh
         )
         for name in payload.get("best_shared", []):
             best_models[name] = models[name]

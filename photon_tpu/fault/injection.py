@@ -38,6 +38,15 @@ Sites and their actions:
   via :func:`consume_nan_injection`, which returns True instead of
   raising).  Params: ``coord`` (coordinate name, or ``*`` for any),
   ``times`` (default 1).
+- ``preempt`` — simulate a preemption WARNING (SIGTERM from a spot/
+  preemptible scheduler): sets the process-wide preemption flag
+  (:mod:`photon_tpu.fault.preemption`) at the top of a training-loop
+  iteration instead of raising, so the loop checkpoints and exits with
+  the preemption exit code exactly as under a real signal.  Params:
+  ``iter`` (fire when the loop's iteration counter equals this),
+  ``times`` (default 1).  A single-token site: the spec is
+  ``preempt:iter=2`` — the parser treats a rule whose second token is a
+  ``k=v`` pair as scope-only.
 
 Determinism: every rule owns a ``random.Random`` seeded by
 ``(seed, site, rule index)`` — for a serial sequence of calls, the same
@@ -69,6 +78,29 @@ class InjectedKillError(RuntimeError, InjectedFaultError):
     """An injected process kill (not retriable; propagates out of the run
     like a preemption would, so the telemetry error-report and checkpoint
     recovery paths see exactly what a real kill leaves behind)."""
+
+
+# The ONE registry of fault-site names consumed anywhere in the codebase,
+# mapping site -> one-line behavior summary.  tests/test_fault_sites.py
+# enforces the hygiene contract: every site consumed in code appears here,
+# every registered site is documented in README's fault-site table, and
+# every registered site is exercised by at least one test — a new site
+# cannot land silently untested or undocumented.
+KNOWN_FAULT_SITES = {
+    "io:read": "transient IOError at guarded data/model reads (retriable)",
+    "io:write": "transient IOError at guarded artifact writes (retriable)",
+    "descent:kill": "process kill at the top of a GAME outer iteration",
+    "stream:kill": "process kill at the top of a streamed L-BFGS iteration",
+    "checkpoint:read": "transient IOError inside a checkpoint load "
+                       "(retriable)",
+    "checkpoint:write": "kill inside the checkpoint torn-write window "
+                        "(payload written, manifest/publish not)",
+    "checkpoint:stage": "kill at the start of checkpoint d2h staging",
+    "solve:nan": "NaN-corrupt a named coordinate's solve output "
+                 "(quarantine path)",
+    "preempt": "set the preemption flag at a loop iteration boundary "
+               "(checkpoint-and-exit path, exit code 75)",
+}
 
 
 @dataclasses.dataclass
@@ -137,11 +169,18 @@ class FaultPlan:
             tokens = raw.strip().split(":")
             if len(tokens) < 2:
                 raise ValueError(
-                    f"bad fault rule {raw!r}: want scope:action[:k=v...]"
+                    f"bad fault rule {raw!r}: want scope:action[:k=v...] "
+                    "or scope:k=v[...]"
                 )
-            site = f"{tokens[0].strip()}:{tokens[1].strip()}"
+            if "=" in tokens[1]:
+                # Single-token site (e.g. ``preempt:iter=2``): the second
+                # token is already a parameter, not an action.
+                site, param_tokens = tokens[0].strip(), tokens[1:]
+            else:
+                site = f"{tokens[0].strip()}:{tokens[1].strip()}"
+                param_tokens = tokens[2:]
             params = {}
-            for tok in tokens[2:]:
+            for tok in param_tokens:
                 k, sep, v = tok.partition("=")
                 if not sep:
                     raise ValueError(

@@ -284,6 +284,11 @@ class FixedEffectCoordinateConfig:
     """Reference: FixedEffectDataConfiguration + per-coordinate optimization
     config inside GameOptimizationConfiguration."""
 
+    # Coordinate kind, shared by the config and its coordinate class: the
+    # checkpoint fingerprint's logical-layout component (fault.checkpoint
+    # .logical_layout) — what a coordinate IS, independent of mesh shape.
+    kind = "fixed"
+
     shard_name: str
     problem: ProblemConfig = ProblemConfig()
     downsampling_rate: float = 1.0  # <1: train on a subsample
@@ -302,6 +307,8 @@ class FixedEffectCoordinateConfig:
 class RandomEffectCoordinateConfig:
     """Reference: RandomEffectDataConfiguration (entity id column a.k.a.
     randomEffectType, feature shard, active-data upper bound)."""
+
+    kind = "random"
 
     shard_name: str
     entity_column: str
@@ -352,6 +359,8 @@ class FactoredRandomEffectCoordinateConfig:
     ``latent_dim``-rank subspace, ``w_e = L z_e`` with ``L: [d, r]`` learned
     on pooled data and ``z_e`` per entity — regularizing entities with few
     rows far harder than a free per-entity fit."""
+
+    kind = "factored_random"
 
     shard_name: str
     entity_column: str
@@ -674,6 +683,8 @@ class RandomEffectDeviceData:
 class FixedEffectCoordinate:
     """Data-parallel global GLM fit (reference: FixedEffectCoordinate)."""
 
+    kind = "fixed"
+
     def __init__(
         self,
         data: GameDataset,
@@ -783,6 +794,8 @@ class RandomEffectCoordinate:
     masked, so early-converging entities freeze while heavy ones iterate
     (SURVEY.md §7).
     """
+
+    kind = "random"
 
     def __init__(
         self,
@@ -978,6 +991,8 @@ class FactoredRandomEffectCoordinate:
     with materialized ``w_e = L z_e`` so scoring, model IO, and warm start
     reuse the unfactored machinery (the reference's factored coordinate
     likewise yields per-entity GLMs)."""
+
+    kind = "factored_random"
 
     def __init__(
         self,

@@ -21,7 +21,7 @@ import numpy as np
 
 from photon_tpu.evaluation.evaluators import MultiEvaluator
 from photon_tpu.fault import QuarantineBudgetError
-from photon_tpu.fault.checkpoint import CheckpointError, DescentState
+from photon_tpu.fault.checkpoint import DescentState
 from photon_tpu.fault.injection import fault_point
 from photon_tpu.game.coordinate import DeferredSolveStats
 from photon_tpu.game.data import GameDataset
@@ -231,6 +231,10 @@ class CoordinateDescent:
             ),
             locked=locked,
             warm_start=warm_start,
+            coordinate_kinds={
+                name: getattr(c, "kind", type(c).__name__)
+                for name, c in self.coordinates.items()
+            },
         )
 
     def run(
@@ -280,6 +284,12 @@ class CoordinateDescent:
             if checkpointer is not None and hasattr(checkpointer, "drain"):
                 checkpointer.drain(reraise=False)
             raise
+        finally:
+            # Retire the iteration heartbeat: a finished (or dead) descent
+            # going quiet is not a stall the watchdog should flag.
+            from photon_tpu.fault.watchdog import complete
+
+            complete("descent.iteration")
         if checkpointer is not None and hasattr(checkpointer, "drain"):
             # The final iteration drains: a completed fit returns only
             # after its last checkpoint is PUBLISHED, and a publish failure
@@ -324,15 +334,16 @@ class CoordinateDescent:
         quarantined_total = 0
 
         if resume_state is not None:
-            mine = self._fingerprint(
-                config_key, locked=locked,
-                warm_start=initial_model is not None,
+            from photon_tpu.fault.checkpoint import require_fingerprint
+
+            require_fingerprint(
+                resume_state,
+                self._fingerprint(
+                    config_key, locked=locked,
+                    warm_start=initial_model is not None,
+                ),
+                "this descent",
             )
-            if resume_state.fingerprint != mine:
-                raise CheckpointError(
-                    f"checkpoint fingerprint {resume_state.fingerprint} does "
-                    f"not match this descent {mine}; refusing to resume"
-                )
             with self.telemetry.span(
                 "descent.resume", iteration=resume_state.iteration
             ):
@@ -413,12 +424,52 @@ class CoordinateDescent:
                 history=history,
             )
 
+        from photon_tpu.fault.preemption import (
+            PreemptedError,
+            consume_preempt_injection,
+            preemption_requested,
+            preemption_reason,
+        )
+        from photon_tpu.fault.watchdog import heartbeat
+
         telemetry = self.telemetry
         for it in range(start_iteration, num_iterations):
             # The preemption site fault injection exercises: between outer
             # iterations, where a killed run must restart from the last
             # published checkpoint.
             fault_point("descent:kill", iteration=it)
+            # Preemption-aware shutdown: SIGTERM (or the injected `preempt`
+            # site) lands here, at the iteration boundary where the
+            # checkpoint state is consistent.  The previous iteration's
+            # snapshot was already handed to the checkpointer — draining
+            # forces that final save through synchronously, so the process
+            # exits with its last completed iteration PUBLISHED (losing
+            # zero completed work), then the driver maps PreemptedError to
+            # the distinct preemption exit code.
+            consume_preempt_injection(it)
+            if preemption_requested():
+                telemetry.counter("descent.preempted").inc()
+                if checkpointer is not None and hasattr(checkpointer, "drain"):
+                    with telemetry.span("descent.checkpoint.drain"):
+                        checkpointer.drain()
+                    self.logger.info(
+                        "preempted (%s) before iteration %d: last completed "
+                        "iteration's checkpoint published; exiting",
+                        preemption_reason(), it,
+                    )
+                    hint = "resume with --resume auto"
+                else:
+                    # Be honest with the operator: nothing was saved, so
+                    # the advertised recovery cannot be a resume.
+                    hint = ("no checkpointer configured — a restart begins "
+                            "from scratch (set --checkpoint-dir)")
+                raise PreemptedError(
+                    f"preempted ({preemption_reason()}) before iteration "
+                    f"{it}; {hint}"
+                )
+            # Watchdog progress mark: one heartbeat per outer iteration
+            # (a stalled heartbeat is how a hung run becomes visible).
+            heartbeat("descent.iteration")
             coord_logs = {}
             trained = 0
             prev_iterates: Dict[str, object] = {}

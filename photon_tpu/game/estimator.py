@@ -182,6 +182,7 @@ class GameEstimator:
         resume: Optional[str] = None,
         max_quarantined: Optional[int] = None,
         checkpoint_async=None,
+        checkpoint_max_staged_mb: Optional[float] = None,
     ) -> List[GameResult]:
         """``checkpoint_fn(iteration, model)`` is forwarded to each descent
         run (per-iteration intermediate model output — SURVEY.md §5).
@@ -199,6 +200,15 @@ class GameEstimator:
         gates the background checkpoint publisher (``'on'``/``'off'``/bool;
         None defers to ``PHOTON_CHECKPOINT_ASYNC``, default on — see
         :func:`photon_tpu.fault.checkpoint.resolve_checkpoint_async`).
+        ``checkpoint_max_staged_mb`` bounds the async publisher's staged
+        host copies (over the cap a snapshot publishes blocking — see
+        :class:`~photon_tpu.fault.checkpoint.CheckpointPublisherBase`).
+
+        Checkpoints are MESH-SHAPE PORTABLE: resume accepts a checkpoint
+        written under a different device/process count — restored model
+        tables are placed for THIS estimator's mesh and the engines re-pad/
+        re-shard score rows onto it (the fingerprint pins the logical
+        layout, never the mesh).
         """
         if not configurations:
             raise ValueError("fit() needs at least one configuration")
@@ -210,10 +220,10 @@ class GameEstimator:
                 "fit; use resume='auto' for sweeps"
             )
         from photon_tpu.fault.checkpoint import (
-            CheckpointError,
             DescentCheckpointer,
             configuration_key,
             descent_fingerprint,
+            require_fingerprint,
         )
         from photon_tpu.game.residuals import resolve_residual_mode
 
@@ -228,12 +238,17 @@ class GameEstimator:
                     os.path.join(checkpoint_dir, f"cfg-{i:03d}"),
                     telemetry=self.telemetry, logger=self.logger,
                     async_publish=checkpoint_async,
+                    max_staged_mb=checkpoint_max_staged_mb,
                 )
             if resume:
+                # The load places restored model state for THIS run's mesh
+                # — whatever shape it is (elastic resume).
                 if resume in ("auto", "latest"):
-                    resume_state = checkpointer.load(resume)
+                    resume_state = checkpointer.load(resume, mesh=self.mesh)
                 else:
-                    resume_state = DescentCheckpointer.load_path(resume)
+                    resume_state = DescentCheckpointer.load_path(
+                        resume, mesh=self.mesh
+                    )
             if resume_state is not None:
                 # Validate compatibility HERE, before the completed
                 # short-circuit below can return a foreign checkpoint's
@@ -256,13 +271,14 @@ class GameEstimator:
                     ),
                     locked=locked_coordinates,
                     warm_start=initial_model is not None,
+                    coordinate_kinds={
+                        name: getattr(cc, "kind", type(cc).__name__)
+                        for name, cc in config.coordinates.items()
+                    },
                 )
-                if resume_state.fingerprint != expected:
-                    raise CheckpointError(
-                        f"checkpoint fingerprint {resume_state.fingerprint} "
-                        f"does not match configuration {label!r} "
-                        f"({expected}); refusing to resume"
-                    )
+                require_fingerprint(
+                    resume_state, expected, f"configuration {label!r}"
+                )
             # Completed means: covers THIS run's requested iterations (a
             # raised descent_iterations resumes and runs the extra passes).
             if (resume_state is not None

@@ -213,16 +213,14 @@ class DeviceScoringCache:
 
     def _put(self, host: np.ndarray, pad_value=0) -> Array:
         """Upload one per-row host array padded + sharded, with transfer and
-        residency accounting."""
-        from photon_tpu.parallel.mesh import axis_sharding
+        residency accounting.  Logical rows in, mesh-padded sharded buffer
+        out (reshard_to_mesh) — the cache is rebuilt per run against the
+        CURRENT mesh, which is what keeps it out of the checkpoint: a
+        resumed fit on a different device count pays one fresh upload here
+        instead of carrying mesh-shaped state in the snapshot."""
+        from photon_tpu.parallel.mesh import reshard_to_mesh
 
-        if self.n_pad != host.shape[0]:
-            widths = [(0, self.n_pad - host.shape[0])] + [(0, 0)] * (host.ndim - 1)
-            host = np.pad(host, widths, constant_values=pad_value)
-        if self.mesh is None:
-            dev = jnp.asarray(host)
-        else:
-            dev = jax.device_put(host, axis_sharding(self.mesh, host.ndim))
+        dev = reshard_to_mesh(host, self.mesh, pad_value=pad_value)
         self.telemetry.counter(
             "descent.host_transfer_bytes", direction="h2d", path="validation"
         ).inc(dev.nbytes)

@@ -72,6 +72,7 @@ from photon_tpu.parallel.mesh import (
     mesh_shards,
     pad_to_multiple,
     reshard,
+    reshard_to_mesh,
     to_host,
 )
 from photon_tpu.telemetry import NULL_SESSION
@@ -223,9 +224,6 @@ class _DeviceScoreTable:
         # host-sync: one-time base-offset staging (host numpy in; the upload
         # below is the table's entire steady-state h2d cost).
         base[: self.n] = np.asarray(base_offset, np.float32)
-        self._row_sharding = (
-            None if mesh is None else axis_sharding(mesh, 1, 0, DATA_AXIS)
-        )
         self.base = self._put(base)
         # The table and its running total are the DONATED buffers
         # (_set_row_and_resum recycles them): build them XLA-born via
@@ -286,15 +284,17 @@ class _DeviceScoreTable:
                 "descent.host_transfer_bytes", direction="h2d", path=self._PATH
             ).inc(new_scores.size * 4)
         new_row = jnp.asarray(new_scores, jnp.float32)
-        if new_row.shape == (self.n,) and self.n != self.n_pad:
-            new_row = jnp.pad(new_row, (0, self.n_pad - self.n))
-        if new_row.shape != (self.n_pad,):
+        if new_row.shape not in ((self.n,), (self.n_pad,)):
             raise ValueError(
                 f"score vector for {name!r} has shape {new_row.shape}, "
                 f"want ({self.n},) or padded ({self.n_pad},)"
             )
-        if self._row_sharding is not None:
-            new_row = reshard(new_row, self._row_sharding)
+        # Logical [n] rows — host fallbacks AND checkpointed rows written
+        # under any other mesh shape — are re-padded and re-sharded onto
+        # THIS table's mesh here (the elastic-resume placement path);
+        # already-padded device rows just re-place (a sharding no-op in
+        # the steady state).
+        new_row = reshard_to_mesh(new_row, self.mesh)
         with self.telemetry.span(f"{self._PATH}.update", coordinate=name):
             self.scores, self.total, self.comp, ok = _set_row_and_resum(
                 self.scores, self.total, self.comp, self._row[name], new_row
@@ -373,7 +373,12 @@ class _DeviceScoreTable:
     def load_rows(self, rows: dict) -> None:
         """Rebuild the device table from checkpointed rows (resume path):
         one guarded update per coordinate, exactly the state an
-        uninterrupted run would hold after the same iterations."""
+        uninterrupted run would hold after the same iterations.
+
+        Checkpointed rows are LOGICAL (unpadded, length ``n``): update()
+        re-pads them to THIS run's mesh multiple and re-shards — so a
+        checkpoint written under any device/process count restores onto
+        whatever mesh this engine was built with (elastic resume)."""
         for name, row in rows.items():
             if name in self._row:
                 # host-sync: resume-path upload of checkpointed HOST rows

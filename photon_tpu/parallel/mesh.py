@@ -183,6 +183,52 @@ def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+def reshard_to_mesh(x, mesh: Optional[Mesh], axis: int = 0,
+                    axis_name: str = DATA_AXIS, pad_value=0):
+    """Re-pad and re-shard one array onto the CURRENT mesh — the elastic-
+    resume placement path.
+
+    ``x`` holds a LOGICAL (unpadded) dimension along ``axis`` — score rows,
+    labels, per-row entity indices — possibly written by a run on a
+    different device/process count.  The dimension is padded with
+    ``pad_value`` up to a multiple of THIS mesh's size (the padding
+    convention everywhere: padded rows carry weight 0 / entity index -1,
+    invisible to kernels and metrics) and the result is placed sharded over
+    ``axis_name``.  Host numpy uploads directly; device arrays re-place
+    through the jitted-identity :func:`reshard` (safe for committed
+    multi-process arrays).  ``mesh=None`` just materializes a device array
+    — so one code path serves every mesh shape, including none.
+
+    This is deliberately the ONLY coupling between a checkpoint and the
+    mesh that restores it: checkpoints record logical layouts, and every
+    padded/sharded buffer is rebuilt HERE against whatever mesh the
+    resuming run constructed (see photon_tpu.fault.checkpoint).
+    """
+    if mesh is None:
+        return jnp.asarray(x)
+    # Pad to the multiple of the WHOLE mesh (product of axes), not just the
+    # sharded axis: the engines' preallocated tables and caches size n_pad
+    # with mesh_shards(mesh), and the two must never disagree on a
+    # multi-axis mesh (a product-multiple is always divisible by the
+    # sharded axis's extent, so the placement below stays valid).
+    n_shards = mesh_shards(mesh)
+    length = x.shape[axis]
+    short = pad_to_multiple(length, n_shards) - length
+    sharding = axis_sharding(mesh, x.ndim, axis, axis_name)
+    if isinstance(x, jax.Array):
+        if short:
+            widths = [(0, 0)] * x.ndim
+            widths[axis] = (0, short)
+            x = jnp.pad(x, widths, constant_values=pad_value)
+        return reshard(x, sharding)
+    host = np.asarray(x)
+    if short:
+        widths = [(0, 0)] * host.ndim
+        widths[axis] = (0, short)
+        host = np.pad(host, widths, constant_values=pad_value)
+    return jax.device_put(host, sharding)
+
+
 def to_host(x) -> np.ndarray:
     """``np.asarray`` that also works for multi-process sharded arrays.
 

@@ -134,6 +134,22 @@ def _render_pipeline_section(report: dict) -> list:
         lines += ["", "## Host-IO pool", ""]
         for name, value in pool.items():
             lines.append(f"- **{name}**: {_fmt(value)}")
+    # Elastic-resume / stall events: preemptions honored, watchdog stalls,
+    # guarded-IO timeout escalations, and staged-RSS blocking fallbacks —
+    # labeled counters, so sum over label variants.
+    resilience = {}
+    for name in ("descent.preempted", "watchdog.stalled",
+                 "io.stall_timeouts", "checkpoint.staged_fallback_sync"):
+        total = sum(
+            m["value"] for m in metrics.get("counters") or []
+            if m["name"] == name
+        )
+        if total:
+            resilience[name] = total
+    if resilience:
+        lines += ["", "## Resilience events", ""]
+        for name, value in resilience.items():
+            lines.append(f"- **{name}**: {_fmt(value)}")
     return lines
 
 

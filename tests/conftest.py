@@ -118,6 +118,14 @@ os.environ.pop("PHOTON_REAL_DATA_DIR", None)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (`-m 'not slow'`); full CLI "
+        "subprocess drives and other minute-scale checks",
+    )
+
+
 @pytest.fixture(scope="session")
 def native_router():
     """The native ``_photon_native.so``, building it once per session.

@@ -1,0 +1,812 @@
+"""ISSUE 7 (elastic resume): mesh-shape-portable checkpoints,
+preemption-aware shutdown, and the run watchdog.
+
+Acceptance pins:
+
+- A checkpoint written under a 1-device placement resumes under a forced
+  multi-device CPU mesh (and vice versa) with EXACT fit parity — the
+  fingerprint carries the logical layout, never the mesh shape.
+- SIGTERM / the injected ``preempt`` fault site stop the loops at an
+  iteration boundary with a PUBLISHED checkpoint and the distinct
+  preemption exit code (75); resume matches the uninterrupted run exactly
+  in both residual modes.
+- The watchdog turns silent heartbeats into ``watchdog.stalled``
+  telemetry and escalates hung guarded-IO calls to retriable timeouts.
+- The async publisher's staged host copies are gauged and bounded
+  (``--checkpoint-max-staged-mb`` falls back to blocking saves).
+- The resident GLM driver rebuilds finished sweep weights from
+  checkpoints without re-fitting.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu.core.objective import RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.problem import ProblemConfig
+from photon_tpu.data.synthetic import make_game_dataset
+from photon_tpu.fault.checkpoint import DescentCheckpointer
+from photon_tpu.fault.injection import FaultPlan, set_plan
+from photon_tpu.fault.preemption import (
+    PREEMPTED_EXIT_CODE,
+    PreemptedError,
+    PreemptionHandler,
+    clear_preemption,
+    preemption_requested,
+)
+from photon_tpu.game.coordinate import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import split_game_dataset
+from photon_tpu.game.estimator import GameEstimator, GameOptimizationConfiguration
+from photon_tpu.parallel.mesh import create_mesh
+from photon_tpu.telemetry import TelemetrySession
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _elastic_hygiene(monkeypatch):
+    """No test leaks a fault plan, a preemption flag, stall heartbeats, or
+    pays real backoff sleeps."""
+    from photon_tpu.fault.watchdog import clear_heartbeats, set_stall_timeout
+
+    monkeypatch.setenv("PHOTON_IO_RETRY_BASE_S", "0")
+    set_plan(None)
+    clear_preemption()
+    clear_heartbeats()
+    set_stall_timeout(None)
+    yield
+    set_plan(None)
+    clear_preemption()
+    clear_heartbeats()
+    set_stall_timeout(None)
+
+
+def _problem(lam: float, iters: int) -> ProblemConfig:
+    return ProblemConfig(
+        regularization=RegularizationContext("l2", lam),
+        optimizer_config=OptimizerConfig(max_iterations=iters),
+    )
+
+
+def _game_fixture(seed: int = 7):
+    data, _ = make_game_dataset(40, 5, 6, 3, seed=seed)
+    train, val = split_game_dataset(data, 0.25)
+    config = GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", _problem(0.01, 8)),
+            "re0": RandomEffectCoordinateConfig("re0", "re0", _problem(1.0, 6)),
+        },
+        descent_iterations=3,
+        name="elastic",
+    )
+    return train, val, config
+
+
+def _coordinate_arrays(model):
+    out = {}
+    for name, coord in model.coordinates.items():
+        if hasattr(coord, "table"):
+            out[name] = np.asarray(coord.table)
+        else:
+            out[name] = np.asarray(coord.coefficients.means)
+    return out
+
+
+def _assert_models_equal(a_model, b_model):
+    a, b = _coordinate_arrays(a_model), _coordinate_arrays(b_model)
+    assert sorted(a) == sorted(b)
+    for name in a:
+        np.testing.assert_array_equal(a[name], b[name])
+
+
+# -- mesh-shape-portable checkpoints (tentpole acceptance) -------------------
+#
+# The test process runs under a forced 8-device CPU platform (conftest), so
+# "a different device count" is exercised in-process: a mesh over k of the
+# virtual devices vs single-device placement (mesh=None).
+
+
+@pytest.mark.parametrize("write_devices,resume_devices", [(None, 4), (4, None)])
+def test_kill_resume_across_mesh_shapes_exact(
+    tmp_path, write_devices, resume_devices
+):
+    """A fit killed mid-sweep under one mesh shape resumes under ANOTHER
+    device count with EXACT parity vs the uninterrupted fit — the
+    checkpoint is mesh-shape portable (score rows re-padded/re-sharded,
+    model tables re-placed, fingerprint pinning only the logical
+    layout)."""
+    train, val, config = _game_fixture()
+
+    def mesh_for(devices):
+        return None if devices is None else create_mesh(devices)
+
+    baseline = GameEstimator(
+        "logistic_regression", train, val, mesh=mesh_for(write_devices)
+    ).fit([config])[0]
+
+    ckpt = str(tmp_path / "ckpt")
+    set_plan(FaultPlan.parse("descent:kill:iter=2"))
+    from photon_tpu.fault.injection import InjectedKillError
+
+    with pytest.raises(InjectedKillError):
+        GameEstimator(
+            "logistic_regression", train, val, mesh=mesh_for(write_devices)
+        ).fit([config], checkpoint_dir=ckpt)
+    set_plan(None)
+
+    resumed = GameEstimator(
+        "logistic_regression", train, val, mesh=mesh_for(resume_devices)
+    ).fit([config], checkpoint_dir=ckpt, resume="auto")[0]
+
+    _assert_models_equal(baseline.model, resumed.model)
+    assert baseline.metrics == resumed.metrics
+    assert [h["iteration"] for h in resumed.descent.history] == [0, 1, 2]
+
+
+def test_completed_restore_across_mesh_shape_exact(tmp_path):
+    """A COMPLETED checkpoint written single-device restores under a
+    2-device mesh without re-running a single solve, bit-identical."""
+    train, val, config = _game_fixture()
+    ckpt = str(tmp_path / "ckpt")
+    session = TelemetrySession("t")
+    first = GameEstimator("logistic_regression", train, val).fit(
+        [config], checkpoint_dir=ckpt
+    )[0]
+
+    restored = GameEstimator(
+        "logistic_regression", train, val, mesh=create_mesh(2),
+        telemetry=session,
+    ).fit([config], checkpoint_dir=ckpt, resume="auto")[0]
+
+    counters = {
+        c["name"]: c["value"] for c in session.registry.snapshot()["counters"]
+        if c["name"].startswith("estimator.")
+    }
+    assert counters.get("estimator.configurations_resumed") == 1
+    assert "estimator.configurations" not in counters  # zero re-fits
+    _assert_models_equal(first.model, restored.model)
+    assert first.metrics == restored.metrics
+
+
+def test_checkpoint_records_logical_layout_not_mesh(tmp_path):
+    """The payload carries the logical layout, the manifest its digest,
+    and the fingerprint has NO device/process/mesh component — the
+    portability contract, checkable without deserializing arrays."""
+    train, val, config = _game_fixture()
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator(
+        "logistic_regression", train, val, mesh=create_mesh(4)
+    ).fit([config], checkpoint_dir=ckpt)
+
+    latest = DescentCheckpointer(os.path.join(ckpt, "cfg-000")).latest_path()
+    with open(os.path.join(latest, "state.json")) as f:
+        payload = json.load(f)
+    layout = payload["layout"]
+    # Score-row lengths are the LOGICAL (unpadded) row count, even though
+    # the writing run padded them to a 4-device multiple on device.
+    assert set(layout["rows"].values()) == {train.num_examples}
+    assert layout["coordinates"]["re0"]["kind"] == "random"
+    assert layout["coordinates"]["re0"]["entities"] > 0
+    assert layout["coordinates"]["fixed"]["kind"] == "fixed"
+
+    fp = payload["fingerprint"]
+    assert fp["layout"]["rows"] == train.num_examples
+    assert fp["layout"]["coordinates"] == {"fixed": "fixed", "re0": "random"}
+    # The exact compatibility surface: logical identity only.  No device-,
+    # process-, or mesh-shape component may ever join it (that is what
+    # makes checkpoints elastic) — a new key fails this assertion and must
+    # justify itself against the portability contract.
+    assert set(fp) == {
+        "task_type", "coordinates", "layout", "residual_mode",
+        "validation", "locked", "warm_start", "config",
+    }
+
+    from photon_tpu.fault.checkpoint import layout_digest
+
+    with open(os.path.join(latest, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["extra"]["layout_digest"] == layout_digest(layout)
+
+
+def test_inconsistent_layout_digest_refused(tmp_path):
+    """The manifest's advertised layout digest is cross-checked against
+    the payload at load: an artifact whose two halves disagree (writer
+    bug, mixed-version tamper — file hashes alone cannot catch an edited
+    manifest `extra`) is refused."""
+    train, val, config = _game_fixture()
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator("logistic_regression", train, val).fit(
+        [config], checkpoint_dir=ckpt
+    )
+    latest = DescentCheckpointer(os.path.join(ckpt, "cfg-000")).latest_path()
+    DescentCheckpointer.load_path(latest)  # consistent: loads fine
+    manifest_path = os.path.join(latest, "manifest.json")
+    manifest = json.load(open(manifest_path))
+    manifest["extra"]["layout_digest"] = "deadbeefdeadbeef"
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    from photon_tpu.fault.checkpoint import CheckpointError
+
+    with pytest.raises(CheckpointError, match="layout digest"):
+        DescentCheckpointer.load_path(latest)
+
+
+def test_resume_refuses_different_logical_layout(tmp_path):
+    """A checkpoint from a different row count (the same sweep re-pointed
+    at different data) must refuse — the layout is identity, the mesh is
+    not."""
+    train, val, config = _game_fixture()
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator("logistic_regression", train, val).fit(
+        [config], checkpoint_dir=ckpt
+    )
+    other_train, other_val = split_game_dataset(
+        make_game_dataset(44, 5, 6, 3, seed=9)[0], 0.25
+    )
+    from photon_tpu.fault.checkpoint import CheckpointError
+
+    with pytest.raises(CheckpointError):
+        GameEstimator("logistic_regression", other_train, other_val).fit(
+            [config], checkpoint_dir=ckpt, resume="auto"
+        )
+
+
+def test_checkpoint_read_faults_retry_on_resume(tmp_path):
+    """The checkpoint:read fault site: injected transient IO errors inside
+    the checkpoint load recover through the retry layer (io.retries > 0)
+    and the resumed state is unaffected."""
+    train, val, config = _game_fixture()
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator("logistic_regression", train, val).fit(
+        [config], checkpoint_dir=ckpt
+    )
+    latest = DescentCheckpointer(os.path.join(ckpt, "cfg-000")).latest_path()
+    clean = DescentCheckpointer.load_path(latest)
+
+    set_plan(FaultPlan.parse("checkpoint:read:times=2"))
+    faulted = DescentCheckpointer.load_path(latest)
+    set_plan(None)
+
+    from photon_tpu.fault.retry import RETRY_TOTALS
+
+    assert RETRY_TOTALS["checkpoint:io"] > 0
+    assert faulted.iteration == clean.iteration
+    for name, row in clean.residual_rows.items():
+        np.testing.assert_array_equal(row, faulted.residual_rows[name])
+
+
+# -- preemption-aware shutdown (tentpole acceptance) -------------------------
+
+
+def test_sigterm_handler_sets_flag_and_restores():
+    import signal
+
+    previous = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler("checkpoint"):
+        assert not preemption_requested()
+        signal.raise_signal(signal.SIGTERM)
+        assert preemption_requested()
+    # Handler restored, flag cleared.
+    assert signal.getsignal(signal.SIGTERM) is previous
+    assert not preemption_requested()
+
+    # mode=ignore installs nothing.
+    with PreemptionHandler("ignore"):
+        assert signal.getsignal(signal.SIGTERM) is previous
+    with pytest.raises(ValueError):
+        PreemptionHandler("maybe")
+
+
+def test_second_signal_escalates_to_default_behavior():
+    """A second signal is the operator insisting: the handler restores the
+    previous behavior and delivers it — so a double Ctrl-C interrupts even
+    before the first iteration boundary would have honored the flag."""
+    import signal
+
+    with PreemptionHandler("checkpoint"):
+        signal.raise_signal(signal.SIGINT)
+        assert preemption_requested()  # first signal: flag only
+        with pytest.raises(KeyboardInterrupt):
+            signal.raise_signal(signal.SIGINT)  # second: stock behavior
+    clear_preemption()
+
+
+def test_non_training_drivers_keep_stock_signals(tmp_path):
+    """telemetry_run installs the flag-setting handler only for drivers
+    whose loops POLL the flag (preemptible=True) — a scoring driver whose
+    code never checks it must keep stock Ctrl-C behavior."""
+    import argparse
+    import signal
+
+    from photon_tpu.drivers import common
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("t")
+    args = argparse.Namespace(
+        output_dir=str(tmp_path), telemetry=False, faults=None,
+        on_preempt="checkpoint", stall_timeout=None,
+    )
+    previous = signal.getsignal(signal.SIGINT)
+    with common.telemetry_run(args, "score", logger):
+        assert signal.getsignal(signal.SIGINT) is previous  # untouched
+    with common.telemetry_run(args, "train", logger, preemptible=True):
+        assert signal.getsignal(signal.SIGINT) is not previous
+    assert signal.getsignal(signal.SIGINT) is previous  # restored
+
+
+@pytest.mark.parametrize("mode", ["device", "host"])
+def test_preempt_checkpoints_and_resume_matches_exactly(tmp_path, mode):
+    """`--faults preempt:iter=k`: the descent stops at the iteration-k
+    boundary with iteration k-1's checkpoint PUBLISHED, and the resumed
+    fit matches the uninterrupted one exactly — in both residual modes."""
+    train, val, config = _game_fixture()
+
+    def fit(**kw):
+        return GameEstimator(
+            "logistic_regression", train, val, residual_mode=mode
+        ).fit([config], **kw)[0]
+
+    baseline = fit()
+
+    ckpt = str(tmp_path / "ckpt")
+    session = TelemetrySession("t")
+    set_plan(FaultPlan.parse("preempt:iter=2"))
+    with pytest.raises(PreemptedError):
+        GameEstimator(
+            "logistic_regression", train, val, residual_mode=mode,
+            telemetry=session,
+        ).fit([config], checkpoint_dir=ckpt)
+    set_plan(None)
+    clear_preemption()
+
+    assert session.counter("descent.preempted").value == 1
+    # The preemption drained the publisher: iteration 1's checkpoint is
+    # the published LATEST (not in-flight, not torn).
+    latest = DescentCheckpointer(os.path.join(ckpt, "cfg-000")).latest_path()
+    assert latest is not None and latest.endswith("ckpt-000001")
+
+    resumed = fit(checkpoint_dir=ckpt, resume="latest")
+    _assert_models_equal(baseline.model, resumed.model)
+    assert baseline.metrics == resumed.metrics
+
+
+def test_preempt_without_checkpointer_still_stops():
+    train, val, config = _game_fixture()
+    set_plan(FaultPlan.parse("preempt:iter=1"))
+    with pytest.raises(PreemptedError):
+        GameEstimator("logistic_regression", train, val).fit([config])
+
+
+def test_streamed_preempt_snapshots_and_resumes_exactly(tmp_path):
+    """The streamed L-BFGS loop honors preemption at its host-iteration
+    boundary: the mid-fit state is snapshotted IMMEDIATELY (off the
+    checkpoint_every cadence), and the resumed trajectory is exactly the
+    uninterrupted one."""
+    from photon_tpu.drivers import train as train_driver
+
+    from test_fault_injection import _stream_files
+
+    glob_spec = _stream_files(tmp_path)
+
+    def stream_args(out, extra=()):
+        return train_driver.build_parser().parse_args([
+            "--backend", "cpu", "--stream", "--input", glob_spec,
+            "--task", "logistic_regression", "--reg-weights", "0.5,2.0",
+            "--max-iterations", "12",
+            "--output-dir", str(tmp_path / out), *extra,
+        ])
+
+    baseline = train_driver.run(stream_args("base"))
+
+    ckpt = str(tmp_path / "ckpt")
+    with pytest.raises(PreemptedError):
+        train_driver.run(stream_args("preempted", [
+            "--checkpoint-dir", ckpt,
+            # checkpoint-every 100 would never snapshot on cadence: the
+            # published mid-fit state can only come from the preemption
+            # path's forced save.
+            "--checkpoint-every", "100",
+            "--faults", "preempt:iter=4",
+        ]))
+    set_plan(None)
+    clear_preemption()
+
+    report = json.load(open(
+        tmp_path / "preempted" / "telemetry" / "run_report.json"
+    ))
+    assert report["status"] == "preempted"
+
+    resumed = train_driver.run(stream_args("resumed", [
+        "--checkpoint-dir", ckpt, "--resume", "latest",
+    ]))
+    for ea, eb in zip(baseline["sweep"], resumed["sweep"]):
+        assert ea["final_value"] == eb["final_value"]
+        assert ea["iterations"] == eb["iterations"]
+        assert ea["convergence_reason"] == eb["convergence_reason"]
+
+
+def test_run_cli_maps_preemption_to_exit_code():
+    from photon_tpu.drivers import common
+
+    def preempted_run(args):
+        raise PreemptedError("boundary stop")
+
+    with pytest.raises(SystemExit) as exc:
+        common.run_cli(preempted_run, None)
+    assert exc.value.code == PREEMPTED_EXIT_CODE
+
+    # Everything else propagates unchanged.
+    def crashed_run(args):
+        raise RuntimeError("real crash")
+
+    with pytest.raises(RuntimeError, match="real crash"):
+        common.run_cli(crashed_run, None)
+
+
+@pytest.mark.slow
+def test_cli_preemption_exit_code(tmp_path):
+    """End to end through the real CLI: an injected preemption exits with
+    the distinct code 75 (EX_TEMPFAIL), leaves a published checkpoint, and
+    the resumed run matches an uninterrupted one."""
+    out = str(tmp_path / "out")
+    ckpt = str(tmp_path / "ckpt")
+    argv = [
+        sys.executable, "-m", "photon_tpu.drivers.train_game",
+        "--backend", "cpu",
+        "--input", "synthetic-game:30:4:6:3",
+        "--task", "logistic_regression",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=6",
+        "--coordinate", "re0:type=random,shard=re0,entity=re0,max_iters=5",
+        "--descent-iterations", "2",
+        "--validation-split", "0.25",
+        "--output-dir", out,
+        "--checkpoint-dir", ckpt,
+        "--faults", "preempt:iter=1",
+    ]
+    env = {k: v for k, v in os.environ.items()}
+    proc = subprocess.run(
+        argv, capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert proc.returncode == PREEMPTED_EXIT_CODE, (
+        proc.returncode, proc.stderr[-2000:]
+    )
+    assert "preempted" in (proc.stderr or "")
+    report = json.load(open(os.path.join(out, "telemetry", "run_report.json")))
+    assert report["status"] == "preempted"
+    from photon_tpu.fault.checkpoint import has_published_checkpoint
+
+    assert has_published_checkpoint(ckpt)
+
+
+# -- run watchdog (tentpole) -------------------------------------------------
+
+
+def test_watchdog_detects_stall_and_recovery():
+    from photon_tpu.fault.watchdog import Watchdog, heartbeat
+
+    session = TelemetrySession("t")
+    heartbeat("descent.iteration")
+    wd = Watchdog(0.05, telemetry=session)
+    time.sleep(0.12)
+    assert wd.check_once() == ["descent.iteration"]
+    # Counted once per stall episode, gauge carries the age.
+    assert wd.check_once() == []
+    counters = {
+        (c["name"], c["labels"].get("site")): c["value"]
+        for c in session.registry.snapshot()["counters"]
+    }
+    assert counters[("watchdog.stalled", "descent.iteration")] == 1
+    gauges = {
+        (g["name"], g["labels"].get("site")): g["value"]
+        for g in session.registry.snapshot()["gauges"]
+    }
+    assert gauges[("watchdog.stall_age_seconds", "descent.iteration")] > 0.05
+    # Progress resets the episode; a NEW stall counts again.
+    heartbeat("descent.iteration")
+    assert wd.check_once() == []
+    time.sleep(0.12)
+    assert wd.check_once() == ["descent.iteration"]
+
+    with pytest.raises(ValueError):
+        Watchdog(0.0)
+
+
+def test_watchdog_thread_emits_on_real_stall():
+    from photon_tpu.fault.watchdog import Watchdog, heartbeat
+
+    session = TelemetrySession("t")
+    heartbeat("io.unit")
+    wd = Watchdog(0.05, telemetry=session, poll_interval_s=0.02).start()
+    try:
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            counters = {
+                (c["name"], c["labels"].get("site")): c["value"]
+                for c in session.registry.snapshot()["counters"]
+            }
+            if counters.get(("watchdog.stalled", "io.unit")):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("watchdog thread never flagged the stalled site")
+    finally:
+        wd.stop()
+
+
+def test_hung_io_escalates_to_retriable_timeout():
+    """The retry/timeout/backoff triangle: a guarded-IO call hung past the
+    stall timeout raises a retriable timeout, the retry layer backs off,
+    and a healthy later attempt succeeds — with both escalation and
+    recovery counted."""
+    from photon_tpu.fault.retry import RetryPolicy, retry_call
+
+    session = TelemetrySession("t")
+    calls = {"n": 0}
+
+    def hangs_once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            time.sleep(5.0)  # "hung" well past the 0.1s stall timeout
+        return "recovered"
+
+    t0 = time.monotonic()
+    out = retry_call(
+        hangs_once, site="unit", telemetry=session,
+        policy=RetryPolicy(
+            attempts=3, base_delay_s=0.0, stall_timeout_s=0.1
+        ),
+        sleep=lambda s: None,
+    )
+    assert out == "recovered" and calls["n"] == 2
+    assert time.monotonic() - t0 < 4.0  # did NOT wait out the hang
+    counters = {
+        (c["name"], c["labels"].get("site")): c["value"]
+        for c in session.registry.snapshot()["counters"]
+    }
+    assert counters[("io.stall_timeouts", "unit")] == 1
+    assert counters[("io.retries", "unit")] == 1
+
+    # Exhausted stalls surface as the timeout error itself.
+    from photon_tpu.fault.watchdog import IOStallTimeoutError
+
+    with pytest.raises(IOStallTimeoutError):
+        retry_call(
+            lambda: time.sleep(5.0), site="unit",
+            policy=RetryPolicy(
+                attempts=1, base_delay_s=0.0, stall_timeout_s=0.05
+            ),
+            sleep=lambda s: None,
+        )
+
+
+def test_slow_but_healthy_io_survives_escalating_timeout():
+    """The per-attempt timeout DOUBLES each retry, so IO legitimately
+    slower than the configured timeout still completes within the attempt
+    budget instead of being starved to failure."""
+    from photon_tpu.fault.retry import RetryPolicy, retry_call
+
+    calls = {"n": 0}
+
+    def consistently_slow():
+        calls["n"] += 1
+        time.sleep(0.25)  # slower than the 0.1s base timeout, every time
+        return "slow-ok"
+
+    out = retry_call(
+        consistently_slow, site="unit",
+        policy=RetryPolicy(attempts=4, base_delay_s=0.0, stall_timeout_s=0.1),
+        sleep=lambda s: None,
+    )
+    # Budget per attempt: 0.1, 0.2, 0.4 — the third attempt fits.
+    assert out == "slow-ok" and calls["n"] == 3
+
+
+def test_finished_activity_retires_its_heartbeat():
+    """Silence from FINISHED work is not a stall: retry_call retires its
+    site mark when the call sequence ends, and a completed descent retires
+    the iteration mark — a healthy run's later phases cannot trip
+    watchdog.stalled on a site that simply finished."""
+    from photon_tpu.fault.retry import RetryPolicy, retry_call
+    from photon_tpu.fault.watchdog import progress_ages
+
+    def io_sites():
+        return [k for k in progress_ages() if k.startswith("io.unit")]
+
+    def tracked_while_running():
+        assert io_sites()  # marked during the call (per-call key)
+        return "ok"
+
+    retry_call(
+        tracked_while_running, site="unit",
+        policy=RetryPolicy(attempts=2, base_delay_s=0.0),
+        sleep=lambda s: None,
+    )
+    assert not io_sites()  # ...and retired on success
+
+    # Retired on NON-retriable failure too (no stale mark after the call).
+    with pytest.raises(ValueError):
+        retry_call(
+            lambda: (_ for _ in ()).throw(ValueError("not an OSError")),
+            site="unit",
+            policy=RetryPolicy(attempts=2, base_delay_s=0.0),
+            sleep=lambda s: None,
+        )
+    assert not io_sites()
+
+    train, val, config = _game_fixture()
+    GameEstimator("logistic_regression", train, val).fit([config])
+    assert "descent.iteration" not in progress_ages()
+
+
+def test_stall_timeout_resolution(monkeypatch):
+    from photon_tpu.fault.retry import default_policy
+    from photon_tpu.fault.watchdog import set_stall_timeout, stall_timeout
+
+    assert stall_timeout() == 0.0
+    monkeypatch.setenv("PHOTON_STALL_TIMEOUT_S", "7.5")
+    assert stall_timeout() == 7.5
+    assert default_policy().stall_timeout_s == 7.5
+    set_stall_timeout(2.0)  # driver flag wins over env
+    assert stall_timeout() == 2.0
+    set_stall_timeout(None)
+    assert stall_timeout() == 7.5
+    monkeypatch.setenv("PHOTON_STALL_TIMEOUT_S", "junk")
+    assert stall_timeout() == 0.0
+
+
+def test_resilience_report_section():
+    from photon_tpu.telemetry.report import render_markdown
+
+    report = {
+        "driver": "t", "run_id": "r", "status": "preempted",
+        "metrics": {
+            "counters": [
+                {"name": "watchdog.stalled", "labels": {"site": "a"},
+                 "value": 2},
+                {"name": "io.stall_timeouts", "labels": {"site": "b"},
+                 "value": 1},
+                {"name": "descent.preempted", "labels": {}, "value": 1},
+            ],
+            "gauges": [], "histograms": [],
+        },
+        "spans": [],
+    }
+    text = render_markdown(report)
+    assert "Resilience events" in text
+    assert "watchdog.stalled" in text and "descent.preempted" in text
+
+
+# -- bounded staged host copies (satellite) ----------------------------------
+
+
+def test_staged_bytes_gauge_and_cap_fallback(tmp_path):
+    train, val, config = _game_fixture()
+
+    # Unbounded async run: gauge populated, no fallback.
+    s1 = TelemetrySession("t1")
+    GameEstimator(
+        "logistic_regression", train, val, telemetry=s1
+    ).fit([config], checkpoint_dir=str(tmp_path / "c1"), checkpoint_async="on")
+    assert s1.gauge("checkpoint.staged_bytes").value > 0
+    assert s1.counter("checkpoint.staged_fallback_sync").value == 0
+
+    # A cap below any real snapshot: every save publishes blocking.
+    s2 = TelemetrySession("t2")
+    result = GameEstimator(
+        "logistic_regression", train, val, telemetry=s2
+    ).fit(
+        [config], checkpoint_dir=str(tmp_path / "c2"), checkpoint_async="on",
+        checkpoint_max_staged_mb=0.0001,
+    )[0]
+    saves = s2.counter("checkpoint.saves").value
+    assert saves == config.descent_iterations
+    assert s2.counter("checkpoint.staged_fallback_sync").value == saves
+
+    # The blocking fallback still produces a loadable, resumable chain.
+    restored = GameEstimator("logistic_regression", train, val).fit(
+        [config], checkpoint_dir=str(tmp_path / "c2"), resume="latest"
+    )[0]
+    _assert_models_equal(result.model, restored.model)
+
+
+def test_max_staged_env_resolution(tmp_path, monkeypatch):
+    from photon_tpu.fault.checkpoint import CheckpointPublisherBase
+
+    base = CheckpointPublisherBase(str(tmp_path))
+    assert base.max_staged_bytes is None
+    monkeypatch.setenv("PHOTON_CHECKPOINT_MAX_STAGED_MB", "2")
+    assert CheckpointPublisherBase(
+        str(tmp_path)
+    ).max_staged_bytes == 2 * (1 << 20)
+    # Explicit argument wins; negative means unbounded.
+    assert CheckpointPublisherBase(
+        str(tmp_path), max_staged_mb=1
+    ).max_staged_bytes == 1 << 20
+    assert CheckpointPublisherBase(
+        str(tmp_path), max_staged_mb=-1
+    ).max_staged_bytes is None
+
+
+# -- resident GLM driver checkpoint/resume (satellite) -----------------------
+
+
+def test_resident_driver_checkpoint_resume_skips_refits(tmp_path):
+    from photon_tpu.drivers import train as train_driver
+
+    def args(out, extra=()):
+        return train_driver.build_parser().parse_args([
+            "--backend", "cpu",
+            "--input", "synthetic:logistic_regression:120:10:3:5",
+            "--task", "logistic_regression", "--reg-weights", "0.5,2.0",
+            "--max-iterations", "15",
+            "--output-dir", str(tmp_path / out), *extra,
+        ])
+
+    baseline = train_driver.run(args("base"))
+
+    ckpt = str(tmp_path / "ckpt")
+    checkpointed = train_driver.run(args("ckpt-run", [
+        "--checkpoint-dir", ckpt,
+    ]))
+    # Checkpointing must not perturb the sweep.
+    for ea, eb in zip(baseline["sweep"], checkpointed["sweep"]):
+        assert ea["final_value"] == eb["final_value"]
+
+    # Wipe the SECOND lambda's chain: resume rebuilds lambda 0 from its
+    # snapshot (zero solves) and re-fits only lambda 1 — from the restored
+    # solver-space warm start, so the result is the uninterrupted sweep's.
+    import shutil
+
+    shutil.rmtree(os.path.join(ckpt, "lam-001"))
+    resumed = train_driver.run(args("resumed", [
+        "--checkpoint-dir", ckpt, "--resume", "auto",
+    ]))
+    for ea, eb in zip(baseline["sweep"], resumed["sweep"]):
+        assert ea["final_value"] == eb["final_value"]
+        assert ea["iterations"] == eb["iterations"]
+        assert ea["convergence_reason"] == eb["convergence_reason"]
+    assert resumed["sweep"][0]["wall_time_s"] == 0.0  # rebuilt, not refit
+    assert resumed["best_lambda"] == baseline["best_lambda"]
+
+    report = json.load(open(
+        tmp_path / "resumed" / "telemetry" / "run_report.json"
+    ))
+    resumed_counter = [
+        c for c in report["metrics"]["counters"]
+        if c["name"] == "train.lambdas_resumed"
+    ]
+    assert resumed_counter and resumed_counter[0]["value"] == 1
+
+
+def test_resident_resume_refuses_mismatched_settings(tmp_path):
+    from photon_tpu.drivers import train as train_driver
+    from photon_tpu.fault.checkpoint import CheckpointError
+
+    ckpt = str(tmp_path / "ckpt")
+
+    def args(out, extra=()):
+        return train_driver.build_parser().parse_args([
+            "--backend", "cpu",
+            "--input", "synthetic:logistic_regression:120:10:3:5",
+            "--task", "logistic_regression", "--reg-weights", "0.5",
+            "--max-iterations", "15",
+            "--output-dir", str(tmp_path / out),
+            "--checkpoint-dir", ckpt, *extra,
+        ])
+
+    train_driver.run(args("first"))
+    # Only the FINAL state is snapshotted, so a different iteration budget
+    # cannot continue a completed resident fit — it must refuse.
+    with pytest.raises(CheckpointError):
+        train_driver.run(args("more-iters", [
+            "--resume", "auto", "--max-iterations", "30",
+        ]))

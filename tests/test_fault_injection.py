@@ -627,17 +627,22 @@ def test_streamed_resume_latest_requires_published_checkpoint(tmp_path):
         train.run(args)
 
 
-def test_resident_driver_rejects_stream_checkpoint_flags(tmp_path):
+def test_resident_driver_resume_flag_validation(tmp_path):
+    # The resident path now supports checkpoints (see test_elastic.py for
+    # the resume behavior) but keeps the same flag strictness as --stream.
     from photon_tpu.drivers import train
 
-    args = train.build_parser().parse_args([
+    base = [
         "--backend", "cpu",
         "--input", "synthetic:logistic_regression:100:10:3:5",
         "--output-dir", str(tmp_path / "out"),
-        "--checkpoint-dir", str(tmp_path / "ckpt"),
-    ])
-    with pytest.raises(ValueError, match="--stream"):
-        train.run(args)
+    ]
+    with pytest.raises(ValueError, match="--resume needs --checkpoint-dir"):
+        train.run(train.build_parser().parse_args(base + ["--resume", "auto"]))
+    with pytest.raises(ValueError, match="no published checkpoint"):
+        train.run(train.build_parser().parse_args(base + [
+            "--checkpoint-dir", str(tmp_path / "empty"), "--resume", "latest",
+        ]))
 
 
 # -- atomic model export -----------------------------------------------------

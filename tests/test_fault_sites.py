@@ -1,0 +1,91 @@
+"""Fault-site hygiene: every site consumed in code is registered,
+documented, and tested.
+
+``photon_tpu.fault.injection.KNOWN_FAULT_SITES`` is the one registry.
+This module scans the source tree for the site literals actually consumed
+(``fault_point("...")`` and ``.consume("...")`` call sites) and enforces
+three invariants, so a new fault site cannot land silently:
+
+1. every consumed site is registered (and nothing registered is dead);
+2. every registered site appears in README's fault-tolerance docs
+   (the fault-site table / failure-mode matrix);
+3. every registered site is exercised by at least one test.
+"""
+
+import os
+import re
+
+from photon_tpu.fault.injection import KNOWN_FAULT_SITES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fault_point("site", ...) and plan.consume("site", ...) — the only two
+# shapes through which code consumes a site by literal name.  \s* spans
+# newlines, so wrapped call sites match too.
+_SITE_CALL = re.compile(
+    r"""(?:fault_point|\.consume)\(\s*["']([^"']+)["']"""
+)
+
+
+def _python_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if not d.startswith((".", "__"))]
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def _consumed_sites() -> dict:
+    """{site: [files]} for every site literal consumed in photon_tpu/."""
+    sites: dict = {}
+    for path in _python_files(os.path.join(REPO, "photon_tpu")):
+        text = open(path).read()
+        for site in _SITE_CALL.findall(text):
+            sites.setdefault(site, []).append(os.path.relpath(path, REPO))
+    return sites
+
+
+def test_every_consumed_site_is_registered():
+    consumed = _consumed_sites()
+    unregistered = {
+        site: files for site, files in consumed.items()
+        if site not in KNOWN_FAULT_SITES
+    }
+    assert not unregistered, (
+        f"fault sites consumed in code but missing from "
+        f"KNOWN_FAULT_SITES (register them in "
+        f"photon_tpu/fault/injection.py): {unregistered}"
+    )
+    dead = set(KNOWN_FAULT_SITES) - set(consumed)
+    assert not dead, (
+        f"KNOWN_FAULT_SITES entries no code consumes (stale registry "
+        f"rows): {sorted(dead)}"
+    )
+
+
+def test_every_site_is_documented_in_readme():
+    readme = open(os.path.join(REPO, "README.md")).read()
+    undocumented = [
+        site for site in KNOWN_FAULT_SITES if f"`{site}`" not in readme
+    ]
+    assert not undocumented, (
+        f"fault sites missing from README's fault-site table "
+        f"(document the failure mode): {undocumented}"
+    )
+
+
+def test_every_site_is_exercised_by_a_test():
+    this_file = os.path.abspath(__file__)
+    coverage = {site: [] for site in KNOWN_FAULT_SITES}
+    for path in _python_files(os.path.dirname(this_file)):
+        if os.path.abspath(path) == this_file:
+            continue  # the registry scan itself is not coverage
+        text = open(path).read()
+        for site in KNOWN_FAULT_SITES:
+            if site in text:
+                coverage[site].append(os.path.basename(path))
+    untested = sorted(site for site, files in coverage.items() if not files)
+    assert not untested, (
+        f"fault sites with no test exercising them (inject them in a "
+        f"recovery test before shipping): {untested}"
+    )

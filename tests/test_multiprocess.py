@@ -472,6 +472,53 @@ def test_two_process_device_residuals_match_single(tmp_path):
 
 
 
+def test_two_process_checkpoint_resumes_on_one_process(tmp_path):
+    """Elastic resume, the real multi-controller leg: a checkpoint WRITTEN
+    by a 2-process run (rank 0 writes, globally-sharded score tables)
+    resumes on ONE process — a different process AND device count — and
+    continues training to the single-process run's metrics.  Skips with a
+    reason on jaxlibs without cross-process CPU collectives
+    (MP_UNSUPPORTED_MARKERS), like every multi-process test."""
+    from photon_tpu.drivers import train_game
+
+    ckpt = str(tmp_path / "ckpt")
+    worker = tmp_path / "game_worker.py"
+    worker.write_text(GAME_WORKER)
+    outs = [str(tmp_path / f"mp{i}") for i in range(2)]
+    # The 2-proc pair trains ONE outer iteration with checkpointing on.
+    run_worker_pair(lambda coordinator: [
+        [sys.executable, str(worker), REPO, coordinator, str(i), outs[i],
+         "--checkpoint-dir", ckpt]
+        for i in range(2)
+    ], what="GAME checkpoint worker")
+    from photon_tpu.fault.checkpoint import has_published_checkpoint
+
+    assert has_published_checkpoint(ckpt)
+
+    argv = [
+        "--backend", "cpu",
+        "--input", "synthetic-game:32:4:8:4:1:7",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=6",
+        "--coordinate", "per_user:type=random,shard=re0,entity=re0,max_iters=5",
+        "--validation-split", "0.25",
+    ]
+    # Resume single-process with a RAISED iteration budget: iteration 0 is
+    # restored from the 2-proc snapshot, iteration 1 trains locally.
+    resumed = train_game.run(train_game.build_parser().parse_args(
+        argv + ["--descent-iterations", "2",
+                "--checkpoint-dir", ckpt, "--resume", "latest",
+                "--output-dir", str(tmp_path / "resumed")]))
+    single = train_game.run(train_game.build_parser().parse_args(
+        argv + ["--descent-iterations", "2",
+                "--output-dir", str(tmp_path / "single")]))
+    for name, value in single["best_metrics"].items():
+        assert resumed["best_metrics"][name] == pytest.approx(
+            value, rel=2e-3
+        ), (name, resumed["best_metrics"][name], value)
+    history = resumed["sweep"][0]["history"]
+    assert [h["iteration"] for h in history] == [0, 1]
+
+
 def test_two_process_row_split_matches_single(merged_worker_results):
     """Row-split entity solves across 2 REAL processes (each holding half of
     every entity's rows) must match a single-process co-located solve — the

@@ -38,6 +38,11 @@ DEFAULT_FILES = (
     "photon_tpu/game/descent.py",
     "photon_tpu/game/coordinate.py",
     "photon_tpu/fault/checkpoint.py",
+    # The preemption/watchdog layers run ON the hot loop's thread (the
+    # boundary checks) or beside it (the heartbeat thread): neither may
+    # ever fetch device data — a watchdog that syncs would BE the stall.
+    "photon_tpu/fault/preemption.py",
+    "photon_tpu/fault/watchdog.py",
 )
 
 SYNC_PATTERN = re.compile(
