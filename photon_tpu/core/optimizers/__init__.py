@@ -18,6 +18,7 @@ from photon_tpu.core.optimizers.base import (  # noqa: F401
     OptimizerResult,
 )
 from photon_tpu.core.optimizers.lbfgs import lbfgs  # noqa: F401
+from photon_tpu.core.optimizers.newton import newton  # noqa: F401
 from photon_tpu.core.optimizers.owlqn import owlqn  # noqa: F401
 from photon_tpu.core.optimizers.tron import tron  # noqa: F401
 

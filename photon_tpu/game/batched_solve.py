@@ -1,0 +1,165 @@
+"""Size-binned batched device linear algebra for random-effect solves.
+
+The seed's ``RandomEffectCoordinate.train`` drove a Python loop over
+row-count buckets — O(buckets) host dispatches and one compiled program per
+bucket shape — which is what capped entity counts (ROADMAP "Random effects
+at millions of entities").  This module is the routing layer that replaces
+it:
+
+- **Bin layout** — :func:`bin_layout` consolidates the power-of-two buckets
+  into a few padded size bins (``game.data.plan_size_bins`` /
+  ``merge_buckets``), so a million-entity coordinate dispatches a handful
+  of jitted programs instead of a dozen-plus.  ``PHOTON_SOLVE_BINNING=off``
+  restores the one-bucket-per-capacity loop (the escape hatch and the
+  bench's bucket-loop baseline).
+- **Solver routing** — :func:`solver_route` picks, per bin, between the
+  batched-Cholesky damped Newton (``core.optimizers.newton`` vmapped over
+  the entity axis: ``[B, dim, dim]`` Hessians, one batched ``cho_factor``/
+  ``cho_solve`` per iteration — the 2112.09017 padded-factorization shape)
+  for the common small-``solve_dim`` smooth case, and the existing vmapped
+  L-BFGS/OWL-QN/TRON program for everything else (L1 bins, large dims,
+  row-split placement) — so every existing ``problem`` config still solves.
+- **Solver cache** — :func:`cached_newton_solver` mirrors
+  ``core.problem.cached_solver``: one traced program per static
+  (optimizer-config, variance) pair, module-cached, the objective riding
+  along as a pytree argument so reg sweeps share it.
+
+Entity-axis sharding rides the existing ``RandomEffectDeviceData``
+placement: bins are padded to the mesh multiple and sharded over the mesh
+axis the score tables already use (``parallel.mesh``), composing with
+``solve_entities_row_split`` under multi-controller row-split configs.
+
+Knobs (env): ``PHOTON_SOLVE_BINNING`` (``on``/``off``),
+``PHOTON_SOLVE_MAX_BINS`` (default 4), ``PHOTON_SOLVE_BIN_WASTE`` (default
+2.0 — padded row cells allowed per live row cell before a capacity starts
+its own bin), ``PHOTON_SOLVE_NEWTON`` (``on``/``off``),
+``PHOTON_NEWTON_MAX_DIM`` (default 64 — above it the dense ``[B, d, d]``
+Hessian stops paying and bins route to the iterative solvers).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.optimizers.newton import newton
+from photon_tpu.core.problem import ProblemConfig, _compute_variances
+from photon_tpu.models.glm import Coefficients
+
+
+def binning_enabled() -> bool:
+    return os.environ.get("PHOTON_SOLVE_BINNING", "on").strip().lower() not in (
+        "off", "0", "false",
+    )
+
+
+def newton_enabled() -> bool:
+    return os.environ.get("PHOTON_SOLVE_NEWTON", "on").strip().lower() not in (
+        "off", "0", "false",
+    )
+
+
+def max_bins() -> int:
+    return int(os.environ.get("PHOTON_SOLVE_MAX_BINS", "4"))
+
+
+def bin_waste_cap() -> float:
+    return float(os.environ.get("PHOTON_SOLVE_BIN_WASTE", "2.0"))
+
+
+def newton_max_dim() -> int:
+    return int(os.environ.get("PHOTON_NEWTON_MAX_DIM", "64"))
+
+
+def bin_layout(buckets: tuple) -> list:
+    """Bucket-index groups for the operative bin policy: the planned size
+    bins, or one bucket per bin when binning is off (the seed's loop)."""
+    if not binning_enabled() or len(buckets) <= 1:
+        return [[i] for i in range(len(buckets))]
+    from photon_tpu.game.data import plan_size_bins
+
+    return plan_size_bins(buckets, max_bins=max_bins(),
+                          waste_cap=bin_waste_cap())
+
+
+def solver_route(problem: ProblemConfig, solve_dim: int,
+                 row_split: bool = False) -> str:
+    """Which solver a bin runs: ``newton`` (batched Cholesky) for smooth
+    small-dim problems, ``row_split`` under row-split placement, else
+    ``vmapped`` (the existing L-BFGS/OWL-QN/TRON program — L1 bins and
+    large dims keep their iterative solve)."""
+    if row_split:
+        return "row_split"
+    if (
+        newton_enabled()
+        and problem.regularization.l1_weight == 0
+        and problem.optimizer.lower() not in ("owlqn", "owl-qn")
+        and solve_dim <= newton_max_dim()
+    ):
+        return "newton"
+    return "vmapped"
+
+
+def _run_newton_fit(objective, batch, w0, *, cfg: OptimizerConfig,
+                    variance: str):
+    """One damped-Newton GLM fit, pure in (objective, batch, w0) — the body
+    :func:`cached_newton_solver` vmaps and compiles.  Mirrors
+    ``core.problem._run_fit``: the objective is a pytree argument, and the
+    variance computation is the SAME ``_compute_variances`` formula the
+    iterative path runs, so means AND variances agree at convergence."""
+    fun = lambda w: objective.value_and_grad(w, batch)  # noqa: E731
+    result = newton(
+        fun, w0, cfg, hess=lambda w: objective.hessian_matrix(w, batch)
+    )
+    coefficients = Coefficients(
+        means=result.w,
+        variances=_compute_variances(objective, variance, result.w, batch),
+    )
+    return coefficients, result
+
+
+def cached_newton_solver(problem: ProblemConfig):
+    """The jit-compiled batched-Newton solver for one static problem
+    configuration: ``(objective, batch, w0) -> (Coefficients,
+    OptimizerResult)`` mapped over a leading entity axis.  Module-cached
+    like ``core.problem.cached_solver`` — every coordinate and sweep config
+    with the same static (optimizer config, variance) shares one traced
+    program, and jit's own cache keys on bin shapes."""
+    return _cached_newton_solver(
+        problem.optimizer_config, problem.variance_computation
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_newton_solver(cfg: OptimizerConfig, variance: str):
+    run = functools.partial(_run_newton_fit, cfg=cfg, variance=variance)
+    return jax.jit(jax.vmap(run, in_axes=(None, 0, 0)))
+
+
+def record_bin_telemetry(telemetry, coordinate: str, bin_stats: list,
+                         routes: list) -> None:
+    """Export the bin layout's padding economics as gauges — the ISSUE 8
+    observability satellite: ``solves.bin_occupancy`` (LIVE entities per
+    bin), ``solves.bin_entities_padded`` (mesh-padding slots), and
+    ``solves.padded_fraction`` (padded fraction of the bin's entity×row
+    cells — bin merging pads rows, mesh padding pads entities), so the bin
+    policy's waste is observable instead of guessed.  Labels carry the
+    coordinate, bin index, row capacity, and the routed solver."""
+    for b, (stats, route) in enumerate(zip(bin_stats, routes)):
+        labels = dict(
+            coordinate=coordinate, bin=str(b),
+            capacity=str(stats["capacity"]), route=route,
+        )
+        telemetry.gauge("solves.bin_occupancy", **labels).set(
+            stats["live_entities"]
+        )
+        telemetry.gauge("solves.bin_entities_padded", **labels).set(
+            stats["total_entities"] - stats["live_entities"]
+        )
+        cells = stats["total_entities"] * stats["capacity"]
+        telemetry.gauge("solves.padded_fraction", **labels).set(
+            0.0 if cells == 0 else 1.0 - stats["live_rows"] / cells
+        )

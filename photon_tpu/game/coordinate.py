@@ -60,8 +60,10 @@ from photon_tpu.game.model import (
     shard_to_batch,
 )
 from photon_tpu.models.glm import Coefficients, model_for_task
+from photon_tpu.telemetry import NULL_SESSION
 from photon_tpu.parallel.mesh import (
     DATA_AXIS,
+    first_axis_name,
     mesh_shards,
     pad_to_multiple,
     put_sharded,
@@ -99,12 +101,15 @@ def _accumulate_solve_stats(
     host sync of its own: the descent loop drains every coordinate's
     accumulator (plus the score-table guard flags) in ONE ``device_get``
     per outer iteration.  Padded entities (``entity_index >=
-    num_entities``) are masked out of every component."""
+    num_entities``) — bin-padding and mesh-padding slots alike — are
+    masked out of every component, so they can never inflate ``entities``
+    or ``converged``; a quarantined (non-finite) entity is not counted
+    converged either — its "solution" was discarded."""
     real = entity_index < num_entities
     real_i = real.astype(jnp.int32)
     return jnp.stack([
         acc[0] + real_i.sum(),
-        acc[1] + (converged.astype(jnp.int32) * real_i).sum(),
+        acc[1] + ((converged & good).astype(jnp.int32) * real_i).sum(),
         jnp.maximum(
             acc[2],
             jnp.max(jnp.where(real, iterations.astype(jnp.int32), 0)),
@@ -165,6 +170,54 @@ class DeferredSolveStats:
         return str(self.resolve()) if self._resolved is not None else (
             f"DeferredSolveStats(pending, extra={self.extra})"
         )
+
+
+def _foreign_src_idx(device_data, model_keys) -> np.ndarray:
+    """Cached foreign-vocabulary join: ``src_idx[e]`` is the row of
+    ``model_keys`` holding this dataset's entity ``e`` (-1 = absent).
+
+    The O(E) host key join used to run once per warm start — once per
+    (configuration × iteration) for a sweep warm-started from disk.  It is
+    keyed by the keys OBJECT's identity and cached on the shared device
+    data (the cached entry pins the keys array, so the id cannot be
+    recycled), closing part of the ROADMAP "host-resident paths" edge."""
+    cache = device_data._warm_join_cache
+    hit = cache.get(id(model_keys))
+    if hit is not None and hit[0] is model_keys:
+        return hit[1]
+    # host-sync: foreign-vocabulary key join (host keys) — once per
+    # distinct warm-start vocabulary, cached after.
+    src_idx = entity_index_for(
+        device_data.dataset.keys, np.asarray(model_keys)
+    )
+    if len(cache) >= 8:
+        cache.pop(next(iter(cache)))
+    cache[id(model_keys)] = (model_keys, src_idx)
+    return src_idx
+
+
+def _align_foreign_table(coord, initial_model) -> np.ndarray:
+    """Key-aligned host ``[E+1, dim]`` table of a FOREIGN warm-start model
+    (unseen entities zero; the dummy slot absorbs padded entities), with the
+    join's host traffic recorded as ``descent.host_transfer_bytes``
+    ``path=warm_start`` — the once-per-warm-start transfers the ROADMAP
+    flags, now visible next to the engines' steady-state counters."""
+    telemetry = getattr(coord, "telemetry", NULL_SESSION)
+    aligned = np.zeros(
+        (coord.dataset.num_entities + 1, coord.dim), np.float32
+    )
+    src_idx = _foreign_src_idx(coord.device_data, initial_model.keys)
+    found = src_idx >= 0
+    # host-sync: foreign warm start — the table fetch of the join.
+    table = to_host(initial_model.table)
+    telemetry.counter(
+        "descent.host_transfer_bytes", direction="d2h", path="warm_start"
+    ).inc(table.nbytes)
+    aligned[:-1][found] = table[src_idx[found]]
+    telemetry.counter(
+        "descent.host_transfer_bytes", direction="h2d", path="warm_start"
+    ).inc(aligned.nbytes)
+    return aligned
 
 
 def _bucket_offsets(device_data, i: int, bucket, offsets) -> Array:
@@ -513,7 +566,16 @@ class FixedEffectDeviceData:
 class RandomEffectDeviceData:
     """Bucketed per-entity data resident on device, entity axis sharded over
     the mesh.  Holds everything except offsets, which change per descent
-    iteration."""
+    iteration.
+
+    The raw power-of-two row-capacity buckets are consolidated into SIZE
+    BINS (``game.batched_solve.bin_layout``) before upload: each bin is one
+    padded ``[E, R, ...]`` block solved by a single jitted program —
+    ``self.buckets`` / ``self.device_buckets`` hold the binned blocks, and
+    ``self.bin_stats`` records each bin's padding economics for the
+    ``solves.*`` telemetry gauges.  New entities arriving between fits
+    extend the layout in place via :meth:`onboard` (appended bins, remapped
+    indices) instead of a full rebuild."""
 
     def __init__(
         self,
@@ -522,6 +584,7 @@ class RandomEffectDeviceData:
         mesh=None,
     ):
         self.mesh = mesh
+        self.config = config
         self.dataset: RandomEffectDataset = build_random_effect_dataset(
             data,
             entity_column=config.entity_column,
@@ -530,19 +593,8 @@ class RandomEffectDeviceData:
             seed=config.seed,
         )
         self.dim = self.dataset.dim
-        n_shards = 1 if mesh is None else int(np.prod(list(mesh.shape.values())))
+        n_shards = mesh_shards(mesh)
         self.row_split = bool(getattr(config, "row_split", False)) and n_shards > 1
-        if self.row_split:
-            # Entities replicated, each entity's ROWS sharded over the mesh
-            # (solve_entities_row_split); pad row capacity, not entities.
-            self.buckets = [
-                pad_bucket_rows(b, n_shards) for b in self.dataset.buckets
-            ]
-        else:
-            self.buckets = [
-                pad_bucket_entities(b, n_shards, self.dataset.num_entities)
-                for b in self.dataset.buckets
-            ]
         # Optional feature projection shrinks each bucket's solve dimension
         # (reference: data/projectors — see game.projection).
         self.random_matrix = None
@@ -558,44 +610,86 @@ class RandomEffectDeviceData:
         self._score_feats: Optional[tuple] = None
         self._score_entity_idx: Optional[Array] = None
         self._score_cache_bytes: int = 0
-        # Device-resident static parts: features / label / weight / entity idx.
-        self.device_buckets = []
-        for bucket in self.buckets:
-            feats = bucket.features
-            proj = None
-            if config.projection == "index_map":
-                from photon_tpu.game.projection import build_index_map_projection
+        # Foreign-vocabulary warm-start join cache: keys-object identity ->
+        # src_idx (see _align_foreign_table) — the O(E) host key join is
+        # paid once per distinct warm-start vocabulary, not once per warm
+        # start.
+        self._warm_join_cache: dict = {}
+        # Size-binned device blocks: features / label / weight / entity idx
+        # per bin.
+        self.buckets: list = []
+        self.device_buckets: list = []
+        self.bin_stats: list = []
+        self._append_bins(self.dataset.buckets)
 
-                proj = build_index_map_projection(bucket)
-            elif config.projection == "random":
-                proj = self.random_matrix
-            if proj is not None:
-                feats = proj.project(feats)
-            solve_dim = self.dim if proj is None else proj.projected_dim
-            if isinstance(feats, DenseShard):
-                dev_feats = (self._place(jnp.asarray(feats.x)),)
+    def _append_bins(self, raw_buckets) -> None:
+        """Bin ``raw_buckets`` (host ``EntityBucket``s over THIS dataset's
+        entity indices), pad for the mesh placement, upload, and append to
+        the device layout — the shared path of __init__ and onboard()."""
+        from photon_tpu.game.batched_solve import bin_layout
+        from photon_tpu.game.data import merge_buckets
+
+        n_shards = mesh_shards(self.mesh)
+        for group in bin_layout(raw_buckets):
+            merged = merge_buckets([raw_buckets[i] for i in group])
+            live_entities = merged.num_entities
+            live_rows = int((merged.row_weight > 0).sum())
+            if self.row_split:
+                # Entities replicated, each entity's ROWS sharded over the
+                # mesh (solve_entities_row_split); pad row capacity, not
+                # entities.
+                merged = pad_bucket_rows(merged, n_shards)
             else:
-                dev_feats = (
-                    self._place(jnp.asarray(feats.ids)),
-                    self._place(jnp.asarray(feats.vals)),
+                merged = pad_bucket_entities(
+                    merged, n_shards, self.dataset.num_entities
                 )
-            self.device_buckets.append(
-                {
-                    "feats": dev_feats,
-                    "dense": isinstance(feats, DenseShard),
-                    "label": self._place(jnp.asarray(bucket.label)),
-                    "weight": self._place(jnp.asarray(bucket.row_weight)),
-                    "entity_index": jnp.asarray(bucket.entity_index),
-                    "proj": proj,
-                    "solve_dim": solve_dim,
-                    "w0": self._place_w0(
-                        jnp.zeros((bucket.num_entities, solve_dim), jnp.float32)
-                    ),
-                }
+            self.buckets.append(merged)
+            self.bin_stats.append({
+                "capacity": merged.row_capacity,
+                "live_entities": live_entities,
+                "total_entities": merged.num_entities,
+                "live_rows": live_rows,
+            })
+            self.device_buckets.append(self._build_device_bucket(merged))
+
+    def _build_device_bucket(self, bucket) -> dict:
+        config = self.config
+        feats = bucket.features
+        proj = None
+        if config.projection == "index_map":
+            from photon_tpu.game.projection import build_index_map_projection
+
+            proj = build_index_map_projection(bucket)
+        elif config.projection == "random":
+            proj = self.random_matrix
+        if proj is not None:
+            feats = proj.project(feats)
+        solve_dim = self.dim if proj is None else proj.projected_dim
+        if isinstance(feats, DenseShard):
+            dev_feats = (self._place(jnp.asarray(feats.x)),)
+        else:
+            dev_feats = (
+                self._place(jnp.asarray(feats.ids)),
+                self._place(jnp.asarray(feats.vals)),
             )
+        return {
+            "feats": dev_feats,
+            "dense": isinstance(feats, DenseShard),
+            "label": self._place(jnp.asarray(bucket.label)),
+            "weight": self._place(jnp.asarray(bucket.row_weight)),
+            "entity_index": jnp.asarray(bucket.entity_index),
+            "proj": proj,
+            "solve_dim": solve_dim,
+            "w0": self._place_w0(
+                jnp.zeros((bucket.num_entities, solve_dim), jnp.float32)
+            ),
+        }
 
     def _sharding(self, ndim: int):
-        axis = next(iter(self.mesh.shape))  # single-axis mesh
+        # The mesh's one physical axis — the same axis the score tables
+        # shard their row dimension over (parallel.mesh.first_axis_name):
+        # entity blocks and score rows split across the same chips.
+        axis = first_axis_name(self.mesh)
         if self.row_split:
             # [E, R, ...]: entities replicated, the row axis sharded.
             if ndim < 2:
@@ -673,6 +767,113 @@ class RandomEffectDeviceData:
         return SparseBatch(
             dev["feats"][0], dev["feats"][1], dev["label"], offsets_b, dev["weight"]
         )
+
+    def check_onboard(self, data: GameDataset) -> None:
+        """Validate :meth:`onboard`'s preconditions WITHOUT mutating — so a
+        caller onboarding several layouts (the estimator's device-data
+        cache) can reject the whole batch up front instead of leaving some
+        layouts grown and others not (a half-onboarded cache would mix
+        grown bucket row indices with old-length offset vectors)."""
+        old = self.dataset
+        n_old = len(old.entity_idx_per_row)
+        if data.num_examples < n_old:
+            raise ValueError(
+                f"onboard() needs the GROWN dataset: got {data.num_examples} "
+                f"rows, the layout was built from {n_old}"
+            )
+        raw_tail = data.id_columns[self.config.entity_column][n_old:]
+        if len(raw_tail) and (entity_index_for(raw_tail, old.keys) >= 0).any():
+            raise ValueError(
+                "appended rows reference EXISTING entities; incremental "
+                "onboarding only appends new entities — rebuild the device "
+                "data to retrain existing entities on new rows"
+            )
+
+    def onboard(self, data: GameDataset) -> None:
+        """Incremental entity onboarding: extend this device layout with NEW
+        entities whose rows were APPENDED to the training data, without a
+        full rebuild.
+
+        ``data`` is the grown dataset — its first ``n_old`` rows must be the
+        rows this layout was built from (append-only; existing entities'
+        data cannot change through this path, and appended rows referencing
+        an existing entity are rejected).  Work done here is proportional to
+        the NEW entities: their rows are bucketed, binned, and uploaded as
+        appended bins; the resident feature blocks of existing bins are
+        untouched — only their tiny ``entity_index`` vectors are remapped
+        (one device gather each) onto the merged vocabulary, whose sort
+        order interleaves the new keys.  Scoring-side caches (features /
+        per-row entity index) are dropped and lazily rebuilt at the grown
+        row count on next use."""
+        from photon_tpu.game.data import take_rows
+
+        self.check_onboard(data)
+        old = self.dataset
+        n_old = len(old.entity_idx_per_row)
+        raw_tail = data.id_columns[self.config.entity_column][n_old:]
+        if len(raw_tail) == 0:
+            return
+        merged_keys = np.unique(np.concatenate([old.keys, np.unique(raw_tail)]))
+        # Old index -> merged index, with the dummy padding slot
+        # (old num_entities) mapped to the NEW dummy slot.
+        remap = entity_index_for(old.keys, merged_keys)
+        remap_full = np.concatenate(
+            [remap, [len(merged_keys)]]
+        ).astype(np.int32)
+        remap_dev = jnp.asarray(remap_full)
+        for i, bucket in enumerate(self.buckets):
+            self.buckets[i] = dataclasses.replace(
+                bucket, entity_index=remap_full[bucket.entity_index]
+            )
+            dev = self.device_buckets[i]
+            dev["entity_index"] = remap_dev[dev["entity_index"]]
+        old_per_row = np.where(
+            old.entity_idx_per_row >= 0,
+            remap_full[np.maximum(old.entity_idx_per_row, 0)],
+            -1,
+        ).astype(np.int32)
+
+        # Bucket ONLY the appended rows (local entity space), then lift the
+        # bucket indices into the merged vocabulary / global row space.
+        tail = take_rows(data, np.arange(n_old, data.num_examples))
+        new_ds = build_random_effect_dataset(
+            tail,
+            entity_column=self.config.entity_column,
+            shard_name=self.config.shard_name,
+            active_row_cap=self.config.active_row_cap,
+            seed=self.config.seed,
+        )
+        new_to_merged = np.concatenate([
+            entity_index_for(new_ds.keys, merged_keys),
+            [len(merged_keys)],  # new-bucket dummy slot -> merged dummy
+        ]).astype(np.int32)
+        self.dataset = dataclasses.replace(
+            old,
+            keys=merged_keys,
+            buckets=tuple(self.buckets),
+            entity_idx_per_row=np.concatenate([
+                old_per_row,
+                new_to_merged[new_ds.entity_idx_per_row],
+            ]),
+        )
+        lifted = [
+            dataclasses.replace(
+                b,
+                entity_index=new_to_merged[b.entity_index],
+                row_index=b.row_index + n_old,
+            )
+            for b in new_ds.buckets
+        ]
+        self._append_bins(lifted)
+        self.dataset = dataclasses.replace(
+            self.dataset, buckets=tuple(self.buckets)
+        )
+        # Row count and vocabulary changed: the scoring caches and the
+        # warm-start join cache are stale — drop them (rebuilt lazily).
+        self._score_feats = None
+        self._score_entity_idx = None
+        self._score_cache_bytes = 0
+        self._warm_join_cache.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -821,6 +1022,50 @@ class RandomEffectCoordinate:
             self.problem.solver(vmapped=True), self.problem.objective
         )
 
+    def _bin_routes(self) -> list:
+        """Per-bin solver route (``newton``/``vmapped``/``row_split``) —
+        see game.batched_solve.solver_route.  Cached per coordinate (the
+        descent loop calls train() every outer iteration; the routes only
+        change when onboarding extends the bin layout, which the bin-count
+        key detects — coordinates are rebuilt per sweep configuration, so
+        the problem-config component never goes stale)."""
+        from photon_tpu.game.batched_solve import solver_route
+
+        cached = getattr(self, "_routes_cache", None)
+        n_bins = len(self.device_data.device_buckets)
+        if cached is not None and cached[0] == n_bins:
+            return cached[1]
+        routes = [
+            solver_route(
+                self.config.problem, dev["solve_dim"],
+                row_split=self.device_data.row_split,
+            )
+            for dev in self.device_data.device_buckets
+        ]
+        self._routes_cache = (n_bins, routes)
+        return routes
+
+    def _solve_bin(self, route: str, batch, w0):
+        """Dispatch one bin's batched solve along its resolved route: the
+        batched-Cholesky Newton program (small-dim smooth bins), the
+        row-split psum solve, or the vmapped iterative solver (L1 /
+        large-dim bins — every existing problem config still solves)."""
+        if route == "newton":
+            from photon_tpu.game.batched_solve import cached_newton_solver
+
+            return cached_newton_solver(self.config.problem)(
+                self.problem.objective, batch, w0
+            )
+        if route == "row_split":
+            from photon_tpu.parallel.distributed import solve_entities_row_split
+
+            return solve_entities_row_split(
+                self.problem.objective, self.config.problem,
+                batch, w0, self.mesh,
+                axis_name=first_axis_name(self.mesh),
+            )
+        return self._solver(batch, w0)
+
     def _initial_table(self, initial_model: RandomEffectModel) -> Array:
         """Align a warm-start model's per-entity rows onto THIS dataset's
         vocabulary by key (the model may come from different training data —
@@ -843,14 +1088,11 @@ class RandomEffectCoordinate:
             return jnp.concatenate(
                 [table, jnp.zeros((1, self.dim), table.dtype)]
             )
-        # host-sync: foreign-vocabulary warm start joins by key on host,
-        # once per warm start (not per iteration).
-        aligned = np.zeros((self.dataset.num_entities + 1, self.dim), np.float32)
-        src_idx = entity_index_for(self.dataset.keys, np.asarray(initial_model.keys))
-        found = src_idx >= 0
-        # host-sync: same foreign warm start — the table fetch of the join.
-        aligned[:-1][found] = to_host(initial_model.table)[src_idx[found]]
-        return jnp.asarray(aligned)
+        # Foreign vocabulary: host key join, with the computed src_idx
+        # CACHED per keys-object identity on the shared device data (the
+        # sweep re-passes the same warm-start model once per configuration
+        # × iteration) and its transfers counted — _align_foreign_table.
+        return jnp.asarray(_align_foreign_table(self, initial_model))
 
     def train(
         self, offsets: np.ndarray, initial_model: Optional[RandomEffectModel] = None
@@ -880,6 +1122,19 @@ class RandomEffectCoordinate:
         )
 
         inject_nan = consume_nan_injection(getattr(self, "fault_name", None))
+        routes = self._bin_routes()
+        # Gauges describe the (static) bin layout: set them once per
+        # coordinate, again only if onboarding extended the layout — not
+        # once per outer descent iteration.
+        if getattr(self, "_bins_recorded", None) != len(routes):
+            from photon_tpu.game.batched_solve import record_bin_telemetry
+
+            record_bin_telemetry(
+                getattr(self, "telemetry", NULL_SESSION),
+                getattr(self, "fault_name", self.config.shard_name),
+                self.device_data.bin_stats, routes,
+            )
+            self._bins_recorded = len(routes)
         for i, bucket in enumerate(self.device_data.buckets):
             offsets_b = _bucket_offsets(self.device_data, i, bucket, offsets)
             batch = self.device_data.batch_for(i, offsets_b)
@@ -895,18 +1150,7 @@ class RandomEffectCoordinate:
                 )
             else:
                 w0 = dev["w0"]
-            if self.device_data.row_split:
-                from photon_tpu.parallel.distributed import (
-                    solve_entities_row_split,
-                )
-
-                coefficients, result = solve_entities_row_split(
-                    self.problem.objective, self.config.problem,
-                    batch, w0, self.mesh,
-                    axis_name=next(iter(self.mesh.shape)),
-                )
-            else:
-                coefficients, result = self._solver(batch, w0)
+            coefficients, result = self._solve_bin(routes[i], batch, w0)
             means, variances = coefficients.means, coefficients.variances
             if inject_nan and i == 0:
                 # Fault injection (solve:nan): poison one entity's solve so
@@ -1110,13 +1354,11 @@ class FactoredRandomEffectCoordinate:
         returns the key-aligned previous table — the quarantine fallback
         rows — since the SVD fetched it to host anyway (the factored warm
         start is a known host-resident edge, see ROADMAP)."""
-        aligned = np.zeros((self.dataset.num_entities + 1, self.dim), np.float32)
-        # host-sync: factored warm start — the rank-r SVD of the previous
-        # table runs in numpy, once per warm start (not per iteration).
-        src_idx = entity_index_for(self.dataset.keys, np.asarray(initial_model.keys))
-        found = src_idx >= 0
-        # host-sync: same factored warm start — the table fetch of the join.
-        aligned[:-1][found] = to_host(initial_model.table)[src_idx[found]]
+        # Key-aligned previous table via the shared (cached) foreign join;
+        # the rank-r SVD below runs in numpy, once per warm start (not per
+        # iteration) — the factored warm start is a known host-resident
+        # edge, see ROADMAP.
+        aligned = _align_foreign_table(self, initial_model)
         u, s, vt = np.linalg.svd(aligned, full_matrices=False)
         r = self.r
         sq = np.sqrt(s[:r])

@@ -267,16 +267,6 @@ def keys_match(keys, ref, ref_array: Optional[np.ndarray] = None) -> bool:
     )
 
 
-def _bucket_capacity(count: int, cap: Optional[int]) -> int:
-    """Power-of-two row capacity for an entity with ``count`` active rows."""
-    if cap is not None:
-        count = min(count, cap)
-    r = 1
-    while r < count:
-        r *= 2
-    return r
-
-
 def build_random_effect_dataset(
     data: GameDataset,
     entity_column: str,
@@ -317,40 +307,62 @@ def build_random_effect_dataset(
     starts = np.concatenate([[0], np.cumsum(counts)])
 
     rng = np.random.default_rng(seed)
-    # Cohort entities by padded row capacity.
-    by_capacity: Dict[int, list[tuple[int, np.ndarray, float]]] = {}
-    for e in range(len(keys)):
-        count = int(counts[e])
-        if count == 0:
-            continue  # vocab entity with no data: stays at zero coefficients
-        entity_rows = rows_in_order[starts[e] : starts[e + 1]]
-        correction = 1.0
-        if active_row_cap is not None and count > active_row_cap:
+    # Per-entity kept rows: an index into rows_in_order for the common
+    # (uncapped) case, so the cohort assembly below can gather VECTORIZED
+    # over all entities of a capacity at once — the Python-loop-per-entity
+    # build capped entity counts in the tens of thousands.  Only entities
+    # OVER the active-row cap take the per-entity subsample path (seeded
+    # draws in entity order, byte-identical to the historical loop).
+    kept_counts = counts.copy()
+    capped_rows: Dict[int, np.ndarray] = {}
+    if active_row_cap is not None:
+        for e in np.nonzero(counts > active_row_cap)[0]:
+            entity_rows = rows_in_order[starts[e] : starts[e + 1]]
             # Active-set subsample with unbiased weight correction (the
             # reference's numActiveDataPointsUpperBound down-sampling).
-            entity_rows = rng.choice(entity_rows, size=active_row_cap, replace=False)
+            entity_rows = rng.choice(
+                entity_rows, size=active_row_cap, replace=False
+            )
             entity_rows.sort()
-            correction = count / active_row_cap
-        capacity = _bucket_capacity(len(entity_rows), active_row_cap)
-        by_capacity.setdefault(capacity, []).append((e, entity_rows, correction))
+            capped_rows[int(e)] = entity_rows
+            kept_counts[e] = active_row_cap
+
+    present_entities = np.nonzero(counts > 0)[0]
+    # Padded power-of-two row capacity per entity.
+    kept = kept_counts[present_entities]
+    capacities = 1 << np.maximum(
+        0, np.ceil(np.log2(np.maximum(kept, 1))).astype(np.int64)
+    )
 
     buckets = []
-    for capacity in sorted(by_capacity):
-        members = by_capacity[capacity]
+    for capacity in np.unique(capacities):
+        members = present_entities[capacities == capacity]
         n_e = len(members)
-        entity_index = np.empty(n_e, np.int32)
+        entity_index = members.astype(np.int32)
         row_index = np.zeros((n_e, capacity), np.int64)
-        mask = np.zeros((n_e, capacity), Float)
-        corrections = np.empty(n_e, Float)
-        for i, (e, entity_rows, correction) in enumerate(members):
-            entity_index[i] = e
-            row_index[i, : len(entity_rows)] = entity_rows
-            mask[i, : len(entity_rows)] = 1.0
-            corrections[i] = correction
+        mask = (
+            np.arange(capacity)[None, :] < kept_counts[members][:, None]
+        ).astype(Float)
+        corrections = np.ones(n_e, Float)
+        uncapped = np.nonzero(counts[members] <= kept_counts[members])[0]
+        if len(uncapped):
+            m = members[uncapped]
+            # Gather each uncapped entity's contiguous rows_in_order slice:
+            # clamp keeps the index in range; mask zeroes the padding.
+            idx = starts[m][:, None] + np.arange(capacity)[None, :]
+            row_index[uncapped] = np.where(
+                mask[uncapped] > 0,
+                rows_in_order[np.minimum(idx, len(rows_in_order) - 1)],
+                0,
+            )
+        for i in np.nonzero(counts[members] > kept_counts[members])[0]:
+            e = int(members[i])
+            row_index[i, : kept_counts[e]] = capped_rows[e]
+            corrections[i] = counts[e] / kept_counts[e]
         row_weight = data.weight[row_index] * mask * corrections[:, None]
         buckets.append(
             EntityBucket(
-                row_capacity=capacity,
+                row_capacity=int(capacity),
                 entity_index=entity_index,
                 row_index=row_index,
                 row_weight=row_weight.astype(Float),
@@ -366,6 +378,106 @@ def build_random_effect_dataset(
         keys=keys,
         buckets=tuple(buckets),
         entity_idx_per_row=entity_idx_per_row,
+    )
+
+
+def plan_size_bins(
+    buckets: tuple,
+    max_bins: int = 4,
+    waste_cap: float = 2.0,
+) -> list:
+    """Group row-capacity buckets into at most ``max_bins`` SIZE BINS.
+
+    The power-of-two buckets bound per-entity padding to 2x, but each bucket
+    is a separately-dispatched, separately-compiled solve: at production
+    entity counts the O(buckets) host dispatches and compiled programs are
+    the scaling cap (ISSUE 8).  A size bin merges adjacent capacities into
+    ONE padded block solved by a single jitted program — entities of a
+    smaller bucket get their row axis padded (weight-0 rows) up to the
+    bin's capacity.
+
+    Policy: walk capacities from LARGEST to smallest, greedily absorbing a
+    smaller bucket into the current bin while the bin's padded row cells
+    stay within ``waste_cap`` × its live (bucket-padded) row cells; then, if
+    more than ``max_bins`` bins remain, merge the adjacent pair that adds
+    the fewest padded cells until the count fits.  Deterministic in the
+    bucket list alone.
+
+    Returns a list of bucket-index groups, each ascending, ordered by
+    ascending capacity — ``merge_buckets`` turns a group into the padded
+    block.
+    """
+    if max_bins < 1:
+        raise ValueError("max_bins must be >= 1")
+    stats = [
+        (i, bucket.row_capacity, bucket.num_entities)
+        for i, bucket in enumerate(buckets)
+    ]
+
+    def padded(members, cap):
+        return cap * sum(n for _, _, n in members)
+
+    def base(members):
+        return sum(c * n for _, c, n in members)
+
+    bins: list = []  # descending capacity; each a list of (idx, cap, n)
+    for entry in sorted(stats, key=lambda t: -t[1]):
+        if bins:
+            members = bins[-1] + [entry]
+            cap = members[0][1]
+            if padded(members, cap) <= waste_cap * base(members):
+                bins[-1] = members
+                continue
+        bins.append([entry])
+    while len(bins) > max_bins:
+        costs = []
+        for j in range(len(bins) - 1):
+            members = bins[j] + bins[j + 1]
+            cap = members[0][1]
+            grown = padded(members, cap)
+            costs.append(
+                grown - padded(bins[j], bins[j][0][1])
+                - padded(bins[j + 1], bins[j + 1][0][1])
+            )
+        j = int(np.argmin(costs))
+        bins[j : j + 2] = [bins[j] + bins[j + 1]]
+    return [sorted(i for i, _, _ in members) for members in reversed(bins)]
+
+
+def merge_buckets(buckets: list) -> EntityBucket:
+    """Merge one size bin's buckets into a single padded ``EntityBucket``.
+
+    Every member's row axis is padded (weight-0 rows, ``row_index`` 0 — the
+    bucket convention) up to the bin capacity, then the entity axes
+    concatenate; member order is the given order (ascending capacity from
+    :func:`plan_size_bins`), entities keeping their within-bucket order.
+    """
+    if len(buckets) == 1:
+        return buckets[0]
+    capacity = max(b.row_capacity for b in buckets)
+    padded = [pad_bucket_rows(b, capacity) for b in buckets]
+
+    def cat(field):
+        return np.concatenate([getattr(b, field) for b in padded])
+
+    features = [b.features for b in padded]
+    if isinstance(features[0], DenseShard):
+        merged_features: Shard = DenseShard(
+            np.concatenate([f.x for f in features])
+        )
+    else:
+        merged_features = SparseShard(
+            np.concatenate([f.ids for f in features]),
+            np.concatenate([f.vals for f in features]),
+            features[0].dim_,
+        )
+    return EntityBucket(
+        row_capacity=capacity,
+        entity_index=cat("entity_index"),
+        row_index=cat("row_index"),
+        row_weight=cat("row_weight"),
+        label=cat("label"),
+        features=merged_features,
     )
 
 

@@ -170,7 +170,51 @@ class GameEstimator:
             # sites (solve:nan:coord=<name>) and quarantine telemetry can
             # address it.
             coord.fault_name = name
+            # The coordinates' own telemetry (bin-occupancy gauges,
+            # warm-start transfer counters) lands in the run's session.
+            coord.telemetry = self.telemetry
         return coords
+
+    def onboard_training_data(self, data: GameDataset) -> None:
+        """Incremental entity onboarding between fits: swap in a GROWN
+        training dataset whose appended rows belong to NEW random-effect
+        entities.
+
+        The cached random-effect device layouts extend in place
+        (:meth:`~photon_tpu.game.coordinate.RandomEffectDeviceData.onboard`
+        — appended bins, remapped indices, resident feature blocks
+        untouched); fixed-effect device data is whole-dataset and is
+        dropped for a lazy rebuild on the next fit.  Warm-start models from
+        the previous fit can be grown to the merged vocabulary on device
+        with :meth:`~photon_tpu.game.model.RandomEffectModel.with_entities`.
+        """
+        from photon_tpu.game.coordinate import RandomEffectDeviceData
+
+        if data.num_examples < self.training_data.num_examples:
+            raise ValueError(
+                "onboard_training_data() needs the grown dataset (rows are "
+                "append-only)"
+            )
+        with self.telemetry.span(
+            "estimator.onboard", rows=data.num_examples
+        ):
+            # Validate EVERY layout's preconditions before mutating any:
+            # one layout rejecting mid-loop must not leave the cache
+            # half-onboarded (grown per-user bins against an old-length
+            # offsets vector).
+            for dd in self._device_data_cache.values():
+                if isinstance(dd, RandomEffectDeviceData):
+                    dd.check_onboard(data)
+            for key, dd in list(self._device_data_cache.items()):
+                if isinstance(dd, RandomEffectDeviceData):
+                    before = dd.dataset.num_entities
+                    dd.onboard(data)
+                    self.telemetry.counter("estimator.entities_onboarded").inc(
+                        dd.dataset.num_entities - before
+                    )
+                else:
+                    del self._device_data_cache[key]
+        self.training_data = data
 
     def fit(
         self,

@@ -159,6 +159,40 @@ class RandomEffectModel:
         variances = None if self.variances is None else self.variances[idx]
         return model_for_task(self.task_type, Coefficients(self.table[idx], variances))
 
+    def with_entities(self, keys: np.ndarray) -> "RandomEffectModel":
+        """Grow this model to a larger entity vocabulary ON DEVICE — the
+        incremental-onboarding warm start.
+
+        ``keys`` is the merged (superset) vocabulary — typically the
+        onboarded device data's ``dataset.keys``.  Existing entities keep
+        their rows, scattered to their new sorted positions with one device
+        scatter (no host table rebuild); new entities start at zero
+        coefficients (they have never been fit), exactly what a cold start
+        would give them.  Variances scatter alongside when present."""
+        merged = np.asarray(keys)
+        from photon_tpu.game.data import entity_index_for
+
+        idx = entity_index_for(self.keys, merged)
+        if (idx < 0).any():
+            raise ValueError(
+                "with_entities() grows the vocabulary: every existing "
+                "entity key must appear in the merged keys"
+            )
+        idx_dev = jnp.asarray(idx)
+
+        def scatter(table):
+            grown = jnp.zeros((len(merged), self.dim), jnp.float32)
+            return grown.at[idx_dev].set(jnp.asarray(table, jnp.float32))
+
+        return dataclasses.replace(
+            self,
+            table=scatter(self.table),
+            keys=merged,
+            variances=(
+                None if self.variances is None else scatter(self.variances)
+            ),
+        )
+
     def score(self, data: GameDataset) -> np.ndarray:
         from photon_tpu.game.data import entity_index_for
 

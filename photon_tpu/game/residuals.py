@@ -171,6 +171,13 @@ def _set_row_and_resum(
 
 
 @jax.jit
+def _resum_rows(scores: Array) -> tuple[Array, Array]:
+    """Fresh compensated total of a (non-donated) table — the table-growth
+    path rebuilds total/comp after appending rows."""
+    return _neumaier_rows(scores)
+
+
+@jax.jit
 def _offsets_kernel(base: Array, total: Array, comp: Array,
                     scores: Array, c) -> Array:
     """Training offsets for coordinate ``c``: ``base + Σ_{k≠c} scores[k]``
@@ -385,6 +392,40 @@ class _DeviceScoreTable:
                 # (asarray normalizes dtype; no device fetch happens here).
                 self.update(name, np.asarray(row, np.float32))
 
+    def grow(self, base_offset: np.ndarray) -> None:
+        """Extend the table to cover APPENDED training rows (incremental
+        entity onboarding — ISSUE 8): existing score rows keep their values
+        on device (one pad + re-shard, no d2h round-trip), appended rows
+        start at zero until the next update()/re-score fills them, and the
+        base offset is replaced by the grown vector.  The compensated
+        total rebuilds from the grown table, so compensation error cannot
+        leak across the growth."""
+        new_n = int(len(base_offset))
+        if new_n < self.n:
+            raise ValueError(
+                f"grow() only appends rows: table holds {self.n}, got {new_n}"
+            )
+        old_scores, old_n = self.scores, self.n
+        self.n = new_n
+        self.n_pad = pad_to_multiple(new_n, mesh_shards(self.mesh))
+        base = np.zeros(self.n_pad, np.float32)
+        # host-sync: one-time base-offset staging of the grown vector (an
+        # upload, same as __init__ — no device fetch happens here).
+        base[: self.n] = np.asarray(base_offset, np.float32)
+        self.base = self._put(base)
+        self.telemetry.counter(
+            "descent.host_transfer_bytes", direction="h2d", path=self._PATH
+        ).inc(self.base.nbytes)
+        grown = jnp.pad(
+            old_scores[:, :old_n], ((0, 0), (0, self.n_pad - old_n))
+        )
+        self.scores = self._device(grown, axis=1)
+        total, comp = _resum_rows(self.scores)
+        self.total = self._device(total)
+        self.comp = self._device(comp)
+        if self._BYTES_GAUGE:
+            self.telemetry.gauge(self._BYTES_GAUGE).set(self.device_bytes)
+
 
 class ResidualEngine(_DeviceScoreTable):
     """Training-side per-coordinate score vectors resident on device with a
@@ -532,3 +573,21 @@ class HostResiduals:
                     f"want {self.base.shape}"
                 )
             self.scores[name] = host
+
+    def grow(self, base_offset: np.ndarray) -> None:
+        """Append-rows growth (entity onboarding), mirroring the device
+        engines: existing rows keep their values, appended rows are zero
+        until re-scored."""
+        # host-sync: the escape hatch keeps ALL residual state on host.
+        new_base = np.asarray(base_offset, np.float64)
+        old_n = len(self.base)
+        if len(new_base) < old_n:
+            raise ValueError(
+                f"grow() only appends rows: table holds {old_n}, got "
+                f"{len(new_base)}"
+            )
+        self.base = new_base
+        self.scores = {
+            name: np.pad(s, (0, len(new_base) - old_n))
+            for name, s in self.scores.items()
+        }

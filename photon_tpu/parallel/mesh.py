@@ -125,6 +125,14 @@ def mesh_shards(mesh: Optional[Mesh]) -> int:
     return int(np.prod(list(mesh.shape.values())))
 
 
+def first_axis_name(mesh: Mesh) -> str:
+    """The mesh's leading (in practice: only) axis name — the one physical
+    axis the data-sharded score tables AND the entity-sharded random-effect
+    solve bins both split over.  One accessor so the two layouts cannot
+    silently pick different axes on a future multi-axis mesh."""
+    return next(iter(mesh.shape))
+
+
 def axis_sharding(
     mesh: Mesh, ndim: int, axis: int = 0, axis_name: str = DATA_AXIS
 ) -> NamedSharding:

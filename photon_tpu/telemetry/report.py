@@ -153,6 +153,41 @@ def _render_pipeline_section(report: dict) -> list:
     return lines
 
 
+def _render_entity_solves_section(report: dict) -> list:
+    """The random-effect size-bin layout at a glance (``solves.*`` gauges):
+    per (coordinate, bin) — routed solver, row capacity, live vs padded
+    entities, and the padded fraction of the bin's entity×row cells — so
+    the bin policy's padding waste is observable instead of guessed.
+    Empty when the run trained no random-effect coordinate."""
+    metrics = report.get("metrics") or {}
+    by_bin: dict = {}
+    for m in metrics.get("gauges") or []:
+        if not m["name"].startswith("solves."):
+            continue
+        labels = m.get("labels", {})
+        key = (labels.get("coordinate", "?"), labels.get("bin", "?"))
+        entry = by_bin.setdefault(key, dict(labels))
+        entry[m["name"]] = m["value"]
+    if not by_bin:
+        return []
+    lines = [
+        "", "## Entity solves", "",
+        "| coordinate | bin | capacity | route | live entities "
+        "| padded entities | padded fraction |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for (coord, b) in sorted(by_bin):
+        e = by_bin[(coord, b)]
+        lines.append(
+            f"| {coord} | {b} | {e.get('capacity', '—')} "
+            f"| {e.get('route', '—')} "
+            f"| {_fmt(e.get('solves.bin_occupancy'))} "
+            f"| {_fmt(e.get('solves.bin_entities_padded'))} "
+            f"| {_fmt(e.get('solves.padded_fraction'))} |"
+        )
+    return lines
+
+
 def render_markdown(report: dict) -> str:
     """Human-readable view of a run report dict."""
     lines = [
@@ -189,6 +224,7 @@ def render_markdown(report: dict) -> str:
             lines.append(f"| {name} | {secs:.3f} |")
 
     lines += _render_pipeline_section(report)
+    lines += _render_entity_solves_section(report)
 
     metrics = report.get("metrics") or {}
     counters = metrics.get("counters") or []

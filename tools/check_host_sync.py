@@ -37,6 +37,10 @@ DEFAULT_FILES = (
     "photon_tpu/game/residuals.py",
     "photon_tpu/game/descent.py",
     "photon_tpu/game/coordinate.py",
+    # The size-binned batched solve layer runs INSIDE the bin loop of
+    # every RandomEffectCoordinate.train: a host fetch here would repeal
+    # the one-sync-per-iteration contract for every random coordinate.
+    "photon_tpu/game/batched_solve.py",
     "photon_tpu/fault/checkpoint.py",
     # The preemption/watchdog layers run ON the hot loop's thread (the
     # boundary checks) or beside it (the heartbeat thread): neither may
