@@ -890,6 +890,158 @@ def _entities_descent_checks() -> dict:
     return out
 
 
+def _serving_fixture():
+    """Synthetic GAME model + request source for the serving bench: the
+    model is CONSTRUCTED (seeded coefficient tables over the dataset's
+    entity vocabulary), not fitted — serving measures scoring, and a fit
+    would dominate the bench's wall clock for nothing."""
+    import jax
+
+    from photon_tpu.data.synthetic import make_game_dataset
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, model_for_task
+
+    platform = jax.devices()[0].platform
+    big = platform != "cpu"
+    n_entities, rows_mean = (20_000, 20) if big else (4000, 8)
+    fixed_dim, random_dim = 32, 8
+    data, _ = make_game_dataset(
+        n_entities, rows_mean, fixed_dim, random_dim, seed=0,
+        n_random_coords=2,
+    )
+    rng = np.random.default_rng(7)
+    coordinates = {
+        "fixed": FixedEffectModel(
+            model_for_task("logistic_regression", Coefficients(
+                rng.standard_normal(fixed_dim).astype(np.float32)
+            )),
+            "global",
+        )
+    }
+    for name in ("re0", "re1"):
+        keys = np.unique(data.id_columns[name])
+        coordinates[name] = RandomEffectModel(
+            table=rng.standard_normal(
+                (len(keys), random_dim)
+            ).astype(np.float32),
+            keys=keys, entity_column=name, shard_name=name,
+            task_type="logistic_regression",
+        )
+    model = GameModel(
+        coordinates=coordinates, task_type="logistic_regression"
+    )
+    return platform, model, data
+
+
+def _bench_serving() -> None:
+    """Online GAME scoring-service micro-bench (``--mode serving``).
+
+    Drives a seeded long-tailed request stream (the serve_game driver's
+    size distribution) through the device-resident
+    :class:`~photon_tpu.serving.GameScorer` + async batcher with
+    closed-loop clients, and reports p50/p99 request latency and QPS
+    against the per-request HOST-scoring baseline (``GameModel.score`` on
+    each request's dataset slice — the only serving story the repo had
+    before the serving layer).  The emitted value is the served QPS;
+    the baseline QPS and the ratio ride the detail so the speedup is a
+    printed comparison, not a bare number."""
+    from photon_tpu.drivers.serve_game import request_sizes
+    from photon_tpu.game.data import take_rows
+    from photon_tpu.serving import (
+        GameScorer,
+        RequestBatcher,
+        build_requests,
+        request_spec_for_dataset,
+        run_closed_loop,
+    )
+    from photon_tpu.telemetry import TelemetrySession
+
+    platform, model, data = _serving_fixture()
+    max_batch, clients, mean_rows = 128, 16, 8.0
+    n_requests = 1500 if platform != "cpu" else 400
+    session = TelemetrySession("bench-serving")
+    scorer = GameScorer(
+        model, request_spec=request_spec_for_dataset(model, data),
+        max_batch=max_batch, telemetry=session,
+    )
+    t0 = time.perf_counter()
+    scorer.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    sizes = request_sizes(n_requests, mean_rows, max_batch, seed=0)
+    requests = build_requests(data, model, sizes)
+    with RequestBatcher(
+        scorer, max_batch=max_batch, max_delay_s=0.001, telemetry=session
+    ) as batcher:
+        scores, latencies, wall = run_closed_loop(
+            batcher, requests, clients=clients
+        )
+    rows = int(sizes.sum())
+    qps = len(requests) / wall
+    lat_ms = np.sort(np.asarray(latencies, np.float64)) * 1e3
+
+    # Host baseline: per-request GameModel.score over the SAME row windows
+    # (request_windows — the definition build_requests cut from, so the
+    # parity oracle cannot drift onto misaligned rows; a warmup pass pays
+    # each distinct shape's compile, as serving's warmup did), on a subset
+    # big enough to time and small enough not to dominate the bench.
+    from photon_tpu.serving import request_windows
+
+    n_base = min(len(requests), 100)
+    windows = request_windows(data.num_examples, sizes[:n_base])
+    chunks = [take_rows(data, w) for w in windows]
+    host_scores = [model.score(c) for c in chunks]  # warmup + parity oracle
+    t0 = time.perf_counter()
+    for c in chunks:
+        model.score(c)
+    host_wall = time.perf_counter() - t0
+    host_qps = n_base / host_wall
+
+    worst = max(
+        float(np.abs(s[: len(h)] - h).max())
+        for s, h in zip(scores[:n_base], host_scores)
+    )
+    if worst > 1e-3:
+        raise AssertionError(
+            f"serving/host parity broke: max |delta| {worst:.2e}"
+        )
+    snapshot = session.registry.snapshot()
+    totals = {}
+    for m in snapshot["counters"]:
+        totals[m["name"]] = totals.get(m["name"], 0) + m["value"]
+    batches = totals.get("serving.batches", 0)
+    if totals.get("serving.host_syncs", 0) > batches:
+        raise AssertionError("serving.host_syncs exceeded one per batch")
+    pad_hist = next(
+        (h for h in snapshot["histograms"]
+         if h["name"] == "serving.padded_fraction"), {},
+    )
+    _emit("game_serving_qps", qps, "req/s", {
+        "requests": len(requests),
+        "rows": rows,
+        "clients": clients,
+        "max_batch": max_batch,
+        "mean_request_rows": round(float(sizes.mean()), 2),
+        "latency_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "latency_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "rows_per_sec": round(rows / wall, 1),
+        "batches": int(batches),
+        "requests_per_batch": round(len(requests) / batches, 2) if batches else None,
+        "padded_fraction_mean": round(pad_hist.get("mean") or 0.0, 3),
+        "cold_entities": int(totals.get("serving.cold_entities", 0)),
+        "compiled_programs": scorer.compilations,
+        "warmup_seconds": round(warmup_s, 3),
+        "host_baseline_qps": round(host_qps, 2),
+        "speedup_vs_host_qps": round(qps / host_qps, 2),
+        "max_parity_delta": worst,
+        "platform": platform,
+    })
+
+
 def _bench_recovery() -> None:
     """Checkpoint write/restore overhead micro-bench (``--mode recovery``).
 
@@ -1412,6 +1564,7 @@ def main() -> None:
             "validation": _bench_validation,
             "recovery": _bench_recovery,
             "entities": _bench_entities,
+            "serving": _bench_serving,
         }
         if mode not in modes:
             # An unknown mode must not silently fall through to the full
@@ -1460,6 +1613,7 @@ def main() -> None:
         for label, fn in (("game_descent", _bench_descent),
                           ("game_validation", _bench_validation),
                           ("game_recovery", _bench_recovery),
+                          ("game_serving", _bench_serving),
                           ("game_entities",
                            _functools.partial(_bench_entities, 100_000))):
             elapsed = time.perf_counter() - t_start

@@ -177,6 +177,28 @@ def _run_streaming(args, model, index_maps, logger, session) -> dict:
     return {"num_scored": n, "metrics": metrics, "streamed": True}
 
 
+def _score_batch_dataset(model, data, logger, session) -> np.ndarray:
+    """Non-streamed scoring through the serving gather-table build: the
+    same :class:`~photon_tpu.serving.GameScorer` (device-resident fixed
+    weights + per-entity gather tables, one compiled program for the
+    dataset's padded shape) that the online service runs, so the batch and
+    serving scoring paths cannot drift.  ``PHOTON_BATCH_SCORER=host``
+    falls back to the host ``GameModel.score`` accumulation (float64 on
+    host — the parity oracle the serving tests pin against)."""
+    if os.environ.get("PHOTON_BATCH_SCORER", "device") == "host":
+        logger.info("PHOTON_BATCH_SCORER=host: host scoring path")
+        return model.score(data)
+    from photon_tpu.serving import GameScorer, request_spec_for_dataset
+
+    scorer = GameScorer(
+        model,
+        mesh=common.maybe_mesh(),
+        request_spec=request_spec_for_dataset(model, data),
+        telemetry=session,
+    )
+    return scorer.score_dataset(data)
+
+
 def run(args: argparse.Namespace) -> dict:
     common.select_backend(args.backend)
     from photon_tpu.utils import PhotonLogger
@@ -222,7 +244,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         session.gauge("score.num_scored").set(data.num_examples)
 
     with logger.timed("score"):
-        raw_scores = model.score(data)
+        raw_scores = _score_batch_dataset(model, data, logger, session)
         if args.predict_mean:
             import jax.numpy as jnp
 

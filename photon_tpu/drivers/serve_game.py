@@ -1,0 +1,215 @@
+"""Online GAME scoring service driver (in-process request loop).
+
+Loads a saved GAME model ONCE into device-resident serving tables
+(photon_tpu.serving.GameScorer), pre-compiles the bucket ladder, then
+drives a closed-loop request stream through the async batcher — the
+serving-shape workload (``--clients`` concurrent users, request sizes drawn
+from a seeded long-tailed distribution) run in-process so the service layer
+is exercised and measured without a network stack.  Scores land in
+``<output-dir>/scores.txt`` in request order; the telemetry run report
+carries the full ``serving.*`` block (request/batch counters, bucket
+occupancy, padded fraction, latency distributions, cold entities,
+host-syncs-per-batch).
+
+    python -m photon_tpu.drivers.serve_game \\
+        --model out/best_model --input test.avro \\
+        --feature-bags global=features,per_user=userFeatures \\
+        --id-columns userId \\
+        --requests 500 --clients 8 --max-batch 128 --max-delay-ms 2 \\
+        --output-dir served
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from photon_tpu.drivers import common
+from photon_tpu.drivers.train_game import _load_game_data
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon_tpu.drivers.serve_game", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    common.add_common_args(p)
+    p.add_argument("--model", required=True, help="GAME model directory")
+    p.add_argument("--input", required=True,
+                   help="request feature source: Avro file/dir/glob or "
+                   "synthetic-game spec (see train_game); requests are row "
+                   "windows cut from it")
+    p.add_argument("--feature-bags", default=None)
+    p.add_argument("--id-columns", default=None)
+    p.add_argument("--requests", type=int, default=256,
+                   help="number of requests to serve")
+    p.add_argument("--request-rows-mean", type=float, default=8.0,
+                   help="mean rows per request (geometric long-tail, "
+                   "clipped to [1, --max-batch])")
+    p.add_argument("--clients", type=int, default=4,
+                   help="closed-loop client threads")
+    p.add_argument("--max-batch", type=int, default=128,
+                   help="bucket-ladder cap / batcher coalescing cap (rows)")
+    p.add_argument("--max-delay-ms", type=float, default=2.0,
+                   help="batcher window: max time the first queued request "
+                   "waits for coalescing partners")
+    p.add_argument("--seed", type=int, default=0,
+                   help="request-size stream seed")
+    return p
+
+
+def request_sizes(n_requests: int, mean: float, cap: int,
+                  seed: int) -> np.ndarray:
+    """Seeded long-tailed request-size stream (geometric, clipped to
+    [1, cap]) — shared by this driver and ``bench.py --mode serving`` so
+    the measured arrival pattern is the served one."""
+    rng = np.random.default_rng(seed)
+    p = min(1.0, max(1.0 / max(mean, 1.0), 1e-6))
+    return np.clip(rng.geometric(p, size=n_requests), 1, max(1, cap))
+
+
+def _publish_text(output_dir: str, name: str, write_fn, session,
+                  logger) -> None:
+    """Atomic, retried artifact publish (the score_game convention, PR 7):
+    each attempt writes a fresh temp file and renames it into place, so a
+    crash or a stall-escalated abandoned writer can never leave a torn
+    artifact — readers see the previous complete file or the new one."""
+    import tempfile
+
+    from photon_tpu.fault.injection import fault_point
+    from photon_tpu.fault.retry import retry_call
+
+    def attempt():
+        fault_point("io:write", path=name)
+        fd, tmp = tempfile.mkstemp(
+            prefix=f".{name}-", suffix=".tmp", dir=output_dir
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                write_fn(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(output_dir, name))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    retry_call(attempt, site="serve:write", telemetry=session, logger=logger)
+
+
+def run(args: argparse.Namespace) -> dict:
+    common.select_backend(args.backend)
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.serve_game", args.log_file)
+    with common.telemetry_run(args, "serve_game", logger) as session:
+        return _run(args, logger, session)
+
+
+def _run(args: argparse.Namespace, logger, session) -> dict:
+    from photon_tpu.fault.retry import retry_call
+    from photon_tpu.game.model_io import load_game_model
+    from photon_tpu.serving import (
+        GameScorer,
+        RequestBatcher,
+        build_requests,
+        request_spec_for_dataset,
+        run_closed_loop,
+    )
+
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    with logger.timed("load-model"):
+        model, index_maps = retry_call(
+            lambda: load_game_model(args.model),
+            site="model:load", telemetry=session, logger=logger,
+        )
+        logger.info("model: %s, coordinates %s", model.task_type,
+                    list(model.coordinates))
+
+    with logger.timed("load-data"):
+        data, _ = _load_game_data(
+            args.input, args, index_maps=index_maps, telemetry=session
+        )
+        logger.info("request source: %d rows", data.num_examples)
+
+    with logger.timed("build-scorer"):
+        scorer = GameScorer(
+            model,
+            mesh=common.maybe_mesh(),
+            request_spec=request_spec_for_dataset(model, data),
+            max_batch=args.max_batch,
+            telemetry=session,
+        ).warmup()
+        logger.info("scorer warm: buckets %s, %d programs compiled",
+                    scorer.buckets, scorer.compilations)
+
+    sizes = request_sizes(
+        args.requests, args.request_rows_mean, args.max_batch, args.seed
+    )
+    requests = build_requests(data, model, sizes)
+
+    with logger.timed("serve"):
+        with RequestBatcher(
+            scorer, max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1000.0, telemetry=session,
+        ) as batcher:
+            scores, latencies, wall = run_closed_loop(
+                batcher, requests, clients=args.clients
+            )
+
+    rows = int(sum(sizes))
+    qps = len(requests) / wall if wall > 0 else 0.0
+    lat_ms = np.sort(np.asarray(latencies, np.float64)) * 1e3
+    p50 = float(np.percentile(lat_ms, 50))
+    p99 = float(np.percentile(lat_ms, 99))
+    session.gauge("serving.qps").set(qps)
+    session.gauge("serving.rows_per_second").set(rows / wall if wall else 0.0)
+
+    _publish_text(
+        args.output_dir, "scores.txt",
+        lambda f: np.savetxt(f, np.concatenate(scores), fmt="%.8g"),
+        session, logger,
+    )
+
+    cold = sum(
+        m["value"]
+        for m in session.registry.snapshot().get("counters", [])
+        if m["name"] == "serving.cold_entities"
+    ) if session.enabled else 0
+    summary = {
+        "requests": len(requests),
+        "rows": rows,
+        "wall_s": round(wall, 4),
+        "qps": round(qps, 2),
+        "rows_per_sec": round(rows / wall, 1) if wall else 0.0,
+        "latency_p50_ms": round(p50, 3),
+        "latency_p99_ms": round(p99, 3),
+        "cold_entities": int(cold),
+        "compiled_programs": scorer.compilations,
+    }
+    _publish_text(
+        args.output_dir, "serving_summary.json",
+        lambda f: json.dump(summary, f, indent=1),
+        session, logger,
+    )
+    logger.info(
+        "served %d requests (%d rows) at %.1f req/s; latency p50 %.2f ms "
+        "p99 %.2f ms; %d cold entities",
+        summary["requests"], rows, qps, p50, p99, summary["cold_entities"],
+    )
+    return summary
+
+
+def main(argv=None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -546,7 +546,8 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
                 ckpt_base, "slot-1" if live == "slot-0" else "slot-0"
             )
             shutil.rmtree(slot, ignore_errors=True)
-            save_game_model(slot, model, index_maps, fmt=args.model_format)
+            save_game_model(slot, model, index_maps, fmt=args.model_format,
+                            telemetry=session)
             tmp_link = os.path.join(ckpt_base, ".latest.tmp")
             if os.path.lexists(tmp_link):
                 os.remove(tmp_link)
@@ -606,6 +607,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
             save_game_model(
                 os.path.join(args.output_dir, f"model_{config.name}"),
                 result.model, index_maps, fmt=args.model_format,
+                telemetry=session,
             )
         return result
 
@@ -676,7 +678,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
     with logger.timed("save-model"):
         save_game_model(
             os.path.join(args.output_dir, "best_model"),
-            best.model, index_maps, fmt=args.model_format,
+            best.model, index_maps, fmt=args.model_format, telemetry=session,
         )
     summary = {
         "task": args.task,

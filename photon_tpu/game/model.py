@@ -70,6 +70,21 @@ def _fixed_margins(w: Array, feats, dense: bool) -> Array:
 
 
 @partial(jax.jit, static_argnames=("dense",))
+def serving_gather_margins(table: Array, safe_idx: Array, feats, dense: bool) -> Array:
+    """Margins via the serving gather convention: ``safe_idx`` is already
+    in-table (unknown entities pre-mapped to the trailing all-zero row by the
+    caller — :meth:`RandomEffectModel.serving_table`), so the gather itself
+    produces the fixed-effect-only fallback with no output mask.  The online
+    scoring hot path (photon_tpu.serving) runs this inside its per-bucket
+    compiled programs; it is defined HERE so the serving path and the batch
+    ``margins_device`` path share one model layer."""
+    if dense:
+        return jnp.einsum("nd,nd->n", feats, table[safe_idx])
+    ids, vals = feats
+    return jnp.sum(table[safe_idx[:, None], ids] * vals, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("dense",))
 def _random_margins(table: Array, entity_idx: Array, feats, dense: bool) -> Array:
     """Margins via gather of per-row entity coefficients; unseen entities -> 0."""
     safe = jnp.maximum(entity_idx, 0)
@@ -125,6 +140,17 @@ class FixedEffectModel:
         """Device-resident margins against pre-uploaded shard features —
         the residual engine's scoring path (no host round-trip)."""
         return _fixed_margins(jnp.asarray(self.coefficients.means), feats, dense)
+
+    def serving_weights(self, mesh=None) -> Array:
+        """Device-resident coefficient vector for the online scoring
+        service: placed once (replicated — every shard reads the whole
+        vector) and then closed over by every pre-compiled bucket program,
+        so serving requests never re-upload model state."""
+        from photon_tpu.parallel.mesh import put_replicated
+
+        return put_replicated(
+            jnp.asarray(self.coefficients.means, jnp.float32), mesh
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -207,6 +233,28 @@ class RandomEffectModel:
         pre-computed per-row entity index — the residual engine's scoring
         path (the gather-join with no host round-trip)."""
         return _random_margins(jnp.asarray(self.table), entity_idx, feats, dense)
+
+    def serving_table(self, mesh=None) -> Array:
+        """Flatten this coordinate's per-entity rows into ONE device-resident
+        gather table for the online scoring service: ``[num_entities + 1,
+        dim]``, row ``num_entities`` all-zero, sharded over the mesh rows.
+
+        Unknown entities (entity index -1) are pre-mapped by the scorer to
+        the trailing zero row, so the serving gather yields exactly zero
+        margin — the fixed-effect-only fallback — without a per-row output
+        mask (photon_tpu.serving counts them as ``serving.cold_entities``).
+        Rows the mesh padding adds past ``num_entities + 1`` are also zero
+        (reshard_to_mesh pads with the zero fill), so any index into the
+        padded tail stays harmless by construction."""
+        from photon_tpu.parallel.mesh import reshard_to_mesh
+
+        table = jnp.concatenate(
+            [
+                jnp.asarray(self.table, jnp.float32),
+                jnp.zeros((1, self.dim), jnp.float32),
+            ]
+        )
+        return reshard_to_mesh(table, mesh)
 
 
 CoordinateModel = "FixedEffectModel | RandomEffectModel"

@@ -60,6 +60,7 @@ def save_game_model(
     model: GameModel,
     index_maps: Dict[str, IndexMap],
     fmt: str = "avro",
+    telemetry=None,
 ) -> None:
     """``index_maps`` is keyed by feature-shard name (each coordinate stores
     the map for its shard).
@@ -71,7 +72,42 @@ def save_game_model(
     from photon_tpu.fault.atomic import atomic_dir
 
     with atomic_dir(dir_path) as tmp:
-        _write_game_model(tmp, model, index_maps, fmt)
+        _write_game_model(tmp, model, index_maps, fmt, telemetry=telemetry)
+
+
+def _fetch_model_tables(model: GameModel, telemetry=None) -> Dict[str, dict]:
+    """ALL per-coordinate device tables in ONE ``jax.device_get``.
+
+    The export used to fetch each coordinate's table/variances/means with
+    its own d2h round-trip; batching them into one gather (the same shape
+    as the descent loop's once-per-iteration drain, PR 5) dispatches every
+    copy together and is counted under
+    ``descent.host_transfer_bytes{path=export}``."""
+    import jax
+
+    pending: Dict[str, dict] = {}
+    for name, coord in model.coordinates.items():
+        if isinstance(coord, FixedEffectModel):
+            c = coord.coefficients
+            pending[name] = {"means": c.means}
+            if c.variances is not None:
+                pending[name]["variances"] = c.variances
+        elif isinstance(coord, RandomEffectModel):
+            pending[name] = {"means": coord.table}
+            if coord.variances is not None:
+                pending[name]["variances"] = coord.variances
+    fetched = jax.device_get(pending)
+    host = {
+        name: {k: np.asarray(v) for k, v in arrays.items()}
+        for name, arrays in fetched.items()
+    }
+    if telemetry is not None:
+        telemetry.counter(
+            "descent.host_transfer_bytes", direction="d2h", path="export"
+        ).inc(sum(
+            a.nbytes for arrays in host.values() for a in arrays.values()
+        ))
+    return host
 
 
 def _write_game_model(
@@ -79,18 +115,25 @@ def _write_game_model(
     model: GameModel,
     index_maps: Dict[str, IndexMap],
     fmt: str = "avro",
+    telemetry=None,
 ) -> None:
     os.makedirs(dir_path, exist_ok=True)
     meta = {"version": 1, "task_type": model.task_type, "coordinates": []}
     ext = "avro" if fmt == "avro" else "json"
+    tables = _fetch_model_tables(model, telemetry=telemetry)
     for name, coord in model.coordinates.items():
+        host = tables[name]
         if isinstance(coord, FixedEffectModel):
             coord_dir = os.path.join(dir_path, "fixed-effect", name)
             os.makedirs(coord_dir, exist_ok=True)
             imap = index_maps[coord.shard_name]
+            from photon_tpu.models.glm import Coefficients
+
             save_glm_model(
                 os.path.join(coord_dir, f"coefficients.{ext}"),
-                coord.model,
+                coord.model.with_coefficients(Coefficients(
+                    host["means"], host.get("variances")
+                )),
                 imap,
                 fmt=fmt,
             )
@@ -102,7 +145,10 @@ def _write_game_model(
             coord_dir = os.path.join(dir_path, "random-effect", name)
             os.makedirs(coord_dir, exist_ok=True)
             imap = index_maps[coord.shard_name]
-            _save_random_effect(coord_dir, coord, imap, ext)
+            _save_random_effect(
+                coord_dir, coord, imap, ext,
+                table=host["means"], variances=host.get("variances"),
+            )
             imap.save(os.path.join(coord_dir, "feature_index.json"))
             meta["coordinates"].append(
                 {
@@ -125,10 +171,18 @@ def _write_game_model(
 
 
 def _save_random_effect(
-    coord_dir: str, coord: RandomEffectModel, imap: IndexMap, ext: str
+    coord_dir: str, coord: RandomEffectModel, imap: IndexMap, ext: str,
+    table: Optional[np.ndarray] = None,
+    variances: Optional[np.ndarray] = None,
 ) -> None:
-    table = np.asarray(coord.table)
-    variances = None if coord.variances is None else np.asarray(coord.variances)
+    """``table``/``variances`` arrive pre-fetched from the batched export
+    d2h (:func:`_fetch_model_tables`); the fallback fetch keeps direct
+    callers working."""
+    if table is None:
+        table = np.asarray(coord.table)
+        variances = (
+            None if coord.variances is None else np.asarray(coord.variances)
+        )
     records = []
     for i, key in enumerate(coord.keys):
         records.append(

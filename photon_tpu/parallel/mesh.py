@@ -237,6 +237,41 @@ def reshard_to_mesh(x, mesh: Optional[Mesh], axis: int = 0,
     return jax.device_put(host, sharding)
 
 
+def put_request(x, mesh: Optional[Mesh]):
+    """Place one serving request-batch buffer (a pytree of small host
+    arrays) for the online scoring hot path.
+
+    Request micro-batches are tiny next to the model gather tables, so they
+    are REPLICATED over the mesh: every shard reads the whole batch and the
+    per-row gather against the row-sharded tables resolves with one
+    collective on the table side instead of re-sharding a few-hundred-row
+    buffer every request.  Today that makes this exactly
+    :func:`put_replicated`; the alias exists so the serving request layout
+    is decided in ONE place — the pre-compiled bucket programs
+    (photon_tpu.serving.scorer) are lowered against buffers placed here,
+    and every later request must hit the exact compiled layout or it would
+    force a recompile.
+    """
+    return put_replicated(x, mesh)
+
+
+def abstract_like(x):
+    """``jax.ShapeDtypeStruct`` pytree mirroring ``x``'s shapes, dtypes,
+    and shardings — AOT-lowering inputs (``jax.jit(f).lower(...)``) without
+    keeping sample buffers alive.  The serving scorer lowers each bucket
+    program against abstract request buffers shaped by this, then compiles
+    once; committed-array leaves carry their sharding into the lowering so
+    the compiled program pins the exact runtime placement."""
+    return jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(
+            leaf.shape,
+            leaf.dtype,
+            sharding=leaf.sharding if isinstance(leaf, jax.Array) else None,
+        ),
+        x,
+    )
+
+
 def to_host(x) -> np.ndarray:
     """``np.asarray`` that also works for multi-process sharded arrays.
 

@@ -1,0 +1,46 @@
+"""Online GAME scoring service: device-resident tables, bucketed batching.
+
+Everything before this package is batch — ``score_game`` loads a model per
+invocation and scores one dataset.  This package is the serving layer the
+ROADMAP's north star ("heavy traffic from millions of users") calls for,
+shaped after Snap ML's hierarchical host/accelerator pipelining of GLM
+serving (PAPERS.md, 1803.06333) and DrJAX's keep-everything-in-jit idiom
+(PAPERS.md, 2403.07128):
+
+- :class:`~photon_tpu.serving.scorer.GameScorer` — loads a saved GAME model
+  ONCE into device-resident tables (fixed-effect weight vectors plus one
+  sharded ``[entities + 1, dim]`` gather table per random coordinate, the
+  trailing row all-zero for unknown entities) and keeps ONE pre-compiled
+  scoring program alive per (bucket shape × coordinate set), serving request
+  micro-batches with donated I/O buffers.  After :meth:`warmup`, arrival
+  patterns can NEVER recompile: batches are padded to a small power-of-two
+  bucket ladder and each bucket's program is AOT-compiled
+  (``jit(...).lower(...).compile()`` — a shape outside the compiled set is
+  an error, not a silent recompile).
+- :class:`~photon_tpu.serving.batcher.RequestBatcher` — an async batcher
+  thread (the ``io_pool`` / ``AsyncPublisher`` depth-1 lineage from PR 5)
+  coalescing concurrent requests under a max-delay/max-batch policy.
+
+The batch scoring driver (``drivers/score_game``, non-streamed) routes
+through the same :class:`GameScorer` gather-table build, so the online and
+batch paths cannot drift; ``python -m photon_tpu.drivers.serve_game`` is the
+in-process request loop, and ``bench.py --mode serving`` measures p50/p99
+latency + QPS against the per-request host-scoring baseline.
+"""
+
+from photon_tpu.serving.batcher import (  # noqa: F401
+    RequestBatcher,
+    run_closed_loop,
+)
+from photon_tpu.serving.scorer import (  # noqa: F401
+    GameScorer,
+    ScoringRequest,
+    ShardSpec,
+    build_requests,
+    concat_requests,
+    request_from_dataset,
+    request_spec_for_dataset,
+    request_spec_for_model,
+    request_windows,
+    slice_request,
+)

@@ -1,0 +1,595 @@
+"""Device-resident GAME scorer with recompile-free bucketed micro-batching.
+
+The hot path's residency contract (enforced by ``tools/check_host_sync.py``):
+model tables live on device for the scorer's whole lifetime; each scored
+batch pays exactly ONE host round-trip — the request buffers go up in one
+``put_request`` placement and the ``(scores, cold_counts)`` pair comes back
+in one ``jax.device_get`` (``serving.host_syncs`` == 1 per batch, pinned by
+tests).  Everything between those two edges is a single pre-compiled XLA
+program per bucket shape, with the request buffers DONATED on accelerators
+so XLA recycles them for outputs (not on CPU, where placed buffers can
+alias host memory — see ``_donate_argnums``).
+
+Bucketing: batch sizes are padded to a small power-of-two ladder
+(``buckets``, default 8 … ``max_batch``), so arrival patterns map onto
+O(log max_batch) compiled programs.  :meth:`GameScorer.warmup` AOT-compiles
+the whole ladder up front (``jax.jit(...).lower(...).compile()``); after
+warmup a request can never trigger a compile — an off-ladder shape raises
+instead of silently recompiling.  Padded rows carry entity index -1 and are
+masked out of the cold-entity counts by the device-side ``n_valid`` bound;
+their scores are sliced off before anything leaves the scorer.
+
+Unknown entities: each random coordinate's table is the model's
+:meth:`~photon_tpu.game.model.RandomEffectModel.serving_table` —
+``[entities + 1, dim]`` with the trailing row all-zero — and request rows
+whose entity key is outside the vocabulary gather that zero row, falling
+back to a fixed-effect-only score.  They are counted on device and surface
+as ``serving.cold_entities{coordinate=...}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.game.data import (
+    DenseShard,
+    GameDataset,
+    entity_index_for,
+)
+from photon_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+    _fixed_margins,
+    serving_gather_margins,
+)
+from photon_tpu.parallel.mesh import abstract_like, put_request
+from photon_tpu.utils import pow2_at_least
+
+DEFAULT_MAX_BATCH = 256
+DEFAULT_MIN_BUCKET = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """Fixed request layout of one feature shard: serving programs compile
+    against ONE shape per shard, so the spec — dense width, or the sparse
+    padded-COO nonzero width — is part of the scorer's identity."""
+
+    kind: str  # "dense" | "sparse"
+    dim: int
+    nnz: int = 0  # padded-COO width (sparse only)
+
+    @property
+    def dense(self) -> bool:
+        return self.kind == "dense"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoringRequest:
+    """One scoring request: per-shard feature rows, raw per-row entity keys
+    for each id column a random coordinate joins on, and an optional
+    per-row offset — a :class:`~photon_tpu.game.data.GameDataset` minus
+    labels/weights.  All arrays are host-side; the scorer owns placement."""
+
+    features: Dict[str, object]  # shard -> [n, d] dense | (ids, vals) sparse
+    entity_ids: Dict[str, np.ndarray]  # id column -> [n] raw keys
+    offset: Optional[np.ndarray] = None  # [n] float32
+
+    @property
+    def num_rows(self) -> int:
+        for leaf in self.features.values():
+            arr = leaf[0] if isinstance(leaf, tuple) else leaf
+            return int(arr.shape[0])
+        for col in self.entity_ids.values():
+            return int(len(col))
+        return 0
+
+
+def request_spec_for_model(model: GameModel) -> Dict[str, ShardSpec]:
+    """Dense request layout straight from the model's own dimensions — the
+    default for request sources that send dense feature vectors."""
+    spec: Dict[str, ShardSpec] = {}
+    for coord in model.coordinates.values():
+        if isinstance(coord, FixedEffectModel):
+            spec[coord.shard_name] = ShardSpec(
+                "dense", int(len(coord.coefficients.means))
+            )
+        else:
+            spec[coord.shard_name] = ShardSpec("dense", int(coord.dim))
+    return spec
+
+
+def request_spec_for_dataset(
+    model: GameModel, data: GameDataset
+) -> Dict[str, ShardSpec]:
+    """Request layout matching a concrete dataset's shard storage (the
+    batch ``score_game`` route: Avro input arrives as padded-COO sparse
+    shards, whose nonzero width fixes the compiled program's shape)."""
+    spec: Dict[str, ShardSpec] = {}
+    for coord in model.coordinates.values():
+        shard = data.shard(coord.shard_name)
+        if isinstance(shard, DenseShard):
+            spec[coord.shard_name] = ShardSpec("dense", int(shard.dim))
+        else:
+            spec[coord.shard_name] = ShardSpec(
+                "sparse", int(shard.dim), nnz=int(shard.ids.shape[1])
+            )
+    return spec
+
+
+def request_from_dataset(data: GameDataset, model: GameModel) -> ScoringRequest:
+    """The whole dataset as one request (batch scoring through the serving
+    tables); only the shards/id-columns the model actually joins ride."""
+    features: Dict[str, object] = {}
+    entity_ids: Dict[str, np.ndarray] = {}
+    for coord in model.coordinates.values():
+        shard = data.shard(coord.shard_name)
+        features[coord.shard_name] = (
+            shard.x if isinstance(shard, DenseShard) else (shard.ids, shard.vals)
+        )
+        if isinstance(coord, RandomEffectModel):
+            entity_ids[coord.entity_column] = data.id_columns[coord.entity_column]
+    return ScoringRequest(
+        features=features, entity_ids=entity_ids, offset=data.offset
+    )
+
+
+def slice_request(req: ScoringRequest, lo: int, hi: int) -> ScoringRequest:
+    """Row window ``[lo, hi)`` of a request (oversize-batch chunking)."""
+    def cut(leaf):
+        if isinstance(leaf, tuple):
+            return tuple(a[lo:hi] for a in leaf)
+        return leaf[lo:hi]
+
+    return ScoringRequest(
+        features={k: cut(v) for k, v in req.features.items()},
+        entity_ids={k: v[lo:hi] for k, v in req.entity_ids.items()},
+        offset=None if req.offset is None else req.offset[lo:hi],
+    )
+
+
+def concat_requests(requests: List[ScoringRequest]) -> ScoringRequest:
+    """Coalesce requests into one micro-batch (the batcher's merge step).
+    Every request must carry the same shards/id-columns; offsets default to
+    zero rows so requests with and without offsets can share a batch."""
+    if len(requests) == 1:
+        return requests[0]
+    first = requests[0]
+
+    def cat(key):
+        leaves = [r.features[key] for r in requests]
+        if isinstance(leaves[0], tuple):
+            return tuple(
+                np.concatenate([leaf[i] for leaf in leaves])
+                for i in range(len(leaves[0]))
+            )
+        return np.concatenate(leaves)
+
+    offsets = []
+    for r in requests:
+        offsets.append(
+            np.zeros(r.num_rows, np.float32) if r.offset is None
+            # host-sync: request ingest — caller-owned host offsets.
+            else np.asarray(r.offset, np.float32)
+        )
+    return ScoringRequest(
+        features={k: cat(k) for k in first.features},
+        entity_ids={
+            k: np.concatenate([r.entity_ids[k] for r in requests])
+            for k in first.entity_ids
+        },
+        offset=np.concatenate(offsets),
+    )
+
+
+def request_windows(n_rows: int, sizes, start: int = 0) -> List[np.ndarray]:
+    """Consecutive row windows of the given sizes, wrapping modulo the
+    dataset.  The ONE definition of the request-stream cut: the serving
+    bench's host baseline scores the same windows the served requests were
+    built from, so the parity comparison can never drift onto misaligned
+    rows."""
+    out: List[np.ndarray] = []
+    pos = start
+    for size in sizes:
+        out.append(np.arange(pos, pos + int(size)) % n_rows)
+        pos = (pos + int(size)) % n_rows
+    return out
+
+
+def build_requests(
+    data: GameDataset, model: GameModel, sizes, start: int = 0
+) -> List[ScoringRequest]:
+    """Cut a dataset into a request stream over :func:`request_windows`.
+    Shared by the serve_game driver, the serving bench, and the tests —
+    one request shape everywhere."""
+    whole = request_from_dataset(data, model)
+    out: List[ScoringRequest] = []
+    for rows in request_windows(data.num_examples, sizes, start=start):
+
+        def take(leaf):
+            if isinstance(leaf, tuple):
+                return tuple(a[rows] for a in leaf)
+            return leaf[rows]
+
+        out.append(
+            ScoringRequest(
+                features={k: take(v) for k, v in whole.features.items()},
+                entity_ids={k: v[rows] for k, v in whole.entity_ids.items()},
+                offset=None if whole.offset is None else whole.offset[rows],
+            )
+        )
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class _CoordPlan:
+    """Static per-coordinate scoring plan baked into every bucket program."""
+
+    name: str
+    kind: str  # "fixed" | "random"
+    shard: str
+    column: Optional[str] = None  # random: id column joined on
+    zero_row: int = 0  # random: index of the all-zero fallback row
+
+
+class GameScorer:
+    """Device-resident GAME model + per-bucket pre-compiled scoring programs.
+
+    Built once per served model; :meth:`score_batch` is the request hot
+    path (one compiled dispatch + one host sync per micro-batch) and
+    :meth:`score_dataset` the batch route sharing the same tables and
+    kernels.  ``buckets`` is the padded-batch ladder; ``max_batch`` caps it
+    (a bigger request is chunked).  ``strict_after_warmup`` (default True)
+    makes any shape outside the compiled set an error instead of a compile.
+    """
+
+    def __init__(
+        self,
+        model: GameModel,
+        mesh=None,
+        request_spec: Optional[Dict[str, ShardSpec]] = None,
+        buckets: Optional[Tuple[int, ...]] = None,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        min_bucket: int = DEFAULT_MIN_BUCKET,
+        telemetry=None,
+        strict_after_warmup: bool = True,
+    ):
+        from photon_tpu.telemetry import NULL_SESSION
+
+        self.model = model
+        self.mesh = mesh
+        self.telemetry = telemetry or NULL_SESSION
+        self.request_spec = request_spec or request_spec_for_model(model)
+        if buckets is None:
+            b, ladder = max(1, pow2_at_least(min_bucket)), []
+            max_bucket = pow2_at_least(max_batch)
+            while b < max_bucket:
+                ladder.append(b)
+                b *= 2
+            ladder.append(max_bucket)
+            buckets = tuple(ladder)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.max_bucket = self.buckets[-1]
+        self.compilations = 0
+        self._warm = False
+        self.strict_after_warmup = strict_after_warmup
+        self._programs: Dict[int, object] = {}
+
+        # -- device-resident model tables (loaded ONCE, owned for life) ------
+        plan: List[_CoordPlan] = []
+        tables: List[jax.Array] = []
+        self._vocab: Dict[str, np.ndarray] = {}
+        model_bytes = 0
+        for name, coord in model.coordinates.items():
+            if isinstance(coord, FixedEffectModel):
+                w = coord.serving_weights(mesh)
+                plan.append(_CoordPlan(name, "fixed", coord.shard_name))
+                tables.append(w)
+                model_bytes += w.nbytes
+            elif isinstance(coord, RandomEffectModel):
+                table = coord.serving_table(mesh)
+                plan.append(
+                    _CoordPlan(
+                        name, "random", coord.shard_name,
+                        column=coord.entity_column,
+                        zero_row=coord.num_entities,
+                    )
+                )
+                tables.append(table)
+                # host-sync: build-time only — entity vocabularies are host
+                # numpy by construction (the key join runs at ingest).
+                self._vocab[name] = np.asarray(coord.keys)
+                model_bytes += table.nbytes
+                self.telemetry.gauge(
+                    "serving.entities", coordinate=name
+                ).set(coord.num_entities)
+            else:
+                raise TypeError(
+                    f"cannot serve a {type(coord).__name__} coordinate"
+                )
+            if coord.shard_name not in self.request_spec:
+                raise ValueError(
+                    f"request spec is missing shard {coord.shard_name!r}"
+                )
+        self._plan = tuple(plan)
+        self._tables = tuple(tables)
+        self.telemetry.gauge("serving.model_bytes").set(model_bytes)
+
+    # -- bucket policy -------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket holding ``n`` rows (n <= max_bucket)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch of {n} rows exceeds max bucket "
+                         f"{self.max_bucket}; chunk it (score_batch does)")
+
+    def warmup(self) -> "GameScorer":
+        """AOT-compile every ladder bucket's program.  After this, serving
+        arrival patterns can never compile: each micro-batch maps onto one
+        of these executables, and (under ``strict_after_warmup``) an
+        off-ladder shape raises instead of silently compiling."""
+        with self.telemetry.span("serving.warmup", buckets=len(self.buckets)):
+            for b in self.buckets:
+                self._program(b)
+        self._warm = True
+        return self
+
+    def _donate_argnums(self) -> tuple:
+        """Donate request buffers (args 1–3: feats/idx/offset) on
+        accelerators only.  See the comment at the jit site: on CPU the
+        placed buffers can alias the staged host memory and each other
+        across replicas, and donating an aliased buffer corrupts scores."""
+        devices = self._tables[0].devices() if self._tables else set()
+        if any(d.platform == "cpu" for d in devices):
+            return ()
+        return (1, 2, 3)
+
+    # -- program build -------------------------------------------------------
+    def _program(self, bucket: int, layout: str = "request"):
+        program = self._programs.get((bucket, layout))
+        if program is not None:
+            return program
+        if self._warm and self.strict_after_warmup and layout == "request":
+            raise RuntimeError(
+                f"no pre-compiled program for bucket {bucket} after warmup "
+                f"(compiled: {sorted(b for b, l in self._programs if l == 'request')}); "
+                "widen `buckets` or chunk the batch — serving must never "
+                "recompile"
+            )
+        plan, spec = self._plan, self.request_spec
+
+        def score(tables, feats, idx, offset, n_valid):
+            valid = jnp.arange(bucket, dtype=jnp.int32) < n_valid
+            total = offset
+            colds = []
+            for c, table in zip(plan, tables):
+                dense = spec[c.shard].dense
+                if c.kind == "fixed":
+                    total = total + _fixed_margins(table, feats[c.shard], dense)
+                else:
+                    raw = idx[c.name]
+                    safe = jnp.where(raw >= 0, raw, c.zero_row)
+                    total = total + serving_gather_margins(
+                        table, safe, feats[c.shard], dense
+                    )
+                    colds.append(
+                        jnp.sum((raw < 0) & valid, dtype=jnp.int32)
+                    )
+            cold = (
+                jnp.stack(colds) if colds else jnp.zeros((0,), jnp.int32)
+            )
+            return jnp.where(valid, total, 0.0), cold
+
+        # Request buffers (feats/idx/offset) are DONATED on accelerators:
+        # XLA recycles the uploaded buffers for outputs, so steady-state
+        # serving allocates nothing per batch beyond the h2d staging
+        # itself.  NOT on CPU — there "device" buffers can zero-copy alias
+        # the staged host numpy AND each other across a replicated mesh
+        # placement, and a donated alias lets one replica's output write
+        # clobber a buffer another replica still reads (observed as
+        # intermittent whole-batch garbage; the only CPU-donatable buffer
+        # was the offset, whose shape/dtype matches the scores output).
+        # On TPU/GPU every h2d is a real copy into device memory, so
+        # donation is both safe and the allocation win it exists for.
+        jitted = jax.jit(score, donate_argnums=self._donate_argnums())
+        sample = self._place(*self._zero_request(bucket), layout=layout)
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            program = jitted.lower(
+                self._tables, *abstract_like(sample)
+            ).compile()
+        self._programs[(bucket, layout)] = program
+        self.compilations += 1
+        self.telemetry.counter("serving.compilations").inc()
+        return program
+
+    def _zero_request(self, bucket: int):
+        """Host-side zero request buffers at a bucket's exact layout."""
+        feats: Dict[str, object] = {}
+        for c in self._plan:
+            s = self.request_spec[c.shard]
+            if s.dense:
+                feats[c.shard] = np.zeros((bucket, s.dim), np.float32)
+            else:
+                feats[c.shard] = (
+                    np.zeros((bucket, s.nnz), np.int32),
+                    np.zeros((bucket, s.nnz), np.float32),
+                )
+        idx = {
+            c.name: np.full(bucket, -1, np.int32)
+            for c in self._plan if c.kind == "random"
+        }
+        offset = np.zeros(bucket, np.float32)
+        return feats, idx, offset, np.int32(0)
+
+    def _place(self, feats, idx, offset, n_valid, layout: str = "request"):
+        """One h2d placement of a staged request, matching the layout the
+        bucket program was lowered against.  ``"request"`` replicates the
+        micro-batch (put_request — tiny next to the tables); ``"dataset"``
+        SHARDS the per-row buffers over the mesh rows: a whole-dataset
+        batch replicated would cost one full dataset copy PER DEVICE,
+        inverting the micro-batch rationale."""
+        if layout == "dataset" and self.mesh is not None:
+            from photon_tpu.parallel.mesh import put_replicated, put_sharded
+
+            return (
+                *put_sharded((feats, idx, offset), self.mesh),
+                put_replicated(jnp.int32(n_valid), self.mesh),
+            )
+        return put_request((feats, idx, offset, jnp.int32(n_valid)), self.mesh)
+
+    # -- request staging (host side, the sanctioned ingest edge) -------------
+    def _stage(self, request: ScoringRequest, bucket: int, n: int):
+        """Validate + pad one request to its bucket, join entity keys
+        against each coordinate's vocabulary, and coerce dtypes — the
+        request-ingest host work.  Padding rows carry zero features, entity
+        index -1 (masked from cold counts by ``n_valid``), zero offset."""
+        feats: Dict[str, object] = {}
+        for c in self._plan:
+            if c.shard in feats:
+                continue
+            s = self.request_spec[c.shard]
+            leaf = request.features.get(c.shard)
+            if leaf is None:
+                raise ValueError(f"request is missing shard {c.shard!r}")
+            if s.dense:
+                # host-sync: request ingest — coercing caller-owned feature
+                # rows to upload-ready numpy (no device data involved).
+                x = np.asarray(leaf, np.float32)
+                if x.shape != (n, s.dim):
+                    raise ValueError(
+                        f"shard {c.shard!r}: got {x.shape}, want {(n, s.dim)}"
+                    )
+                feats[c.shard] = _pad_rows(x, bucket)
+            else:
+                ids, vals = leaf
+                # host-sync: request ingest — same coercion, sparse leaves.
+                ids = np.asarray(ids, np.int32)
+                vals = np.asarray(vals, np.float32)
+                if ids.shape != (n, s.nnz) or vals.shape != (n, s.nnz):
+                    raise ValueError(
+                        f"shard {c.shard!r}: got {ids.shape}/{vals.shape}, "
+                        f"want {(n, s.nnz)}"
+                    )
+                feats[c.shard] = (
+                    _pad_rows(ids, bucket), _pad_rows(vals, bucket)
+                )
+        idx: Dict[str, np.ndarray] = {}
+        for c in self._plan:
+            if c.kind != "random":
+                continue
+            keys = request.entity_ids.get(c.column)
+            if keys is None:
+                raise ValueError(
+                    f"request is missing id column {c.column!r}"
+                )
+            # The key->row join (host searchsorted against the sorted
+            # vocabulary) is the serving-time shape of the reference's
+            # scoring shuffle-join; unknown keys become -1 -> zero row.
+            rows = entity_index_for(keys, self._vocab[c.name])
+            idx[c.name] = _pad_rows(rows, bucket, fill=-1)
+        offset = (
+            np.zeros(bucket, np.float32) if request.offset is None
+            else _pad_rows(
+                # host-sync: request ingest — offset coercion, host data.
+                np.asarray(request.offset, np.float32), bucket
+            )
+        )
+        return feats, idx, offset
+
+    # -- scoring -------------------------------------------------------------
+    def score_batch(self, request: ScoringRequest) -> np.ndarray:
+        """Score one request micro-batch; returns ``[n]`` float32 raw
+        scores (offset + every coordinate's margin; unknown entities get
+        the fixed-effect-only fallback).  ONE compiled dispatch + ONE host
+        sync; requests wider than the bucket ladder are chunked."""
+        n = request.num_rows
+        if n == 0:
+            return np.zeros(0, np.float32)
+        if n > self.max_bucket:
+            return np.concatenate([
+                self.score_batch(slice_request(request, lo,
+                                               min(lo + self.max_bucket, n)))
+                for lo in range(0, n, self.max_bucket)
+            ])
+        return self._score_padded(request, self.bucket_for(n), n)
+
+    def score_dataset(self, data: GameDataset) -> np.ndarray:
+        """Batch scoring through the SAME device tables and kernels: the
+        dataset is one request padded to the next power of two (its own
+        bucket, compiled once per dataset shape — the ``score_game``
+        non-streamed route), so the batch and online paths cannot drift.
+        Unlike request micro-batches, the per-row buffers are SHARDED over
+        the mesh (one dataset copy across devices, not one per device)."""
+        from photon_tpu.parallel.mesh import mesh_shards, pad_to_multiple
+
+        req = request_from_dataset(data, self.model)
+        n = req.num_rows
+        if n == 0:
+            return np.zeros(0, np.float32)
+        # pow2 for shape bucketing, then up to a mesh multiple so the row
+        # sharding divides (a no-op on power-of-two meshes).
+        bucket = pad_to_multiple(pow2_at_least(n), mesh_shards(self.mesh))
+        return self._score_padded(req, bucket, n, layout="dataset")
+
+    def _score_padded(self, request: ScoringRequest, bucket: int,
+                      n: int, layout: str = "request") -> np.ndarray:
+        t0 = time.monotonic()
+        program = self._program(bucket, layout=layout)
+        feats, idx, offset = self._stage(request, bucket, n)
+        placed = self._place(feats, idx, offset, n, layout=layout)
+        out, cold_dev = program(self._tables, *placed)
+        # The response must OWN its memory (the copy below): on CPU the
+        # fetch can alias the device output buffer, and with donated inputs
+        # that buffer is recycled by the very next batch — a zero-copy view
+        # would read the next request's scores (the egress twin of
+        # _pad_rows' ingest copy).
+        # host-sync: response egress — THE one per-batch fetch; scores and
+        # the per-coordinate cold-entity counts ride one device_get.
+        fetched_scores, cold = jax.device_get((out, cold_dev))
+        scores = np.array(fetched_scores, copy=True)
+        t = self.telemetry
+        t.counter("serving.host_syncs").inc()
+        t.counter("serving.batches", bucket=bucket).inc()
+        t.counter("serving.rows").inc(n)
+        t.histogram("serving.batch_rows").observe(n)
+        t.histogram("serving.bucket_occupancy", bucket=bucket).observe(
+            n / bucket
+        )
+        t.histogram("serving.padded_fraction").observe((bucket - n) / bucket)
+        t.histogram("serving.score_seconds").observe(time.monotonic() - t0)
+        cold_plan = [c for c in self._plan if c.kind == "random"]
+        for c, count in zip(cold_plan, cold):
+            if count:
+                t.counter("serving.cold_entities", coordinate=c.name).inc(
+                    int(count)
+                )
+        return scores[:n]
+
+
+def _pad_rows(a: np.ndarray, target: int, fill=0) -> np.ndarray:
+    """Pad rows to the bucket — ALWAYS returning memory this module owns.
+
+    The staged buffers are DONATED to the bucket programs, and on CPU
+    ``device_put`` can alias suitably-aligned host numpy zero-copy: donating
+    an aliased view of the caller's dataset would let XLA write outputs
+    into the caller's own arrays (the exact corruption class PR 3's
+    XLA-born-donation rule exists for).  ``np.pad`` copies when padding is
+    needed; the exact-size case must copy explicitly."""
+    short = target - a.shape[0]
+    if short <= 0:
+        return np.array(a, copy=True)
+    widths = [(0, short)] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, widths, constant_values=fill)

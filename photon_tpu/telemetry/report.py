@@ -188,6 +188,68 @@ def _render_entity_solves_section(report: dict) -> list:
     return lines
 
 
+def _render_serving_section(report: dict) -> list:
+    """The online scoring service at a glance (``serving.*``): request/batch
+    counters and the coalescing ratio they imply, padded fraction, cold
+    entities, host syncs per batch (the ≤ 1 residency contract, made
+    visible), and the latency/QPS numbers.  Empty when the run served
+    nothing."""
+    metrics = report.get("metrics") or {}
+    counters = metrics.get("counters") or []
+    gauges = metrics.get("gauges") or []
+
+    def total(name):
+        return sum(m["value"] for m in counters if m["name"] == name)
+
+    def gauge(name):
+        for m in gauges:
+            if m["name"] == name and not m.get("labels"):
+                return m["value"]
+        return None
+
+    batches = total("serving.batches")
+    requests = total("serving.requests")
+    if not batches and not requests:
+        return []
+    lines = ["", "## Online serving", ""]
+    rows = [("serving.requests", requests),
+            ("serving.batches", batches),
+            ("serving.rows", total("serving.rows"))]
+    if requests and batches:
+        rows.append(("requests per batch (coalescing)",
+                     round(requests / batches, 3)))
+    if batches:
+        rows.append(("serving.host_syncs per batch",
+                     round(total("serving.host_syncs") / batches, 3)))
+    cold = total("serving.cold_entities")
+    if cold:
+        rows.append(("serving.cold_entities", cold))
+    compilations = total("serving.compilations")
+    rows.append(("serving.compilations", compilations))
+    for name in ("serving.qps", "serving.rows_per_second",
+                 "serving.model_bytes"):
+        v = gauge(name)
+        if v is not None:
+            rows.append((name, v))
+    lines += ["| metric | value |", "|---|---|"]
+    lines += [f"| {name} | {_fmt(value)} |" for name, value in rows]
+    hists = [
+        h for h in metrics.get("histograms") or []
+        if h["name"] in ("serving.request_latency_s", "serving.score_seconds",
+                         "serving.batch_rows", "serving.padded_fraction",
+                         "serving.coalesced")
+    ]
+    if hists:
+        lines += ["", "| distribution | count | mean | p50 | p99 | max |",
+                  "|---|---|---|---|---|---|"]
+        for h in hists:
+            lines.append(
+                f"| {h['name']} | {h['count']} | {_fmt(h['mean'])} "
+                f"| {_fmt(h['p50'])} | {_fmt(h['p99'])} | {_fmt(h['max'])} |"
+            )
+    return lines
+
+
 def render_markdown(report: dict) -> str:
     """Human-readable view of a run report dict."""
     lines = [
@@ -225,6 +287,7 @@ def render_markdown(report: dict) -> str:
 
     lines += _render_pipeline_section(report)
     lines += _render_entity_solves_section(report)
+    lines += _render_serving_section(report)
 
     metrics = report.get("metrics") or {}
     counters = metrics.get("counters") or []

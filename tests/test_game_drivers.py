@@ -254,9 +254,11 @@ def test_train_game_driver_avro_end_to_end(tmp_path):
     assert summary2["best_metrics"]["AUC"] > 0.55
 
 
-def test_streamed_scoring_matches_whole(tmp_path):
+def test_streamed_scoring_matches_whole(tmp_path, monkeypatch):
     """score_game --stream over part files must reproduce the whole-set
-    scores and metrics exactly (chunk boundaries cannot change results)."""
+    HOST-path scores and metrics exactly (chunk boundaries cannot change
+    results); the default whole-set route — the serving gather tables
+    (ISSUE 9) — must agree with both to f32 tolerance."""
     import numpy as np
 
     from photon_tpu.drivers import score_game, train_game
@@ -293,9 +295,14 @@ def test_streamed_scoring_matches_whole(tmp_path):
         "--id-columns", "re0",
         "--evaluators", "AUC,SHARDED_AUC:re0",
     ]
+    device_whole = score_game.run(score_game.build_parser().parse_args(
+        common_args + ["--input", avro_path,
+                       "--output-dir", str(tmp_path / "s_device")]))
+    monkeypatch.setenv("PHOTON_BATCH_SCORER", "host")
     whole = score_game.run(score_game.build_parser().parse_args(
         common_args + ["--input", avro_path,
                        "--output-dir", str(tmp_path / "s_whole")]))
+    monkeypatch.delenv("PHOTON_BATCH_SCORER")
     streamed = score_game.run(score_game.build_parser().parse_args(
         common_args + ["--input", str(parts / "*.avro"), "--stream",
                        "--output-dir", str(tmp_path / "s_stream")]))
@@ -306,3 +313,9 @@ def test_streamed_scoring_matches_whole(tmp_path):
     np.testing.assert_array_equal(s_whole, s_stream)
     for name, value in whole["metrics"].items():
         assert streamed["metrics"][name] == pytest.approx(value, rel=1e-6)
+    # The default (device gather-table) whole-set route agrees with the
+    # host oracle to f32 accumulation tolerance.
+    s_device = np.loadtxt(tmp_path / "s_device" / "scores.txt")
+    np.testing.assert_allclose(s_device, s_whole, rtol=1e-4, atol=1e-4)
+    for name, value in whole["metrics"].items():
+        assert device_whole["metrics"][name] == pytest.approx(value, rel=1e-3)

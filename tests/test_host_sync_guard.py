@@ -46,6 +46,17 @@ def test_checker_accepts_marker_within_window(tmp_path):
     assert len(check_file(far)) == 1
 
 
+def test_serving_hot_path_is_guarded():
+    """The online scoring service rides the default guard set (ISSUE 9
+    satellite): its one response-egress fetch and ingest coercions carry
+    markers, and adding an unmarked sync to serving code must fail CI."""
+    from check_host_sync import DEFAULT_FILES
+
+    guarded = set(DEFAULT_FILES)
+    assert "photon_tpu/serving/scorer.py" in guarded
+    assert "photon_tpu/serving/batcher.py" in guarded
+
+
 def test_checker_ignores_jnp_and_comments(tmp_path):
     f = tmp_path / "f.py"
     f.write_text(

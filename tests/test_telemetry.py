@@ -370,6 +370,44 @@ def test_render_markdown_checkpoint_pipeline_section(tmp_path):
     assert "## Host-IO pool" not in text2
 
 
+def test_render_markdown_serving_section(tmp_path):
+    """The serving.* row block (ISSUE 9 satellite): request/batch counters,
+    the coalescing and host-syncs-per-batch ratios, latency distributions;
+    absent metrics -> absent section."""
+    session = TelemetrySession("serving-test")
+    session.counter("serving.requests").inc(40)
+    session.counter("serving.batches", bucket=8).inc(6)
+    session.counter("serving.batches", bucket=64).inc(4)
+    session.counter("serving.rows").inc(320)
+    session.counter("serving.host_syncs").inc(10)
+    session.counter("serving.cold_entities", coordinate="per_user").inc(3)
+    session.counter("serving.compilations").inc(5)
+    session.gauge("serving.qps").set(1234.5)
+    session.histogram("serving.request_latency_s").observe(0.002)
+    session.histogram("serving.padded_fraction").observe(0.25)
+    session.finalize(str(tmp_path))
+    text = render_markdown(
+        json.load(open(tmp_path / "telemetry" / "run_report.json"))
+    )
+    assert "## Online serving" in text
+    assert "| serving.requests | 40 |" in text
+    assert "| serving.batches | 10 |" in text  # summed over bucket labels
+    assert "| requests per batch (coalescing) | 4 |" in text
+    assert "| serving.host_syncs per batch | 1 |" in text
+    assert "| serving.cold_entities | 3 |" in text
+    assert "| serving.qps | 1234.5 |" in text
+    assert "serving.request_latency_s" in text
+    assert "serving.padded_fraction" in text
+
+    plain = TelemetrySession("no-serving")
+    plain.counter("rows").inc()
+    plain.finalize(str(tmp_path / "plain"))
+    text2 = render_markdown(
+        json.load(open(tmp_path / "plain" / "telemetry" / "run_report.json"))
+    )
+    assert "## Online serving" not in text2
+
+
 # ------------------------------------------------------ driver integration
 
 

@@ -47,6 +47,13 @@ DEFAULT_FILES = (
     # ever fetch device data — a watchdog that syncs would BE the stall.
     "photon_tpu/fault/preemption.py",
     "photon_tpu/fault/watchdog.py",
+    # The online scoring service: every served batch is allowed exactly
+    # ONE d2h (the response egress, serving.host_syncs) and the host work
+    # at request ingest (staging/key-join on caller-owned numpy); both
+    # carry markers.  Anything else in the serving hot path would add a
+    # per-request round-trip the latency budget cannot absorb.
+    "photon_tpu/serving/scorer.py",
+    "photon_tpu/serving/batcher.py",
 )
 
 SYNC_PATTERN = re.compile(
