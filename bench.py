@@ -514,6 +514,79 @@ def _game_bench_fixture(n_random_coords: int, descent_iterations: int,
     return platform, (n_entities, rows_mean), data, config
 
 
+def _bench_ooc() -> None:
+    """Out-of-core GAME micro-bench (``--mode ooc`` — ISSUE 10).
+
+    Runs the SAME synthetic GAME fit twice — resident (device residual
+    engine) and streamed under a FORCED small ``--max-resident-mb``-style
+    chunk budget — and emits ``game_ooc_rows_per_sec``: the streamed fit's
+    training rows/s, with the resident number, the streaming overhead
+    ratio, and the measured prefetch economics (``stream.stall_s`` /
+    ``stream.prefetch_overlap_s``; the acceptance bar is stall < 20% of
+    chunk compute on this CPU fixture) in detail.  Each mode times its
+    SECOND fit (the first pays compilation, both modes alike).
+    """
+    from photon_tpu.game.estimator import GameEstimator
+    from photon_tpu.game.tiles import PREFETCH_DEPTH, per_row_bytes
+    from photon_tpu.telemetry import TelemetrySession
+
+    iters = 2
+    platform, (n_entities, _rows_mean), data, config = _game_bench_fixture(
+        n_random_coords=1, descent_iterations=iters
+    )
+    # Force a budget ~1/8 of the dataset: the streamed fit must page.
+    chunk_rows = max(1, data.num_examples // 8)
+    chunk_mb = (
+        (PREFETCH_DEPTH + 1) * chunk_rows * per_row_bytes(data) / (1 << 20)
+    )
+
+    resident = GameEstimator("logistic_regression", data,
+                             residual_mode="device")
+    resident.fit([config])  # warm-up: compile + device-data upload
+    t0 = time.perf_counter()
+    resident.fit([config])
+    resident_wall = time.perf_counter() - t0
+
+    session = TelemetrySession("bench-ooc")
+    streamed = GameEstimator("logistic_regression", data,
+                             stream_chunks=chunk_rows, telemetry=session)
+    streamed.fit([config])  # warm-up
+    stall0 = session.registry.counter("stream.stall_s").value
+    overlap0 = session.registry.counter("stream.prefetch_overlap_s").value
+    t0 = time.perf_counter()
+    streamed.fit([config])
+    streamed_wall = time.perf_counter() - t0
+    stall = session.registry.counter("stream.stall_s").value - stall0
+    overlap = (
+        session.registry.counter("stream.prefetch_overlap_s").value - overlap0
+    )
+    peak = streamed._streamer.peak_in_flight_bytes
+    # Chunk compute ≈ streamed wall minus the time spent stalled on loads.
+    compute = max(1e-9, streamed_wall - stall)
+
+    _emit("game_ooc_rows_per_sec",
+          iters * data.num_examples / streamed_wall, "rows/s", {
+              "rows": data.num_examples,
+              "entities": n_entities,
+              "descent_iterations": iters,
+              "chunk_rows": chunk_rows,
+              "chunk_budget_mb": round(chunk_mb, 2),
+              "device_peak_in_flight_bytes": int(peak),
+              "streamed_fit_seconds": round(streamed_wall, 4),
+              "resident_fit_seconds": round(resident_wall, 4),
+              "resident_rows_per_sec": round(
+                  iters * data.num_examples / resident_wall, 1
+              ),
+              "streaming_overhead_x": round(
+                  streamed_wall / resident_wall, 3
+              ),
+              "stall_s": round(stall, 4),
+              "prefetch_overlap_s": round(overlap, 4),
+              "stall_fraction_of_compute": round(stall / compute, 4),
+              "platform": platform,
+          })
+
+
 def _bench_descent() -> None:
     """GAME coordinate-descent residual micro-bench (``--mode descent``).
 
@@ -1565,6 +1638,7 @@ def main() -> None:
             "recovery": _bench_recovery,
             "entities": _bench_entities,
             "serving": _bench_serving,
+            "ooc": _bench_ooc,
         }
         if mode not in modes:
             # An unknown mode must not silently fall through to the full
@@ -1614,6 +1688,7 @@ def main() -> None:
                           ("game_validation", _bench_validation),
                           ("game_recovery", _bench_recovery),
                           ("game_serving", _bench_serving),
+                          ("game_ooc", _bench_ooc),
                           ("game_entities",
                            _functools.partial(_bench_entities, 100_000))):
             elapsed = time.perf_counter() - t_start

@@ -89,6 +89,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "restores the full per-iteration GameModel.score fetch "
                    "+ numpy evaluators.  'auto' (default) follows "
                    "--residuals.  Overrides PHOTON_VALIDATION")
+    p.add_argument("--stream-chunks", type=int, default=None,
+                   metavar="ROWS",
+                   help="out-of-core GAME: train with the streamed descent "
+                   "— rows partitioned into ROWS-sized chunks, score "
+                   "tables tiled at the host tier, chunks double-buffered "
+                   "h2d on the io pool (device residency bounded by the "
+                   "chunk window, not the dataset).  Single-controller; "
+                   "replaces --residuals/--validation-pipeline.  Also "
+                   "auto-enabled by --max-resident-mb")
+    p.add_argument("--max-resident-mb", type=float, default=None,
+                   help="device-residency budget in MB: when the dataset's "
+                   "resident-fit estimate exceeds it, streaming "
+                   "auto-enables with a chunk size whose in-flight window "
+                   "fits the budget (explicit --stream-chunks wins)")
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"),
                    help="storage dtype for FEATURE VALUES in every shard "
@@ -499,6 +513,54 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
     )
 
     mesh = common.maybe_mesh()
+    stream_rows = None
+    if args.stream_chunks is not None:
+        if args.stream_chunks < 1:
+            raise ValueError(
+                f"--stream-chunks must be >= 1, got {args.stream_chunks}"
+            )
+        stream_rows = args.stream_chunks
+    elif args.max_resident_mb is not None:
+        from photon_tpu.game.tiles import (
+            chunk_rows_for_budget,
+            resident_bytes_estimate,
+        )
+
+        estimate = resident_bytes_estimate(data, n_coordinates=len(specs))
+        budget = int(args.max_resident_mb * (1 << 20))
+        session.gauge("stream.resident_estimate_bytes").set(estimate)
+        if estimate > budget:
+            stream_rows = chunk_rows_for_budget(data, args.max_resident_mb)
+            logger.info(
+                "resident estimate %.1f MB exceeds --max-resident-mb %.1f: "
+                "streaming enabled with %d-row chunks",
+                estimate / (1 << 20), args.max_resident_mb, stream_rows,
+            )
+    if stream_rows:
+        import jax as _jax_stream
+
+        if _jax_stream.process_count() > 1:
+            raise ValueError(
+                "--stream-chunks/--max-resident-mb streaming runs "
+                "single-controller; drop the multi-process flags"
+            )
+        if mesh is not None:
+            # A single-host multi-device mesh is an execution choice the
+            # streamed loop does not use: fall back to one device rather
+            # than refuse the run.
+            logger.info(
+                "streamed descent is single-controller: ignoring the "
+                "%d-device mesh", len(_jax_stream.devices()),
+            )
+            mesh = None
+        if args.residuals not in (None, "auto") or (
+            args.validation_pipeline not in (None, "auto")
+        ):
+            logger.info(
+                "streamed descent replaces --residuals/"
+                "--validation-pipeline; ignoring the explicit flags"
+            )
+        session.gauge("stream.chunk_rows").set(stream_rows)
     estimator = GameEstimator(
         args.task,
         data,
@@ -507,8 +569,11 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         mesh=mesh,
         logger=logger,
         telemetry=session,
-        residual_mode=args.residuals,
-        validation_mode=args.validation_pipeline,
+        # The streamed estimator refuses explicit engine modes; the driver
+        # already warned above, so strip them here.
+        residual_mode=None if stream_rows else args.residuals,
+        validation_mode=None if stream_rows else args.validation_pipeline,
+        stream_chunks=stream_rows,
     )
 
     import jax as _jax

@@ -378,10 +378,18 @@ class DescentState:
     residual_rows: Dict[str, np.ndarray]
     quarantined: int
     fingerprint: dict
+    # Streamed (out-of-core) descents only: the mid-epoch restart cursor —
+    # {"chunk_rows", "cursor" (coordinates completed in the in-progress
+    # iteration; 0 = iteration boundary), "seq" (monotonic checkpoint
+    # sequence), "tile_digests" (per-chunk score-tile content digests,
+    # verified on resume)}.  None for resident descents.
+    stream: Optional[dict] = None
 
     @property
     def completed(self) -> bool:
-        return self.iteration + 1 >= self.num_iterations
+        return self.iteration + 1 >= self.num_iterations and not (
+            self.stream or {}
+        ).get("cursor")
 
 
 # -- model <-> array serialization ------------------------------------------
@@ -773,8 +781,16 @@ class DescentCheckpointer(CheckpointPublisherBase):
             "quarantined": state.quarantined,
             "fingerprint": state.fingerprint,
             "layout": _state_layout(state),
+            "stream": state.stream,
         }
-        return self.save_arrays(state.iteration, arrays, payload)
+        # Streamed descents checkpoint MID-EPOCH (after every coordinate):
+        # the version name follows the monotonic stream sequence so two
+        # snapshots of one iteration never collide; resident descents keep
+        # the one-version-per-iteration naming.
+        seq = state.iteration
+        if state.stream:
+            seq = int(state.stream.get("seq", state.iteration))
+        return self.save_arrays(seq, arrays, payload)
 
     # -- load ----------------------------------------------------------------
     def load(self, resume: str, mesh=None) -> Optional[DescentState]:
@@ -846,6 +862,7 @@ class DescentCheckpointer(CheckpointPublisherBase):
             },
             quarantined=int(payload.get("quarantined", 0)),
             fingerprint=payload.get("fingerprint", {}),
+            stream=payload.get("stream"),
         )
 
 

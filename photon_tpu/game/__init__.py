@@ -29,8 +29,22 @@ from photon_tpu.game.coordinate import (
 )
 from photon_tpu.game.descent import CoordinateDescent, DescentResult
 from photon_tpu.game.estimator import GameEstimator, GameOptimizationConfiguration
+from photon_tpu.game.tiles import (
+    ChunkPlan,
+    ChunkStreamer,
+    TiledResidualTable,
+    TiledValidationTable,
+    chunk_rows_for_budget,
+    resident_bytes_estimate,
+)
 
 __all__ = [
+    "ChunkPlan",
+    "ChunkStreamer",
+    "TiledResidualTable",
+    "TiledValidationTable",
+    "chunk_rows_for_budget",
+    "resident_bytes_estimate",
     "DenseShard",
     "SparseShard",
     "GameDataset",

@@ -381,6 +381,15 @@ class CoordinateDescent:
                     # are never re-scored again (their rows are reused every
                     # iteration — validation.score_reuse counts them).
                     val_engine.update(name, val_cache.score(coord_model))
+            # Kick the foreign-vocabulary warm-start key joins onto the io
+            # pool NOW: the fixed effect usually trains first, and by the
+            # time a random coordinate's train() needs its aligned table
+            # the join has run beside that compute instead of blocking it.
+            from photon_tpu.game.coordinate import prefetch_warm_joins
+
+            prefetch_warm_joins(
+                self.coordinates, initial_model, telemetry=self.telemetry
+            )
 
         # Drain guard flags from the seeding/resume updates BEFORE the loop:
         # a rejected seed row belongs to the INITIAL model, not to whatever

@@ -72,13 +72,22 @@ class GameEstimator:
         telemetry=None,
         residual_mode: Optional[str] = None,
         validation_mode: Optional[str] = None,
+        stream_chunks: Optional[int] = None,
     ):
         """``normalization`` is keyed by feature-shard name and applies to
         fixed-effect coordinates on that shard (the reference normalizes the
         fixed-effect objective only).  ``residual_mode`` selects how descent
         passes residuals between coordinates, ``validation_mode`` how it
         scores/evaluates validation data (``auto``/``device``/``host`` —
-        see :mod:`photon_tpu.game.residuals`)."""
+        see :mod:`photon_tpu.game.residuals`).
+
+        ``stream_chunks`` (rows per chunk, > 0) switches every fit to the
+        OUT-OF-CORE streamed descent (:mod:`photon_tpu.game.stream_descent`):
+        training data and score state stay host-resident as fixed-size row
+        chunks / score tiles, streamed through a double-buffered h2d
+        prefetch — device residency is bounded by the chunk window instead
+        of the dataset size.  Streamed mode is single-controller (no mesh)
+        and replaces the residual/validation mode machinery."""
         self.task_type = task_type
         self.training_data = training_data
         self.validation_data = validation_data
@@ -95,10 +104,39 @@ class GameEstimator:
         self.telemetry = telemetry or NULL_SESSION
         self.residual_mode = residual_mode
         self.validation_mode = validation_mode
+        self.stream_chunks = None
+        if stream_chunks is not None:
+            if int(stream_chunks) < 1:
+                raise ValueError(
+                    f"stream_chunks must be >= 1, got {stream_chunks}"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "stream_chunks (out-of-core GAME) runs single-controller"
+                    " — drop the mesh or train resident"
+                )
+            if residual_mode not in (None, "auto") or (
+                validation_mode not in (None, "auto")
+            ):
+                # Same refuse-loudly policy as every other unsupported
+                # streamed configuration: an explicitly requested resident
+                # engine must not be silently replaced by the tiled tables
+                # (the CLI driver strips the flags itself and logs).
+                raise ValueError(
+                    "stream_chunks replaces the residual/validation "
+                    "engines; drop the explicit residual_mode/"
+                    "validation_mode (got "
+                    f"{residual_mode!r}/{validation_mode!r})"
+                )
+            self.stream_chunks = int(stream_chunks)
         # Device-resident data shared across sweep configurations: building
         # the bucketed random-effect datasets (the reference's shuffle) and
         # uploading feature blocks happens once per distinct data config.
         self._device_data_cache: Dict[tuple, object] = {}
+        # Streamed mode: host-side bucketed layouts + the shared chunk
+        # streamer (overlap/stall telemetry accumulates across the sweep).
+        self._stream_data_cache: Dict[tuple, object] = {}
+        self._streamer = None
         # Validation scoring cache shared across the whole sweep: one upload
         # of the validation feature shards for ALL configurations.
         self._validation_cache = None
@@ -175,6 +213,66 @@ class GameEstimator:
             coord.telemetry = self.telemetry
         return coords
 
+    # -- streamed (out-of-core) mode -----------------------------------------
+    def _stream_plan(self):
+        from photon_tpu.game.tiles import ChunkPlan
+
+        return ChunkPlan(self.training_data.num_examples, self.stream_chunks)
+
+    def _stream_streamer(self):
+        from photon_tpu.game.tiles import ChunkStreamer
+
+        if self._streamer is None:
+            self._streamer = ChunkStreamer(self.telemetry)
+        return self._streamer
+
+    def _build_stream_coordinates(self, config: GameOptimizationConfiguration):
+        """Streamed counterparts of :meth:`_build_coordinates`: no device
+        data is uploaded at build time — fixed coordinates stream row
+        chunks, random coordinates stream entity sub-blocks from HOST bin
+        layouts cached across sweep configurations."""
+        from photon_tpu.game.coordinate import (
+            FactoredRandomEffectCoordinateConfig,
+            FixedEffectCoordinateConfig,
+            RandomEffectCoordinateConfig,
+        )
+        from photon_tpu.game.stream_descent import (
+            StreamedFixedEffectCoordinate,
+            StreamedRandomEffectCoordinate,
+            StreamedRandomEffectHostData,
+        )
+
+        plan, streamer = self._stream_plan(), self._stream_streamer()
+        coords = {}
+        for name, cc in config.coordinates.items():
+            if isinstance(cc, FixedEffectCoordinateConfig):
+                coords[name] = StreamedFixedEffectCoordinate(
+                    self.training_data, cc, self.task_type, plan, streamer,
+                    normalization=self.normalization.get(cc.shard_name),
+                )
+            elif isinstance(cc, FactoredRandomEffectCoordinateConfig):
+                raise ValueError(
+                    f"coordinate {name!r}: factored_random coordinates have "
+                    "no streamed path (the pooled latent solve is "
+                    "whole-dataset); train resident"
+                )
+            elif isinstance(cc, RandomEffectCoordinateConfig):
+                key = cc.data_key
+                if key not in self._stream_data_cache:
+                    self._stream_data_cache[key] = (
+                        StreamedRandomEffectHostData(self.training_data, cc)
+                    )
+                coords[name] = StreamedRandomEffectCoordinate(
+                    self.training_data, cc, self.task_type, plan, streamer,
+                    host_data=self._stream_data_cache[key],
+                )
+            else:
+                raise TypeError(f"unknown coordinate config {type(cc)!r}")
+        for name, coord in coords.items():
+            coord.fault_name = name
+            coord.telemetry = self.telemetry
+        return coords
+
     def onboard_training_data(self, data: GameDataset) -> None:
         """Incremental entity onboarding between fits: swap in a GROWN
         training dataset whose appended rows belong to NEW random-effect
@@ -214,6 +312,10 @@ class GameEstimator:
                     )
                 else:
                     del self._device_data_cache[key]
+        # Streamed host layouts have no incremental-onboard path (they are
+        # cheap host structures): drop them for a lazy rebuild at the
+        # grown row count.
+        self._stream_data_cache.clear()
         self.training_data = data
 
     def fit(
@@ -304,22 +406,38 @@ class GameEstimator:
                     self.validation_data is not None
                     and self.evaluators is not None
                 )
-                expected = descent_fingerprint(
-                    self.task_type, config.coordinates,
-                    self.training_data.num_examples,
-                    resolve_residual_mode(self.residual_mode),
-                    config_key=config_key,
-                    validation_key=(
-                        self.evaluators.primary.name if has_validation
-                        else None
-                    ),
-                    locked=locked_coordinates,
-                    warm_start=initial_model is not None,
-                    coordinate_kinds={
-                        name: getattr(cc, "kind", type(cc).__name__)
-                        for name, cc in config.coordinates.items()
-                    },
+                kinds = {
+                    name: getattr(cc, "kind", type(cc).__name__)
+                    for name, cc in config.coordinates.items()
+                }
+                validation_key = (
+                    self.evaluators.primary.name if has_validation else None
                 )
+                if self.stream_chunks:
+                    from photon_tpu.game.stream_descent import (
+                        stream_fingerprint,
+                    )
+
+                    expected = stream_fingerprint(
+                        self.task_type, config.coordinates,
+                        self.training_data.num_examples, self.stream_chunks,
+                        config_key=config_key,
+                        validation_key=validation_key,
+                        locked=locked_coordinates,
+                        warm_start=initial_model is not None,
+                        coordinate_kinds=kinds,
+                    )
+                else:
+                    expected = descent_fingerprint(
+                        self.task_type, config.coordinates,
+                        self.training_data.num_examples,
+                        resolve_residual_mode(self.residual_mode),
+                        config_key=config_key,
+                        validation_key=validation_key,
+                        locked=locked_coordinates,
+                        warm_start=initial_model is not None,
+                        coordinate_kinds=kinds,
+                    )
                 require_fingerprint(
                     resume_state, expected, f"configuration {label!r}"
                 )
@@ -353,18 +471,36 @@ class GameEstimator:
                 continue
             with self.telemetry.span("estimator.fit", configuration=label), \
                     self.logger.timed(f"fit-{label}"):
-                descent = CoordinateDescent(
-                    self._build_coordinates(config),
-                    self.task_type,
-                    self.training_data,
-                    self.validation_data,
-                    self.evaluators,
-                    logger=self.logger,
-                    telemetry=self.telemetry,
-                    residual_mode=self.residual_mode,
-                    validation_mode=self.validation_mode,
-                    validation_cache=self._validation_scoring_cache(),
-                ).run(
+                if self.stream_chunks:
+                    from photon_tpu.game.stream_descent import (
+                        StreamedCoordinateDescent,
+                    )
+
+                    loop = StreamedCoordinateDescent(
+                        self._build_stream_coordinates(config),
+                        self.task_type,
+                        self.training_data,
+                        self.validation_data,
+                        self.evaluators,
+                        plan=self._stream_plan(),
+                        streamer=self._stream_streamer(),
+                        logger=self.logger,
+                        telemetry=self.telemetry,
+                    )
+                else:
+                    loop = CoordinateDescent(
+                        self._build_coordinates(config),
+                        self.task_type,
+                        self.training_data,
+                        self.validation_data,
+                        self.evaluators,
+                        logger=self.logger,
+                        telemetry=self.telemetry,
+                        residual_mode=self.residual_mode,
+                        validation_mode=self.validation_mode,
+                        validation_cache=self._validation_scoring_cache(),
+                    )
+                descent = loop.run(
                     config.descent_iterations,
                     initial_model=initial_model,
                     locked_coordinates=locked_coordinates,

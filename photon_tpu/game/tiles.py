@@ -1,0 +1,580 @@
+"""Tiled score tables + double-buffered chunk streaming (out-of-core GAME).
+
+The resident engines (:mod:`photon_tpu.game.residuals`) hold ONE stacked
+``[C, n]`` score table in device memory — correct until ``n`` outgrows HBM.
+This module is the out-of-core counterpart (ISSUE 10 / the ROADMAP's
+"billions of rows that never fit in HBM" wall): rows are partitioned into
+fixed-size **chunks** (one per sharded part-file group), the score table
+becomes per-chunk ``[C, rows_k]`` **tiles** resident at the host tier, and
+per-chunk Neumaier-compensated partials ``(total_k, comp_k)`` reduce to
+exactly the global compensated total the resident engine maintains — the
+Neumaier scan runs over the COORDINATE axis element-wise per row, so the
+chunk partition cannot change a single value.  This is Snap ML's hierarchy
+argument (arXiv:1803.06333) applied one tier up: the dataset and score
+state live at the host level, and only the working chunk (plus its
+prefetched successor) ever occupies device memory.
+
+:class:`ChunkStreamer` is the transport: chunk ``k+1``'s host slice +
+``device_put`` runs on io-pool worker threads while chunk ``k`` computes —
+the double-buffered h2d prefetch.  Overlap is measured, not assumed:
+``stream.stall_s`` accumulates the wall time the consumer spent blocked on
+a chunk that was not ready, ``stream.prefetch_overlap_s`` the load time
+that was hidden behind compute, and the ``residuals.device_bytes`` gauge
+reports the peak in-flight device residency (the chunk budget bound the
+descent asserts against).
+
+The per-chunk map + cross-chunk reduce shape — every training pass is
+``reduce(map(chunk))`` with the reduction inside jit per chunk — is the
+DrJAX MapReduce idiom (arXiv:2403.07128) expressed at the host loop level,
+which is where it must live once the mapped axis no longer fits on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.telemetry import NULL_SESSION
+
+# Chunks the streamer keeps in flight beyond the one being consumed: chunk
+# k+1 uploads while chunk k computes (double buffering).  The device-memory
+# bound every budget computation uses is (PREFETCH_DEPTH + 1) chunks.
+PREFETCH_DEPTH = 2
+
+
+# ---------------------------------------------------------------------------
+# Chunk plan + memory budget
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkPlan:
+    """Fixed-size row partition: chunk ``k`` covers rows
+    ``[k * chunk_rows, min(n, (k+1) * chunk_rows))``.  The last chunk may be
+    partial; a ``chunk_rows >= n`` plan degenerates to one chunk (the
+    resident-equivalent case the tests pin)."""
+
+    n: int
+    chunk_rows: int
+
+    def __post_init__(self):
+        if self.n < 0:
+            raise ValueError(f"negative row count {self.n}")
+        if self.chunk_rows < 1:
+            raise ValueError(f"chunk_rows must be >= 1, got {self.chunk_rows}")
+
+    @property
+    def num_chunks(self) -> int:
+        return max(1, -(-self.n // self.chunk_rows))
+
+    def bounds(self, k: int) -> tuple[int, int]:
+        if not 0 <= k < self.num_chunks:
+            raise IndexError(f"chunk {k} out of range [0, {self.num_chunks})")
+        lo = k * self.chunk_rows
+        return lo, min(self.n, lo + self.chunk_rows)
+
+    def rows(self, k: int) -> int:
+        lo, hi = self.bounds(k)
+        return hi - lo
+
+
+def per_row_bytes(data) -> int:
+    """Bytes one dataset row occupies across every feature shard plus the
+    per-row scalars — the unit the chunk budget divides by."""
+    from photon_tpu.game.data import DenseShard
+
+    total = 12  # label + offset + weight (f32 each)
+    for shard in data.shards.values():
+        if isinstance(shard, DenseShard):
+            total += shard.x.dtype.itemsize * shard.x.shape[1]
+        else:
+            total += (
+                shard.ids.dtype.itemsize + shard.vals.dtype.itemsize
+            ) * shard.ids.shape[1]
+    return total
+
+
+def resident_bytes_estimate(data, n_coordinates: int = 2) -> int:
+    """Device bytes a RESIDENT GAME fit would hold for this dataset: the
+    training feature blocks, the scoring-cache second copy the residual
+    engine keeps (``coordinate._scoring_feats``), and the two stacked
+    ``[C, n]`` float32 score tables (residual + validation) at
+    ``n_coordinates`` rows each.  A lower bound — random-effect bin
+    padding (≤2× per block) and optimizer workspace ride on top — which
+    is the right direction for the auto-streaming gate
+    (``--max-resident-mb``): an over-budget ESTIMATE always streams, and
+    a dataset whose floor already exceeds the budget can never silently
+    train resident."""
+    n = data.num_examples
+    return 2 * per_row_bytes(data) * n + 2 * max(1, n_coordinates) * n * 4
+
+
+def chunk_rows_for_budget(data, max_resident_mb: float) -> int:
+    """Chunk size such that the streamer's in-flight window —
+    ``PREFETCH_DEPTH + 1`` chunks — fits the device budget."""
+    if max_resident_mb <= 0:
+        raise ValueError(f"max_resident_mb must be > 0, got {max_resident_mb}")
+    budget = int(max_resident_mb * (1 << 20))
+    rows = budget // ((PREFETCH_DEPTH + 1) * max(1, per_row_bytes(data)))
+    return max(1, min(int(rows), max(1, data.num_examples)))
+
+
+def slice_rows(data, lo: int, hi: int):
+    """Contiguous row window ``[lo, hi)`` of a GameDataset as numpy VIEWS
+    (no copy — the chunk loader's host side is a slice, not a gather)."""
+    from photon_tpu.game.data import DenseShard, GameDataset, SparseShard
+
+    def cut(shard):
+        if isinstance(shard, DenseShard):
+            return DenseShard(shard.x[lo:hi])
+        return SparseShard(shard.ids[lo:hi], shard.vals[lo:hi], shard.dim_)
+
+    return GameDataset(
+        label=data.label[lo:hi],
+        offset=data.offset[lo:hi],
+        weight=data.weight[lo:hi],
+        shards={name: cut(s) for name, s in data.shards.items()},
+        id_columns={name: c[lo:hi] for name, c in data.id_columns.items()},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered chunk streamer
+# ---------------------------------------------------------------------------
+
+
+def _device_nbytes(payload) -> int:
+    """Device bytes of one loaded chunk (any pytree of arrays)."""
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree.leaves(payload)
+    )
+
+
+class ChunkStreamer:
+    """Ordered chunk iteration with h2d prefetch on io-pool worker threads.
+
+    ``stream(load_chunk, num_chunks)`` yields ``load_chunk(k)`` results in
+    order; ``load_chunk`` runs on worker threads (host slice + device_put,
+    so the upload overlaps the consumer's compute).  At most
+    ``prefetch`` chunks are in flight beyond the one being consumed — the
+    double-buffer window that bounds device residency at
+    ``(prefetch + 1) × chunk_bytes``.
+
+    Telemetry (shared across every pass this streamer drives):
+    ``stream.stall_s`` — consumer wall time blocked on an unready chunk;
+    ``stream.prefetch_overlap_s`` — load seconds hidden behind compute;
+    ``stream.chunks`` — chunks delivered; ``peak_in_flight_bytes`` — the
+    high-water in-flight device residency (exported by the descent as the
+    ``residuals.device_bytes`` gauge, the chunk-budget assertion).
+    """
+
+    def __init__(self, telemetry=None, prefetch: int = PREFETCH_DEPTH):
+        self.telemetry = telemetry or NULL_SESSION
+        self.prefetch = max(1, int(prefetch))
+        self.peak_in_flight_bytes = 0
+        self._lock = threading.Lock()
+        # One persistent worker pool per streamer: a streamed L-BFGS runs
+        # one stream() pass PER OBJECTIVE EVALUATION, and spawning threads
+        # per pass would churn hundreds of threads across a fit.
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_workers = 0
+
+    def _executor(self, workers: int) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None or self._pool_workers < workers:
+                if self._pool is not None:
+                    self._pool.shutdown(wait=False)
+                self._pool = ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="photon-chunk-stream",
+                )
+                self._pool_workers = workers
+            return self._pool
+
+    def _note_bytes(self, in_flight_chunks: int, chunk_bytes: int) -> None:
+        bound = in_flight_chunks * chunk_bytes
+        with self._lock:
+            if bound > self.peak_in_flight_bytes:
+                self.peak_in_flight_bytes = bound
+
+    def stream(
+        self, load_chunk: Callable[[int], object], num_chunks: int
+    ) -> Iterator[object]:
+        from photon_tpu.utils.io_pool import io_threads
+
+        tel = self.telemetry
+        stall_c = tel.counter("stream.stall_s")
+        overlap_c = tel.counter("stream.prefetch_overlap_s")
+        chunks_c = tel.counter("stream.chunks")
+
+        def timed_load(k: int):
+            t0 = time.monotonic()
+            payload = load_chunk(k)
+            return payload, time.monotonic() - t0, _device_nbytes(payload)
+
+        # Single chunk: plain eager load — there is nothing to overlap,
+        # and the whole load time is an honest stall.
+        window = self.prefetch
+        if num_chunks <= 1:
+            for k in range(num_chunks):
+                payload, load_s, nbytes = timed_load(k)
+                stall_c.inc(load_s)
+                chunks_c.inc()
+                self._note_bytes(1, nbytes)
+                yield payload
+            return
+
+        ex = self._executor(min(window, max(2, io_threads())))
+        futs: deque = deque()
+        try:
+            idx = 0
+            while futs or idx < num_chunks:
+                while idx < num_chunks and len(futs) < window:
+                    futs.append(ex.submit(timed_load, idx))
+                    idx += 1
+                t_wait = time.monotonic()
+                payload, load_s, nbytes = futs.popleft().result()
+                stall = time.monotonic() - t_wait
+                stall_c.inc(stall)
+                overlap_c.inc(max(0.0, load_s - stall))
+                chunks_c.inc()
+                # REFILL before yielding: the successor chunks must be in
+                # flight WHILE the consumer computes on this one — with
+                # prefetch=1 this is what makes single-buffering ahead
+                # real rather than a silent no-overlap mode.
+                while idx < num_chunks and len(futs) < window:
+                    futs.append(ex.submit(timed_load, idx))
+                    idx += 1
+                # Compute-time residency: the chunk being consumed plus
+                # everything in flight behind it (sized by this chunk —
+                # chunks share one layout).  Steady state is window + 1
+                # chunks, the (PREFETCH_DEPTH + 1) factor the budget
+                # divides by.
+                self._note_bytes(len(futs) + 1, nbytes)
+                yield payload
+        finally:
+            # An abandoned pass (consumer raised / generator closed) must
+            # not leave queued loads running into the next pass: cancel
+            # what has not started; in-progress loads finish harmlessly
+            # (their results are dropped with the futures).
+            for f in futs:
+                f.cancel()
+
+
+# ---------------------------------------------------------------------------
+# Tiled score tables
+# ---------------------------------------------------------------------------
+
+
+def _neumaier_rows_np(tile: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Neumaier-compensated column-wise sum of one ``[C, rows]`` tile in
+    float32 numpy — the SAME arithmetic, in the same order, as the resident
+    engine's jitted ``_neumaier_rows`` scan (elementwise IEEE f32 ops), so
+    per-chunk partials concatenate to the resident engine's global
+    total/comp pair."""
+    total = np.zeros(tile.shape[1], np.float32)
+    comp = np.zeros(tile.shape[1], np.float32)
+    for row in tile:
+        t = total + row
+        lost = np.where(
+            np.abs(total) >= np.abs(row),
+            (total - t) + row,
+            (row - t) + total,
+        )
+        comp = comp + lost
+        total = t
+    return total, comp
+
+
+class TiledScoreTable:
+    """Host-resident per-chunk score tiles with maintained compensated
+    partials — the out-of-core form of ``_DeviceScoreTable``.
+
+    ``tiles[k]`` is the ``[C, rows_k]`` float32 score tile of chunk ``k``
+    (row ``c`` = coordinate ``c``'s scores over that chunk's rows);
+    ``totals[k]``/``comps[k]`` hold the chunk's Neumaier partials,
+    recomputed from the tile on every row update (never incrementally
+    drifted, same rule as the resident engine).  Training offsets and
+    composite margins are produced PER CHUNK — the streamed training and
+    scoring passes consume them chunk by chunk and never materialize a
+    device ``[C, n]`` table.
+
+    Non-finite score vectors are rejected at update (host check — the
+    tiles ARE host data), keeping the previous tile; the pending guard
+    flags drain through the same ``drain_guard_flags`` /
+    ``poll_quarantined`` contract as the engines.
+    """
+
+    _PATH = "residuals"
+
+    def __init__(
+        self,
+        base_offset: np.ndarray,
+        names: Sequence[str],
+        plan: ChunkPlan,
+        telemetry=None,
+    ):
+        if not names:
+            raise ValueError(
+                f"{type(self).__name__} needs at least one coordinate"
+            )
+        self.names = list(names)
+        self._row = {name: i for i, name in enumerate(self.names)}
+        if len(self._row) != len(self.names):
+            raise ValueError(f"duplicate coordinate names in {self.names}")
+        if len(base_offset) != plan.n:
+            raise ValueError(
+                f"base offset has {len(base_offset)} rows, plan covers {plan.n}"
+            )
+        self.plan = plan
+        self.telemetry = telemetry or NULL_SESSION
+        self.n = plan.n
+        # host-sync: the tiled tables are host-resident BY DESIGN — the
+        # out-of-core tier keeps score state at host level, streaming only
+        # the working chunk to device.
+        self.base = np.asarray(base_offset, np.float32)
+        c = len(self.names)
+        self.tiles: List[np.ndarray] = [
+            np.zeros((c, plan.rows(k)), np.float32)
+            for k in range(plan.num_chunks)
+        ]
+        self.totals: List[np.ndarray] = [
+            np.zeros(plan.rows(k), np.float32) for k in range(plan.num_chunks)
+        ]
+        self.comps: List[np.ndarray] = [
+            np.zeros(plan.rows(k), np.float32) for k in range(plan.num_chunks)
+        ]
+        self._pending_guard: list = []
+        self.telemetry.gauge(f"{self._PATH}.tile_chunks").set(plan.num_chunks)
+
+    @property
+    def num_chunks(self) -> int:
+        return self.plan.num_chunks
+
+    def row(self, name: str) -> int:
+        return self._row[name]
+
+    def update(self, name: str, new_scores) -> None:
+        """Replace ``name``'s score row across every tile and refresh the
+        per-chunk compensated partials.  ``new_scores`` is a host float32
+        vector of length ``n`` (the streamed scoring passes assemble it
+        chunk by chunk)."""
+        # host-sync: streamed score vectors arrive as host numpy by
+        # construction (assembled from per-chunk d2h fetches).
+        host = np.asarray(new_scores, np.float32)
+        if host.shape != (self.n,):
+            raise ValueError(
+                f"score vector for {name!r} has shape {host.shape}, "
+                f"want ({self.n},)"
+            )
+        ok = bool(np.isfinite(host).all())
+        self._pending_guard.append((name, ok))
+        if ok:
+            c = self._row[name]
+            for k in range(self.num_chunks):
+                lo, hi = self.plan.bounds(k)
+                self.tiles[k][c] = host[lo:hi]
+                self.totals[k], self.comps[k] = _neumaier_rows_np(
+                    self.tiles[k]
+                )
+        self.telemetry.counter(f"{self._PATH}.updates", coordinate=name).inc()
+
+    # -- per-chunk reads ------------------------------------------------------
+    def offsets_chunk(self, name: str, k: int) -> np.ndarray:
+        """Chunk ``k``'s training offsets for coordinate ``name``:
+        ``base_k + (total_k - tile_k[c]) + comp_k`` — the same fused formula
+        (and f32 order) as the resident ``_offsets_kernel``."""
+        lo, hi = self.plan.bounds(k)
+        c = self._row[name]
+        return self.base[lo:hi] + (
+            (self.totals[k] - self.tiles[k][c]) + self.comps[k]
+        )
+
+    def offsets_full(self, name: str) -> np.ndarray:
+        """All chunks' offsets concatenated (``[n]`` f32) — the host gather
+        source for random-effect bucket offsets, and exactly the
+        concatenation of :meth:`offsets_chunk` (chunking never changes a
+        value; see module docstring)."""
+        return np.concatenate(
+            [self.offsets_chunk(name, k) for k in range(self.num_chunks)]
+        )
+
+    def composite_chunk(self, k: int) -> np.ndarray:
+        """Chunk ``k``'s composite margin ``base_k + (total_k + comp_k)``
+        (the validation table's scoring output)."""
+        lo, hi = self.plan.bounds(k)
+        return self.base[lo:hi] + (self.totals[k] + self.comps[k])
+
+    def composite_full(self) -> np.ndarray:
+        return np.concatenate(
+            [self.composite_chunk(k) for k in range(self.num_chunks)]
+        )
+
+    def scores_for(self, name: str) -> np.ndarray:
+        """Coordinate ``name``'s current score vector (host, ``[n]``)."""
+        c = self._row[name]
+        return np.concatenate([tile[c] for tile in self.tiles])
+
+    # -- guard / snapshot contract (mirrors the engines) ----------------------
+    def drain_guard_flags(self) -> list:
+        pending, self._pending_guard = self._pending_guard, []
+        return pending
+
+    def record_rejected(self, bad: Sequence[str]) -> None:
+        for name in bad:
+            self.telemetry.counter(
+                f"{self._PATH}.nonfinite_rows", coordinate=name
+            ).inc()
+
+    def poll_quarantined(self) -> list:
+        bad = [name for name, ok in self.drain_guard_flags() if not ok]
+        self.record_rejected(bad)
+        return bad
+
+    def snapshot_rows(self) -> dict:
+        """All score rows as host float32 ``{name: [n]}`` — the checkpoint
+        snapshot (already host: staging is a copy)."""
+        return {name: self.scores_for(name).copy() for name in self.names}
+
+    def load_rows(self, rows: dict) -> None:
+        """Rebuild tiles from checkpointed rows (resume path).  Stored
+        directly — checkpointed rows were guarded at write time, and
+        routing them through update() would enqueue phantom guard flags."""
+        for name, row in rows.items():
+            if name not in self._row:
+                continue
+            # host-sync: checkpointed rows are host arrays by construction.
+            host = np.asarray(row, np.float32)
+            if host.shape != (self.n,):
+                raise ValueError(
+                    f"checkpointed row for {name!r} has shape {host.shape}, "
+                    f"want ({self.n},)"
+                )
+            c = self._row[name]
+            for k in range(self.num_chunks):
+                lo, hi = self.plan.bounds(k)
+                self.tiles[k][c] = host[lo:hi]
+        for k in range(self.num_chunks):
+            self.totals[k], self.comps[k] = _neumaier_rows_np(self.tiles[k])
+
+    def tile_digests(self) -> List[str]:
+        """Per-chunk content digests of the score tiles (sha256/16): stamped
+        into mid-epoch checkpoints so a resume can verify the rebuilt tiles
+        match the interrupted run's state chunk for chunk."""
+        out = []
+        for k in range(self.num_chunks):
+            h = hashlib.sha256()
+            h.update(self.tiles[k].tobytes())
+            out.append(h.hexdigest()[:16])
+        return out
+
+
+class TiledResidualTable(TiledScoreTable):
+    """Training-side tiled score table (the residual engine's role; the
+    base class already carries the ``residuals`` telemetry path)."""
+
+
+class TiledValidationTable(TiledScoreTable):
+    """Validation-side tiled score table: incremental per-coordinate
+    re-scoring with the composite margin from the same per-chunk partials
+    (``validation.score_reuse`` counting happens in the descent loop)."""
+
+    _PATH = "validation"
+
+
+# ---------------------------------------------------------------------------
+# Chunked model scoring (shared by training re-score and validation)
+# ---------------------------------------------------------------------------
+
+
+def score_model_chunks(
+    model,
+    data,
+    plan: ChunkPlan,
+    streamer: ChunkStreamer,
+    entity_idx: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Score one coordinate model over ``data`` chunk by chunk: each chunk's
+    features upload on the streamer's worker threads (prefetch overlapping
+    the previous chunk's margin kernel + fetch), margins compute on device,
+    and the per-chunk d2h fetches assemble the host ``[n]`` score vector the
+    tiled tables consume.  ``entity_idx`` (random models) is the
+    pre-computed per-row entity index against the MODEL's vocabulary."""
+    import jax.numpy as jnp
+
+    from photon_tpu.game.data import DenseShard
+    from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
+
+    shard = data.shard(model.shard_name)
+    dense = isinstance(shard, DenseShard)
+    is_random = isinstance(model, RandomEffectModel)
+    if is_random and entity_idx is None:
+        from photon_tpu.game.data import entity_index_for
+
+        # host-sync: the per-row entity key join is host work by nature
+        # (raw keys live on host); callers cache it per vocabulary.
+        entity_idx = entity_index_for(
+            data.id_columns[model.entity_column], np.asarray(model.keys)
+        )
+    if not is_random and not isinstance(model, FixedEffectModel):
+        raise TypeError(f"cannot chunk-score a {type(model).__name__}")
+
+    def load(k: int):
+        lo, hi = plan.bounds(k)
+        if dense:
+            feats = jnp.asarray(shard.x[lo:hi])
+        else:
+            feats = (jnp.asarray(shard.ids[lo:hi]), jnp.asarray(shard.vals[lo:hi]))
+        if is_random:
+            return feats, jnp.asarray(entity_idx[lo:hi].astype(np.int32))
+        return feats, None
+
+    out = np.empty(plan.n, np.float32)
+    pos = 0
+    for feats, idx in streamer.stream(load, plan.num_chunks):
+        if is_random:
+            margins = model.margins_device(idx, feats, dense)
+        else:
+            margins = model.margins_device(feats, dense)
+        # host-sync: the streamed scoring pass lands each chunk's margins at
+        # the host tier (that is where the tiles live — see module
+        # docstring); counted as d2h transfer below.
+        host = np.asarray(margins, np.float32)
+        out[pos : pos + len(host)] = host
+        pos += len(host)
+    streamer.telemetry.counter(
+        "descent.host_transfer_bytes", direction="d2h", path="stream_score"
+    ).inc(out.nbytes)
+    return out
+
+
+def entity_index_cache() -> Dict:
+    """A tiny per-descent cache for ``(column, keys-object) -> entity_idx``
+    joins used by :func:`score_model_chunks` callers (same identity-first
+    discipline as ``data.keys_match``)."""
+    return {}
+
+
+def cached_entity_index(cache: Dict, data, column: str, keys) -> np.ndarray:
+    from photon_tpu.game.data import entity_index_for, keys_match
+
+    hit = cache.get(column)
+    if hit is not None and keys_match(keys, hit[0], hit[1]):
+        return hit[2]
+    # host-sync: entity-key vocabularies are host numpy by construction.
+    arr = np.asarray(keys)
+    # host-sync: foreign-vocabulary key join (host keys) — once per
+    # distinct (column, vocabulary), cached after.
+    idx = entity_index_for(data.id_columns[column], arr)
+    cache[column] = (keys, arr, idx)
+    return idx

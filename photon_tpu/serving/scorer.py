@@ -282,19 +282,34 @@ class GameScorer:
         self.strict_after_warmup = strict_after_warmup
         self._programs: Dict[int, object] = {}
 
-        # -- device-resident model tables (loaded ONCE, owned for life) ------
+        # -- device-resident model tables (loaded once; replaceable by
+        # swap_model without recompiling — the programs take them as
+        # arguments) ----------------------------------------------------------
+        plan, tables, vocab = self._build_tables(model)
+        self._plan = tuple(plan)
+        self._tables = tuple(tables)
+        self._vocab = vocab
+        # The ONE published (tables, vocab) pair: score_batch unpacks it
+        # once at entry, so a swap can never hand one batch a mixed state.
+        self._serving = (self._tables, self._vocab)
+        self._record_model_gauges(model, self._tables)
+
+    def _build_tables(self, model: GameModel):
+        """Device placement of one model's serving state: the static
+        per-coordinate plan, the device table tuple, and the host
+        vocabularies the ingest join runs against.  Shared by ``__init__``
+        and :meth:`swap_model` so the two can never build differently.
+        Sets NO gauges — :meth:`_record_model_gauges` publishes telemetry
+        only for a model that actually serves (a refused swap must not
+        leave gauges describing the rejected model)."""
         plan: List[_CoordPlan] = []
         tables: List[jax.Array] = []
-        self._vocab: Dict[str, np.ndarray] = {}
-        model_bytes = 0
+        vocab: Dict[str, np.ndarray] = {}
         for name, coord in model.coordinates.items():
             if isinstance(coord, FixedEffectModel):
-                w = coord.serving_weights(mesh)
                 plan.append(_CoordPlan(name, "fixed", coord.shard_name))
-                tables.append(w)
-                model_bytes += w.nbytes
+                tables.append(coord.serving_weights(self.mesh))
             elif isinstance(coord, RandomEffectModel):
-                table = coord.serving_table(mesh)
                 plan.append(
                     _CoordPlan(
                         name, "random", coord.shard_name,
@@ -302,14 +317,10 @@ class GameScorer:
                         zero_row=coord.num_entities,
                     )
                 )
-                tables.append(table)
-                # host-sync: build-time only — entity vocabularies are host
-                # numpy by construction (the key join runs at ingest).
-                self._vocab[name] = np.asarray(coord.keys)
-                model_bytes += table.nbytes
-                self.telemetry.gauge(
-                    "serving.entities", coordinate=name
-                ).set(coord.num_entities)
+                tables.append(coord.serving_table(self.mesh))
+                # host-sync: build/swap-time only — entity vocabularies are
+                # host numpy by construction (the key join runs at ingest).
+                vocab[name] = np.asarray(coord.keys)
             else:
                 raise TypeError(
                     f"cannot serve a {type(coord).__name__} coordinate"
@@ -318,9 +329,63 @@ class GameScorer:
                 raise ValueError(
                     f"request spec is missing shard {coord.shard_name!r}"
                 )
-        self._plan = tuple(plan)
+        return plan, tables, vocab
+
+    def _record_model_gauges(self, model: GameModel, tables) -> None:
+        """Publish the SERVED model's residency/entity gauges (called only
+        after a model is actually installed)."""
+        for name, coord in model.coordinates.items():
+            if isinstance(coord, RandomEffectModel):
+                self.telemetry.gauge(
+                    "serving.entities", coordinate=name
+                ).set(coord.num_entities)
+        self.telemetry.gauge("serving.model_bytes").set(
+            sum(t.nbytes for t in tables)
+        )
+
+    def swap_model(self, model: GameModel) -> None:
+        """HOT-SWAP a retrained model under live traffic: the new device
+        table tuple is built (uploaded) FIRST — double-buffered next to the
+        serving tables — then published in one reference assignment, so no
+        request is dropped and nothing recompiles (every bucket program
+        takes the tables as arguments; the per-coordinate plan, which IS
+        baked into the programs, must match the served model's — same
+        coordinate names/kinds/shards/entity counts.  Vocabulary growth is
+        a rebuild, the open ROADMAP serving edge (c)).
+
+        In-flight requests complete against whichever tuple they captured
+        at dispatch: the old tables stay alive until their last dispatch
+        retires (the runtime holds the references), then free.  Counted as
+        ``serving.swaps``."""
+        plan, tables, vocab = self._build_tables(model)
+        if tuple(plan) != self._plan:
+            raise ValueError(
+                "swap_model: the new model's serving plan does not match "
+                f"the compiled programs (served {self._plan}, new "
+                f"{tuple(plan)}); a changed coordinate layout or entity "
+                "count requires a new GameScorer"
+            )
+        for new, old in zip(tables, self._tables):
+            if new.shape != old.shape or new.dtype != old.dtype:
+                raise ValueError(
+                    "swap_model: table shape/dtype changed "
+                    f"({old.shape}/{old.dtype} -> {new.shape}/{new.dtype}); "
+                    "a changed table layout requires a new GameScorer"
+                )
+        import jax as _jax
+
+        # The upload completes BEFORE publication: a request arriving the
+        # instant after the swap reads fully-materialized tables.
+        _jax.block_until_ready(tables)
+        # One-assignment publication: score_batch reads ``self._serving``
+        # exactly once at entry, so every batch scores against ONE model's
+        # tables + vocabulary — never a mix of old and new.
         self._tables = tuple(tables)
-        self.telemetry.gauge("serving.model_bytes").set(model_bytes)
+        self._vocab = vocab
+        self._serving = (self._tables, self._vocab)
+        self.model = model
+        self._record_model_gauges(model, self._tables)
+        self.telemetry.counter("serving.swaps").inc()
 
     # -- bucket policy -------------------------------------------------------
     def bucket_for(self, n: int) -> int:
@@ -451,11 +516,14 @@ class GameScorer:
         return put_request((feats, idx, offset, jnp.int32(n_valid)), self.mesh)
 
     # -- request staging (host side, the sanctioned ingest edge) -------------
-    def _stage(self, request: ScoringRequest, bucket: int, n: int):
+    def _stage(self, request: ScoringRequest, bucket: int, n: int,
+               vocab: Optional[Dict[str, np.ndarray]] = None):
         """Validate + pad one request to its bucket, join entity keys
         against each coordinate's vocabulary, and coerce dtypes — the
         request-ingest host work.  Padding rows carry zero features, entity
         index -1 (masked from cold counts by ``n_valid``), zero offset."""
+        if vocab is None:
+            vocab = self._vocab
         feats: Dict[str, object] = {}
         for c in self._plan:
             if c.shard in feats:
@@ -498,7 +566,7 @@ class GameScorer:
             # The key->row join (host searchsorted against the sorted
             # vocabulary) is the serving-time shape of the reference's
             # scoring shuffle-join; unknown keys become -1 -> zero row.
-            rows = entity_index_for(keys, self._vocab[c.name])
+            rows = entity_index_for(keys, vocab[c.name])
             idx[c.name] = _pad_rows(rows, bucket, fill=-1)
         offset = (
             np.zeros(bucket, np.float32) if request.offset is None
@@ -547,10 +615,13 @@ class GameScorer:
     def _score_padded(self, request: ScoringRequest, bucket: int,
                       n: int, layout: str = "request") -> np.ndarray:
         t0 = time.monotonic()
+        # ONE read of the published (tables, vocab) pair: a concurrent
+        # swap_model cannot hand this batch old tables + a new vocabulary.
+        tables, vocab = self._serving
         program = self._program(bucket, layout=layout)
-        feats, idx, offset = self._stage(request, bucket, n)
+        feats, idx, offset = self._stage(request, bucket, n, vocab)
         placed = self._place(feats, idx, offset, n, layout=layout)
-        out, cold_dev = program(self._tables, *placed)
+        out, cold_dev = program(tables, *placed)
         # The response must OWN its memory (the copy below): on CPU the
         # fetch can alias the device output buffer, and with donated inputs
         # that buffer is recycled by the very next batch — a zero-copy view

@@ -46,6 +46,27 @@ def io_threads() -> int:
     return env_int("PHOTON_IO_THREADS", default, minimum=1)
 
 
+_submit_pool: Optional[ThreadPoolExecutor] = None
+_submit_lock = threading.Lock()
+
+
+def submit(fn: Callable[[], R]):
+    """Fire one background call on a small shared io-pool executor and
+    return its Future — the overlap primitive for host work that should run
+    beside device compute (e.g. the foreign-vocabulary warm-start key join
+    prefetched while the fixed-effect coordinate trains).  The pool is
+    lazily created, bounded (2 threads — these are occasional scalar jobs,
+    not the bulk pipelines ``map_ordered`` serves), and process-lifetime;
+    submitted work must be short and must not block indefinitely."""
+    global _submit_pool
+    with _submit_lock:
+        if _submit_pool is None:
+            _submit_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="photon-io-submit"
+            )
+        return _submit_pool.submit(fn)
+
+
 def map_ordered(
     fn: Callable[[T], R],
     items: Sequence[T] | Iterable[T],

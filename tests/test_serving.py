@@ -493,3 +493,123 @@ def test_serve_game_driver_end_to_end(tmp_path):
     md = render_markdown(report)
     assert "## Online serving" in md
     assert "serving.host_syncs per batch | 1 |" in md
+
+
+# -- model hot-swap (ISSUE 10 satellite) -------------------------------------
+
+def _retrained(model: GameModel, seed: int) -> GameModel:
+    """A 'retrained' model: same coordinate layout and vocabularies,
+    different coefficients — the production hot-swap shape."""
+    rng = np.random.default_rng(seed)
+    fixed = model.coordinates["fixed"]
+    per_entity = model.coordinates["per_entity"]
+    means = np.asarray(fixed.coefficients.means)
+    return GameModel(
+        coordinates={
+            "fixed": FixedEffectModel(
+                model_for_task(model.task_type, Coefficients(
+                    (means + rng.standard_normal(means.shape)).astype(
+                        np.float32
+                    )
+                )),
+                fixed.shard_name,
+            ),
+            "per_entity": RandomEffectModel(
+                table=rng.standard_normal(
+                    (per_entity.num_entities, per_entity.dim)
+                ).astype(np.float32),
+                keys=per_entity.keys,
+                entity_column=per_entity.entity_column,
+                shard_name=per_entity.shard_name,
+                task_type=model.task_type,
+            ),
+        },
+        task_type=model.task_type,
+    )
+
+
+def test_swap_model_scores_new_model_without_recompiles():
+    model, data = _fixture(seed=23)
+    session = TelemetrySession("test-swap")
+    scorer = GameScorer(
+        model, request_spec=request_spec_for_dataset(model, data),
+        max_batch=32, telemetry=session,
+    ).warmup()
+    compiled = scorer.compilations
+    req = build_requests(data, model, [16])[0]
+    np.testing.assert_allclose(
+        scorer.score_batch(req), model.score(data)[:16],
+        rtol=1e-4, atol=1e-4,
+    )
+    retrained = _retrained(model, seed=29)
+    scorer.swap_model(retrained)
+    # Zero recompiles, scores are the NEW model's, and the swap counted.
+    np.testing.assert_allclose(
+        scorer.score_batch(req), retrained.score(data)[:16],
+        rtol=1e-4, atol=1e-4,
+    )
+    assert scorer.compilations == compiled
+    assert _counter_total(session, "serving.swaps") == 1
+
+
+def test_swap_model_mid_closed_loop_no_dropped_requests():
+    """Swap while a closed-loop request stream is in flight: every request
+    completes, every response matches the model that was live when its
+    batch dispatched (old XOR new — never a mix), and scores before/after
+    the swap pin both models."""
+    model, data = _fixture(seed=31)
+    session = TelemetrySession("test-swap-loop")
+    scorer = GameScorer(
+        model, request_spec=request_spec_for_dataset(model, data),
+        max_batch=32, telemetry=session,
+    ).warmup()
+    retrained = _retrained(model, seed=37)
+    want_old = model.score(data)
+    want_new = retrained.score(data)
+    requests = build_requests(data, model, [8] * 40)
+    windows = [np.arange(i * 8, (i + 1) * 8) % data.num_examples
+               for i in range(40)]
+    batcher = RequestBatcher(scorer, max_batch=32, max_delay_s=0.001)
+    swap_at = 20
+    results = []
+    with batcher:
+        futures = []
+        for i, req in enumerate(requests):
+            if i == swap_at:
+                scorer.swap_model(retrained)
+            futures.append(batcher.submit(req))
+        results = [f.result(timeout=30) for f in futures]
+    assert len(results) == len(requests)
+    for rows, got in zip(windows, results):
+        # Every response is exactly ONE model's scores — old XOR new,
+        # never a mix of the two tables/vocabularies.
+        ok_old = np.allclose(got, want_old[rows], rtol=1e-4, atol=1e-4)
+        ok_new = np.allclose(got, want_new[rows], rtol=1e-4, atol=1e-4)
+        assert ok_old or ok_new, "response matches neither model"
+    # The tail of the stream (submitted well after the swap) must be the
+    # new model's scores.
+    assert np.allclose(
+        results[-1], want_new[windows[-1]], rtol=1e-4, atol=1e-4
+    )
+    assert _counter_total(session, "serving.swaps") == 1
+
+
+def test_swap_model_rejects_layout_changes():
+    model, data = _fixture(seed=41)
+    scorer = GameScorer(
+        model, request_spec=request_spec_for_dataset(model, data),
+        max_batch=16,
+    ).warmup()
+    per_entity = model.coordinates["per_entity"]
+    # A grown vocabulary changes the zero-row index baked into the
+    # compiled programs: swap must refuse (rebuild instead).
+    grown = per_entity.with_entities(
+        np.unique(np.concatenate([per_entity.keys,
+                                  np.asarray(["zz-new-entity"])]))
+    )
+    bigger = GameModel(
+        coordinates={**model.coordinates, "per_entity": grown},
+        task_type=model.task_type,
+    )
+    with pytest.raises(ValueError, match="swap_model"):
+        scorer.swap_model(bigger)

@@ -41,6 +41,13 @@ DEFAULT_FILES = (
     # every RandomEffectCoordinate.train: a host fetch here would repeal
     # the one-sync-per-iteration contract for every random coordinate.
     "photon_tpu/game/batched_solve.py",
+    # The streamed (out-of-core) descent: score data moves host<->device
+    # per CHUNK by design (that is the tier the data lives at), but every
+    # such edge is a bulk streaming transfer carrying a marker — the only
+    # blocking scalar sync allowed per outer iteration is the chunk-cursor
+    # stats drain (descent.host_syncs), same contract as resident.
+    "photon_tpu/game/tiles.py",
+    "photon_tpu/game/stream_descent.py",
     "photon_tpu/fault/checkpoint.py",
     # The preemption/watchdog layers run ON the hot loop's thread (the
     # boundary checks) or beside it (the heartbeat thread): neither may
