@@ -514,20 +514,37 @@ def _game_bench_fixture(n_random_coords: int, descent_iterations: int,
     return platform, (n_entities, rows_mean), data, config
 
 
-def _bench_ooc() -> None:
-    """Out-of-core GAME micro-bench (``--mode ooc`` — ISSUE 10).
+def _bench_ooc(spill: bool = False) -> None:
+    """Out-of-core GAME micro-bench (``--mode ooc [--spill]`` — ISSUE
+    10/11).
 
-    Runs the SAME synthetic GAME fit twice — resident (device residual
-    engine) and streamed under a FORCED small ``--max-resident-mb``-style
-    chunk budget — and emits ``game_ooc_rows_per_sec``: the streamed fit's
-    training rows/s, with the resident number, the streaming overhead
-    ratio, and the measured prefetch economics (``stream.stall_s`` /
-    ``stream.prefetch_overlap_s``; the acceptance bar is stall < 20% of
-    chunk compute on this CPU fixture) in detail.  Each mode times its
-    SECOND fit (the first pays compilation, both modes alike).
+    Runs the SAME synthetic GAME fit — resident (device residual engine),
+    streamed under a FORCED small ``--max-resident-mb``-style chunk
+    budget, and (``spill=True``, the default bench run) streamed again
+    through the DISK-backed tile store under a ``--max-host-mb`` budget
+    small enough to force LRU eviction.  Emits ``game_ooc_rows_per_sec``
+    (streamed training rows/s vs resident) and, with spill,
+    ``game_ooc_disk_rows_per_sec`` with per-tier stall fractions and the
+    cache/store shape.  The spilled leg ASSERTS the ISSUE 11 acceptance
+    bars in-bench: forced evictions observed, spilled-vs-host-resident
+    tiles bit-identical (``np.array_equal`` against a recomputation from
+    the host-resident fit's final models), metrics ≤1e-6, and the spilled
+    rate ≥ 0.5× the host-resident streamed rate on CPU.  Each mode times
+    its SECOND fit (the first pays compilation, all modes alike).
     """
+    import tempfile
+
     from photon_tpu.game.estimator import GameEstimator
-    from photon_tpu.game.tiles import PREFETCH_DEPTH, per_row_bytes
+    from photon_tpu.game.tile_store import TileStore
+    from photon_tpu.game.tiles import (
+        PREFETCH_DEPTH,
+        RESIDUAL_TILE_KIND as TILES,
+        ChunkPlan,
+        ChunkStreamer,
+        per_row_bytes,
+        score_model_chunks,
+        stream_host_bytes_estimate,
+    )
     from photon_tpu.telemetry import TelemetrySession
 
     iters = 2
@@ -551,15 +568,16 @@ def _bench_ooc() -> None:
     streamed = GameEstimator("logistic_regression", data,
                              stream_chunks=chunk_rows, telemetry=session)
     streamed.fit([config])  # warm-up
-    stall0 = session.registry.counter("stream.stall_s").value
-    overlap0 = session.registry.counter("stream.prefetch_overlap_s").value
-    t0 = time.perf_counter()
-    streamed.fit([config])
-    streamed_wall = time.perf_counter() - t0
-    stall = session.registry.counter("stream.stall_s").value - stall0
-    overlap = (
-        session.registry.counter("stream.prefetch_overlap_s").value - overlap0
+    stall_c = session.registry.counter("stream.stall_s", tier="h2d")
+    overlap_c = session.registry.counter(
+        "stream.prefetch_overlap_s", tier="h2d"
     )
+    stall0, overlap0 = stall_c.value, overlap_c.value
+    t0 = time.perf_counter()
+    host_fit = streamed.fit([config])[0]
+    streamed_wall = time.perf_counter() - t0
+    stall = stall_c.value - stall0
+    overlap = overlap_c.value - overlap0
     peak = streamed._streamer.peak_in_flight_bytes
     # Chunk compute ≈ streamed wall minus the time spent stalled on loads.
     compute = max(1e-9, streamed_wall - stall)
@@ -585,6 +603,131 @@ def _bench_ooc() -> None:
               "stall_fraction_of_compute": round(stall / compute, 4),
               "platform": platform,
           })
+    if not spill:
+        return
+
+    # -- the disk tier (ISSUE 11): tile+feature bytes must EXCEED the host
+    # budget so the LRU cache pages against the store.
+    host_set = stream_host_bytes_estimate(data, n_coordinates=2)
+    max_host_mb = host_set / (1 << 20) / 4
+    with tempfile.TemporaryDirectory() as td:
+        sp_session = TelemetrySession("bench-ooc-spill")
+        spilled = GameEstimator(
+            "logistic_regression", data, stream_chunks=chunk_rows,
+            spill_dir=td, max_host_mb=max_host_mb, telemetry=sp_session,
+        )
+        spilled.fit([config])  # warm-up
+        d_stall_c = sp_session.registry.counter(
+            "stream.stall_s", tier="disk"
+        )
+        h_stall_c = sp_session.registry.counter(
+            "stream.stall_s", tier="h2d"
+        )
+        d_overlap_c = sp_session.registry.counter(
+            "stream.prefetch_overlap_s", tier="disk"
+        )
+        evict_c = sp_session.registry.counter("tiles.cache_evictions")
+        d0, h0, o0 = d_stall_c.value, h_stall_c.value, d_overlap_c.value
+        e0 = evict_c.value
+        t0 = time.perf_counter()
+        result = spilled.fit([config])[0]
+        spill_wall = time.perf_counter() - t0
+        disk_stall = d_stall_c.value - d0
+        h2d_stall = h_stall_c.value - h0
+        disk_overlap = d_overlap_c.value - o0
+        # Delta around the timed fit, like the stall/overlap counters:
+        # the warm-up fit evicts too, and the acceptance bar is "the
+        # MEASURED fit pages against the store".
+        evictions = evict_c.value - e0
+        cache_bytes = sp_session.registry.gauge(
+            "tiles.host_cache_bytes"
+        ).value
+        disk_bytes = sp_session.registry.gauge("tiles.disk_bytes").value
+
+        # ISSUE 11 acceptance, asserted in-bench --------------------------
+        if not evictions > 0:
+            raise AssertionError(
+                f"--spill bench must force LRU eviction (budget "
+                f"{max_host_mb:.2f} MB vs host set "
+                f"{host_set / (1 << 20):.2f} MB) but "
+                f"tiles.cache_evictions == {evictions}"
+            )
+        # Models bit-identical => every downstream artifact is too; check
+        # them directly, then check the PUBLISHED tiles against a
+        # recomputation from the host-resident fit's final models.
+        def model_table(m):
+            if hasattr(m, "table"):
+                return np.asarray(m.table)
+            return np.asarray(m.model.coefficients.means)
+
+        sp_last = result.descent.last_model.coordinates
+        host_last = host_fit.descent.last_model.coordinates
+        for name, host_model in host_last.items():
+            if not np.array_equal(
+                model_table(host_model), model_table(sp_last[name])
+            ):
+                raise AssertionError(
+                    f"spilled fit diverged from host-resident streamed "
+                    f"fit on coordinate {name!r}"
+                )
+        for name, value in host_fit.metrics.items():
+            if abs(value - result.metrics[name]) > 1e-6:
+                raise AssertionError(
+                    f"spilled metrics diverged: {name} "
+                    f"{value} vs {result.metrics[name]}"
+                )
+        plan = ChunkPlan(data.num_examples, chunk_rows)
+        store = TileStore(td)
+        oracle_streamer = ChunkStreamer()
+        names = list(config.coordinates)
+        oracle_rows = {
+            name: score_model_chunks(
+                host_last[name], data, plan, oracle_streamer
+            )
+            for name in names
+        }
+        for k in range(plan.num_chunks):
+            arrays, _ = store.read(TILES, k)
+            lo, hi = plan.bounds(k)
+            want = np.stack([oracle_rows[name][lo:hi] for name in names])
+            if not np.array_equal(arrays["tile"], want):
+                raise AssertionError(
+                    f"published tile {k} differs from the host-resident "
+                    "recomputation (spill roundtrip not bit-exact)"
+                )
+        host_rate = iters * data.num_examples / streamed_wall
+        spill_rate = iters * data.num_examples / spill_wall
+        if spill_rate < 0.5 * host_rate:
+            raise AssertionError(
+                f"spilled rate {spill_rate:.1f} rows/s fell below 0.5x the "
+                f"host-resident streamed rate {host_rate:.1f} rows/s"
+            )
+        _emit("game_ooc_disk_rows_per_sec", spill_rate, "rows/s", {
+            "rows": data.num_examples,
+            "chunk_rows": chunk_rows,
+            "max_host_mb": round(max_host_mb, 3),
+            "host_set_mb": round(host_set / (1 << 20), 3),
+            "spilled_fit_seconds": round(spill_wall, 4),
+            "host_resident_rows_per_sec": round(host_rate, 1),
+            "spill_overhead_x": round(spill_wall / streamed_wall, 3),
+            "disk_stall_s": round(disk_stall, 4),
+            "h2d_stall_s": round(h2d_stall, 4),
+            "disk_overlap_s": round(disk_overlap, 4),
+            # Per-tier stall fractions of WALL: disk stalls land on h2d
+            # worker threads (overlapping consumer compute), so wall is
+            # the only denominator that cannot double-count.
+            "disk_stall_fraction_of_wall": round(
+                disk_stall / spill_wall, 4
+            ),
+            "h2d_stall_fraction_of_wall": round(
+                h2d_stall / spill_wall, 4
+            ),
+            "cache_evictions": int(evictions),
+            "host_cache_bytes": int(cache_bytes),
+            "disk_bytes": int(disk_bytes),
+            "tiles_vs_host_resident": "bit-identical",
+            "platform": platform,
+        })
 
 
 def _bench_descent() -> None:
@@ -1640,6 +1783,11 @@ def main() -> None:
             "serving": _bench_serving,
             "ooc": _bench_ooc,
         }
+        if mode == "ooc" and "--spill" in sys.argv[3:]:
+            # ``--mode ooc --spill``: add the disk-tier leg (ISSUE 11) —
+            # forced-eviction spilled fit, in-bench parity assertions,
+            # game_ooc_disk_rows_per_sec.
+            modes["ooc"] = lambda: _bench_ooc(spill=True)
         if mode not in modes:
             # An unknown mode must not silently fall through to the full
             # (minutes-long) default run; the raise reaches the top-level
@@ -1688,7 +1836,11 @@ def main() -> None:
                           ("game_validation", _bench_validation),
                           ("game_recovery", _bench_recovery),
                           ("game_serving", _bench_serving),
-                          ("game_ooc", _bench_ooc),
+                          # spill=True: game_ooc_disk_rows_per_sec + the
+                          # per-tier stall fractions ride the default run
+                          # (ISSUE 11).
+                          ("game_ooc",
+                           _functools.partial(_bench_ooc, spill=True)),
                           ("game_entities",
                            _functools.partial(_bench_entities, 100_000))):
             elapsed = time.perf_counter() - t_start

@@ -103,6 +103,24 @@ def build_parser() -> argparse.ArgumentParser:
                    "resident-fit estimate exceeds it, streaming "
                    "auto-enables with a chunk size whose in-flight window "
                    "fits the budget (explicit --stream-chunks wins)")
+    p.add_argument("--max-host-mb", type=float, default=None,
+                   help="host-RAM budget in MB for the streamed tier "
+                   "(mirrors --max-resident-mb one tier up): when the "
+                   "streamed fit's host working set — feature chunks + "
+                   "score tiles — exceeds it, the disk-backed tile store "
+                   "auto-enables (spilling to --spill-dir) with an LRU "
+                   "host cache bounded by this budget, and streaming "
+                   "itself auto-enables if no device budget already did. "
+                   "NOTE: the ingestion path still materializes the "
+                   "dataset once to build the store (ROADMAP tiering "
+                   "edge (a)); the budget bounds the fit's STEADY-STATE "
+                   "working set, not the initial load")
+    p.add_argument("--spill-dir", default=None,
+                   help="directory for the disk-backed tile store "
+                   "(per-chunk feature blocks + score tiles).  Setting it "
+                   "forces spilling; otherwise --max-host-mb derives "
+                   "<output-dir>/tile_store when the host budget is "
+                   "exceeded.  Requires streamed mode")
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"),
                    help="storage dtype for FEATURE VALUES in every shard "
@@ -536,6 +554,52 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
                 "streaming enabled with %d-row chunks",
                 estimate / (1 << 20), args.max_resident_mb, stream_rows,
             )
+    if args.max_host_mb is not None and args.max_host_mb <= 0:
+        raise ValueError(
+            f"--max-host-mb must be > 0, got {args.max_host_mb}"
+        )
+    spill_dir = args.spill_dir
+    if args.max_host_mb is not None:
+        # ISSUE 11 satellite: the auto-enable gate used to size against
+        # device memory only — fold the HOST estimate in, so a dataset
+        # past host RAM auto-enables streaming AND spilling instead of
+        # OOM-ing the host tier.
+        from photon_tpu.game.tiles import (
+            chunk_rows_for_budget,
+            stream_host_bytes_estimate,
+        )
+
+        host_estimate = stream_host_bytes_estimate(
+            data, n_coordinates=len(specs)
+        )
+        host_budget = int(args.max_host_mb * (1 << 20))
+        session.gauge("stream.host_estimate_bytes").set(host_estimate)
+        if host_estimate > host_budget:
+            if stream_rows is None:
+                # Past host RAM with no device pressure configured:
+                # stream anyway (the resident path would pin even more),
+                # chunked so the in-flight window fits the host budget.
+                stream_rows = chunk_rows_for_budget(data, args.max_host_mb)
+                logger.info(
+                    "host estimate %.1f MB exceeds --max-host-mb %.1f: "
+                    "streaming enabled with %d-row chunks",
+                    host_estimate / (1 << 20), args.max_host_mb,
+                    stream_rows,
+                )
+            if spill_dir is None:
+                spill_dir = os.path.join(args.output_dir, "tile_store")
+            logger.info(
+                "host estimate %.1f MB exceeds --max-host-mb %.1f: "
+                "disk-backed tile store enabled at %s",
+                host_estimate / (1 << 20), args.max_host_mb, spill_dir,
+            )
+    if spill_dir is not None and not stream_rows:
+        raise ValueError(
+            "--spill-dir requires streamed mode (--stream-chunks or a "
+            "--max-resident-mb/--max-host-mb budget the dataset exceeds)"
+        )
+    if spill_dir is not None:
+        session.gauge("stream.spilled").set(1)
     if stream_rows:
         import jax as _jax_stream
 
@@ -574,6 +638,8 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         residual_mode=None if stream_rows else args.residuals,
         validation_mode=None if stream_rows else args.validation_pipeline,
         stream_chunks=stream_rows,
+        spill_dir=spill_dir,
+        max_host_mb=args.max_host_mb if spill_dir is not None else None,
     )
 
     import jax as _jax

@@ -100,6 +100,11 @@ KNOWN_FAULT_SITES = {
                  "(quarantine path)",
     "preempt": "set the preemption flag at a loop iteration boundary "
                "(checkpoint-and-exit path, exit code 75)",
+    "tile:read": "transient IOError reading a tile-store part file "
+                 "(disk tier of out-of-core GAME; retriable)",
+    "tile:write": "transient IOError inside a tile-store publish "
+                  "(before the atomic rename; retriable — the previous "
+                  "part file stays intact)",
 }
 
 

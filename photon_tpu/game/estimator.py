@@ -73,6 +73,8 @@ class GameEstimator:
         residual_mode: Optional[str] = None,
         validation_mode: Optional[str] = None,
         stream_chunks: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+        max_host_mb: Optional[float] = None,
     ):
         """``normalization`` is keyed by feature-shard name and applies to
         fixed-effect coordinates on that shard (the reference normalizes the
@@ -87,7 +89,18 @@ class GameEstimator:
         chunks / score tiles, streamed through a double-buffered h2d
         prefetch — device residency is bounded by the chunk window instead
         of the dataset size.  Streamed mode is single-controller (no mesh)
-        and replaces the residual/validation mode machinery."""
+        and replaces the residual/validation mode machinery.
+
+        ``spill_dir`` (requires ``stream_chunks``) adds the DISK tier
+        behind the stream (:mod:`photon_tpu.game.tile_store`): feature
+        chunks and residual score tiles live in per-chunk part files, an
+        LRU host cache bounded by ``max_host_mb`` (MB; ``None`` =
+        unbounded cache, still disk-backed) serves them, and the prefetch
+        pipeline becomes disk→host→device — the score plane and the
+        fixed-effect feature stream are bounded by the cache budget
+        instead of the dataset.  (The caller-provided ``training_data``
+        itself and the random-effect bin layouts are still host-resident
+        — the ROADMAP tiering item's remaining edges.)"""
         self.task_type = task_type
         self.training_data = training_data
         self.validation_data = validation_data
@@ -129,6 +142,24 @@ class GameEstimator:
                     f"{residual_mode!r}/{validation_mode!r})"
                 )
             self.stream_chunks = int(stream_chunks)
+        self.spill_dir = spill_dir
+        self.max_host_mb = max_host_mb
+        if spill_dir is not None and not self.stream_chunks:
+            raise ValueError(
+                "spill_dir (the disk-backed tile store) requires "
+                "stream_chunks — the disk tier spills the STREAMED fit's "
+                "host working set"
+            )
+        if max_host_mb is not None:
+            if max_host_mb <= 0:
+                raise ValueError(
+                    f"max_host_mb must be > 0, got {max_host_mb}"
+                )
+            if spill_dir is None:
+                raise ValueError(
+                    "max_host_mb bounds the spill host cache; set "
+                    "spill_dir (or let the driver derive one)"
+                )
         # Device-resident data shared across sweep configurations: building
         # the bucketed random-effect datasets (the reference's shuffle) and
         # uploading feature blocks happens once per distinct data config.
@@ -137,6 +168,7 @@ class GameEstimator:
         # streamer (overlap/stall telemetry accumulates across the sweep).
         self._stream_data_cache: Dict[tuple, object] = {}
         self._streamer = None
+        self._spill = None
         # Validation scoring cache shared across the whole sweep: one upload
         # of the validation feature shards for ALL configurations.
         self._validation_cache = None
@@ -226,6 +258,44 @@ class GameEstimator:
             self._streamer = ChunkStreamer(self.telemetry)
         return self._streamer
 
+    def _spill_context(self):
+        """The disk tier of a spilled streamed fit, built ONCE per
+        estimator: the part-file store, the ``max_host_mb``-bounded LRU
+        host cache, and the chunk feature source reading through them.
+        Building it spills the training dataset's feature chunks (skipped
+        when a previous run over the same dataset+plan already published
+        them — mid-epoch resume reuses the store)."""
+        if self.spill_dir is None:
+            return None
+        if self._spill is None:
+            from photon_tpu.game.tile_store import TileStore
+            from photon_tpu.game.tiles import (
+                HostTileCache,
+                SpillContext,
+                SpilledChunkSource,
+                spill_dataset,
+            )
+
+            store = TileStore(self.spill_dir, telemetry=self.telemetry)
+            cache = HostTileCache(
+                max_bytes=(
+                    None if self.max_host_mb is None
+                    else int(self.max_host_mb * (1 << 20))
+                ),
+                telemetry=self.telemetry,
+            )
+            plan = self._stream_plan()
+            spill_dataset(
+                store, self.training_data, plan, telemetry=self.telemetry
+            )
+            self._spill = SpillContext(
+                store=store, cache=cache,
+                source=SpilledChunkSource(
+                    store, plan, cache, telemetry=self.telemetry,
+                ),
+            )
+        return self._spill
+
     def _build_stream_coordinates(self, config: GameOptimizationConfiguration):
         """Streamed counterparts of :meth:`_build_coordinates`: no device
         data is uploaded at build time — fixed coordinates stream row
@@ -243,12 +313,15 @@ class GameEstimator:
         )
 
         plan, streamer = self._stream_plan(), self._stream_streamer()
+        spill = self._spill_context()
+        source = spill.source if spill is not None else None
         coords = {}
         for name, cc in config.coordinates.items():
             if isinstance(cc, FixedEffectCoordinateConfig):
                 coords[name] = StreamedFixedEffectCoordinate(
                     self.training_data, cc, self.task_type, plan, streamer,
                     normalization=self.normalization.get(cc.shard_name),
+                    source=source,
                 )
             elif isinstance(cc, FactoredRandomEffectCoordinateConfig):
                 raise ValueError(
@@ -265,6 +338,7 @@ class GameEstimator:
                 coords[name] = StreamedRandomEffectCoordinate(
                     self.training_data, cc, self.task_type, plan, streamer,
                     host_data=self._stream_data_cache[key],
+                    source=source,
                 )
             else:
                 raise TypeError(f"unknown coordinate config {type(cc)!r}")
@@ -314,8 +388,10 @@ class GameEstimator:
                     del self._device_data_cache[key]
         # Streamed host layouts have no incremental-onboard path (they are
         # cheap host structures): drop them for a lazy rebuild at the
-        # grown row count.
+        # grown row count.  The spill context follows — the grown dataset
+        # re-spills under its new fingerprint on the next fit.
         self._stream_data_cache.clear()
+        self._spill = None
         self.training_data = data
 
     def fit(
@@ -486,6 +562,7 @@ class GameEstimator:
                         streamer=self._stream_streamer(),
                         logger=self.logger,
                         telemetry=self.telemetry,
+                        spill=self._spill_context(),
                     )
                 else:
                     loop = CoordinateDescent(

@@ -29,6 +29,12 @@ data itself moves host<->device per chunk by design — that is the
 out-of-core tier working as intended, and it is all bulk streaming
 transfer, never a blocking scalar sync inside a chunk.
 
+With a :class:`~photon_tpu.game.tiles.SpillContext` attached (ISSUE 11),
+the residual tiles and feature chunks live one tier lower — disk part
+files behind the LRU host cache — and the loop's shape is unchanged: the
+chunk loads read disk→host→device, and every residual update writes the
+dirty tiles back through the store (write-through, atomic per chunk).
+
 Mid-epoch restartability: after EVERY coordinate the full restart state —
 models, residual tiles, the **chunk cursor** (how far into the epoch's
 update sequence the run got) and per-chunk **score-tile digests** — is
@@ -77,6 +83,9 @@ from photon_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
 from photon_tpu.game.tiles import (
     ChunkPlan,
     ChunkStreamer,
+    NeumaierAccumulator,
+    SpilledResidualTable,
+    SpillContext,
     TiledResidualTable,
     TiledValidationTable,
     cached_entity_index,
@@ -151,6 +160,7 @@ class StreamedFixedEffectCoordinate:
         plan: ChunkPlan,
         streamer: ChunkStreamer,
         normalization=None,
+        source=None,
     ):
         from photon_tpu.core.objective import GlmObjective
 
@@ -181,27 +191,41 @@ class StreamedFixedEffectCoordinate:
         shard = data.shard(config.shard_name)
         self.dim = shard.dim
         self._dense = isinstance(shard, DenseShard)
+        self.source = source  # None = host-resident slices (PR 10)
         self.objective = GlmObjective.create(
             task_type, config.problem.regularization
         )
 
     def _chunk_batch(self, k: int, offsets: list):
-        """Worker-side chunk load: host slice + device placement of chunk
-        ``k``'s feature rows, labels, weights, and this coordinate's tiled
-        training offsets."""
+        """Worker-side chunk load: chunk ``k``'s feature rows, labels and
+        weights (host slices, or the spilled disk tier through the host
+        cache when a ``source`` is attached) + this coordinate's tiled
+        training offsets, placed on device."""
         import jax.numpy as jnp
 
         from photon_tpu.data.batch import DenseBatch, SparseBatch
 
-        lo, hi = self.plan.bounds(k)
-        shard = self.data.shard(self.config.shard_name)
-        label = jnp.asarray(self.data.label[lo:hi])
-        weight = jnp.asarray(self.data.weight[lo:hi])
+        if self.source is not None:
+            sub = self.source.chunk(k)
+            shard = sub.shard(self.config.shard_name)
+            label_np, weight_np = sub.label, sub.weight
+            feats = shard.x if self._dense else (shard.ids, shard.vals)
+        else:
+            lo, hi = self.plan.bounds(k)
+            shard = self.data.shard(self.config.shard_name)
+            label_np = self.data.label[lo:hi]
+            weight_np = self.data.weight[lo:hi]
+            feats = (
+                shard.x[lo:hi] if self._dense
+                else (shard.ids[lo:hi], shard.vals[lo:hi])
+            )
+        label = jnp.asarray(label_np)
+        weight = jnp.asarray(weight_np)
         off = jnp.asarray(offsets[k])
         if self._dense:
-            return DenseBatch(jnp.asarray(shard.x[lo:hi]), label, off, weight)
+            return DenseBatch(jnp.asarray(feats), label, off, weight)
         return SparseBatch(
-            jnp.asarray(shard.ids[lo:hi]), jnp.asarray(shard.vals[lo:hi]),
+            jnp.asarray(feats[0]), jnp.asarray(feats[1]),
             label, off, weight,
         )
 
@@ -210,11 +234,13 @@ class StreamedFixedEffectCoordinate:
         (``_chunk_value_and_grad`` — the existing
         ``_fast_data_value_and_grad`` routing unchanged per chunk) computes
         each chunk's data value+grad on device, and the CROSS-CHUNK reduce
-        runs at float64 on host — the fixed-effect analog of the tiles'
-        Neumaier partials: the chunk partition becomes numerically
-        invisible (a 1-chunk and a 40-chunk pass agree to f32 rounding),
-        which is what keeps streamed-vs-resident parity inside the 1e-4
-        acceptance bar instead of drifting with the chunk count."""
+        is a Neumaier-COMPENSATED float64 accumulation on host (ISSUE 11
+        satellite) — the fixed-effect analog of the tiles' partials: the
+        cross-chunk accumulation error is independent of the chunk count
+        (a 1-chunk and a 1000-chunk pass reduce identically up to the
+        per-chunk f32 inputs themselves), which keeps streamed-vs-resident
+        parity at the two-solver f32 plateau floor instead of drifting
+        with the chunk count."""
         import jax.numpy as jnp
 
         from photon_tpu.data.streaming import _chunk_value_and_grad
@@ -222,25 +248,23 @@ class StreamedFixedEffectCoordinate:
         data_obj = dataclasses.replace(
             self.objective, l2_weight=0.0, l1_weight=0.0
         )
-        total_v = 0.0
-        total_g = np.zeros(self.dim, np.float64)
+        acc = NeumaierAccumulator(self.dim)
         for chunk in self.streamer.stream(
             lambda k: self._chunk_batch(k, offs), self.plan.num_chunks
         ):
             kernel = data_obj._sparse_kernel(chunk, self.dim)
             v, g = _chunk_value_and_grad(data_obj, kernel, w, chunk)
-            # host-sync: the cross-chunk reduce — each chunk's scalar value
-            # and [dim] gradient land on host and accumulate at f64 (bulk
-            # streaming transfer, dim-sized; part of the streamed design).
-            total_v += float(v)
-            # host-sync: same reduce, the gradient leg.
-            total_g += np.asarray(g, np.float64)
+            # host-sync: the cross-chunk reduce — each chunk's scalar
+            # value + [dim] gradient land on host and fold into the
+            # compensated f64 accumulator (bulk dim-sized transfer).
+            acc.add(float(v), np.asarray(g, np.float64))
+        total_v, total_g = acc.value, acc.grad
         l2 = self.objective.l2_weight
         if l2:
             # host-sync: dim-sized regularization terms of the f64 reduce.
             w_host = np.asarray(w, np.float64)
             total_v += 0.5 * l2 * float(w_host @ w_host)
-            total_g += l2 * w_host
+            total_g = total_g + l2 * w_host
         return (
             jnp.asarray(np.float32(total_v)),
             jnp.asarray(total_g.astype(np.float32)),
@@ -306,7 +330,9 @@ class StreamedFixedEffectCoordinate:
             # host-sync: foreign-shard warm starts score through the
             # model's own host path (no chunk layout for that shard here).
             return np.asarray(model.score(self.data), np.float32)
-        return score_model_chunks(model, self.data, self.plan, self.streamer)
+        return score_model_chunks(
+            model, self.data, self.plan, self.streamer, source=self.source
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -394,6 +420,7 @@ class StreamedRandomEffectCoordinate:
         plan: ChunkPlan,
         streamer: ChunkStreamer,
         host_data: Optional[StreamedRandomEffectHostData] = None,
+        source=None,
     ):
         from photon_tpu.core.objective import GlmObjective
         from photon_tpu.core.problem import GlmOptimizationProblem
@@ -416,6 +443,7 @@ class StreamedRandomEffectCoordinate:
         self.plan = plan
         self.streamer = streamer
         self.mesh = None
+        self.source = source  # spilled chunk features for re-scoring
         self.device_data = host_data or StreamedRandomEffectHostData(
             data, config
         )
@@ -580,7 +608,8 @@ class StreamedRandomEffectCoordinate:
                 np.asarray(model.keys),
             )
         return score_model_chunks(
-            model, self.data, self.plan, self.streamer, entity_idx=idx
+            model, self.data, self.plan, self.streamer, entity_idx=idx,
+            source=self.source,
         )
 
 
@@ -608,6 +637,7 @@ class StreamedCoordinateDescent:
         streamer: Optional[ChunkStreamer] = None,
         logger: Optional[PhotonLogger] = None,
         telemetry=None,
+        spill: Optional[SpillContext] = None,
     ):
         if not coordinates:
             raise ValueError(
@@ -624,6 +654,7 @@ class StreamedCoordinateDescent:
             training_data.num_examples, training_data.num_examples
         )
         self.streamer = streamer or ChunkStreamer(self.telemetry)
+        self.spill = spill
         self._val_idx_cache = entity_index_cache()
 
     # -- helpers -------------------------------------------------------------
@@ -703,6 +734,12 @@ class StreamedCoordinateDescent:
                 "cursor": int(cursor),
                 "seq": int(seq),
                 "tile_digests": residuals.tile_digests(),
+                # Informational: spilled snapshots carry EMPTY residual
+                # rows — the on-disk tiles are referenced by the digests
+                # above, not re-saved (resume re-adopts or rebuilds; the
+                # spill residency itself is deliberately NOT fingerprinted
+                # because spilled and host-resident tiles are bit-equal).
+                "spilled": self.spill is not None,
             },
         )
 
@@ -770,12 +807,24 @@ class StreamedCoordinateDescent:
         )
         models: Dict[str, object] = {}
         with telemetry.span(
-            "descent.residuals.init", mode=STREAM_RESIDUAL_MODE
+            "descent.residuals.init", mode=STREAM_RESIDUAL_MODE,
+            spilled=self.spill is not None,
         ):
-            residuals = TiledResidualTable(
-                self.training_data.offset, names=list(self.coordinates),
-                plan=self.plan, telemetry=telemetry,
-            )
+            if self.spill is not None:
+                residuals = SpilledResidualTable(
+                    self.training_data.offset, names=list(self.coordinates),
+                    plan=self.plan, store=self.spill.store,
+                    cache=self.spill.cache, telemetry=telemetry,
+                )
+                if resume_state is None:
+                    # A fresh fit must not read a previous run's published
+                    # tiles as its zero state.
+                    residuals.reset_store()
+            else:
+                residuals = TiledResidualTable(
+                    self.training_data.offset, names=list(self.coordinates),
+                    plan=self.plan, telemetry=telemetry,
+                )
         val_table = None
         if self.validation_data is not None and self.evaluators is not None:
             with telemetry.span("descent.validation.init"):
@@ -804,11 +853,47 @@ class StreamedCoordinateDescent:
                 "descent.resume", iteration=resume_state.iteration
             ):
                 models = dict(resume_state.models)
-                residuals.load_rows(resume_state.residual_rows)
                 stream_meta = resume_state.stream or {}
                 saved_digests = stream_meta.get("tile_digests")
+                rows = resume_state.residual_rows
+                if rows:
+                    residuals.load_rows(rows)
+                elif hasattr(residuals, "attach_resume"):
+                    # Spilled checkpoint: the tiles were REFERENCED, not
+                    # re-saved — adopt the on-disk part files (reads are
+                    # digest-verified; corruption is refused loudly).
+                    residuals.attach_resume()
                 if saved_digests is not None:
                     rebuilt = residuals.tile_digests()
+                    if rebuilt != list(saved_digests) and not rows:
+                        # Referenced tiles are stale (a kill tore the
+                        # update sequence mid-write-back, or the spill
+                        # residency changed between runs).  The tiles are
+                        # a pure function of the checkpointed models over
+                        # the fingerprinted data+plan: rebuild them
+                        # deterministically and re-verify.
+                        telemetry.counter("tiles.rebuilt").inc()
+                        self.logger.info(
+                            "on-disk tiles do not match the checkpoint; "
+                            "rebuilding from the checkpointed models"
+                        )
+                        if hasattr(residuals, "reset_store"):
+                            # Spilled table: dropping the part files IS
+                            # the zero state — no stale-tile reads, no
+                            # zero-tile publishes that the model rebuild
+                            # below would immediately overwrite.
+                            residuals.reset_store()
+                        else:
+                            residuals.clear()
+                        for name, coord_model in models.items():
+                            residuals.update(
+                                name,
+                                self.coordinates[name].score_stream(
+                                    coord_model
+                                ),
+                            )
+                        residuals.drain_guard_flags()  # checkpointed = guarded
+                        rebuilt = residuals.tile_digests()
                     if rebuilt != list(saved_digests):
                         raise CheckpointError(
                             "score-tile digests do not match the "

@@ -1,0 +1,448 @@
+"""Disk-backed tile store: the third tier of the out-of-core hierarchy.
+
+PR 10's out-of-core descent (:mod:`photon_tpu.game.tiles`) bounds DEVICE
+memory but still pins every score tile and feature chunk in host RAM — one
+tier short of the full memory hierarchy.  This module adds the disk tier
+(Snap ML's argument, arXiv:1803.06333: the headline speed of out-of-core
+GLM training comes from pipelining data across *all* tiers so the slowest
+link is always overlapped): per-chunk **part files** hold a chunk's feature
+block and its ``[C, rows_k]`` score tile + Neumaier partials, an LRU host
+cache (:class:`photon_tpu.game.tiles.HostTileCache`) bounds the host-RAM
+working set to ``--max-host-mb``, and the prefetch pipeline becomes
+disk→host→device.
+
+Part-file format (one self-describing container per chunk per role —
+``feat-NNNNNN.pt`` is the immutable feature block written once at spill
+time, ``tile-NNNNNN.pt`` the score tile + partials republished on every
+dirty write-back; splitting the roles keeps a tile update from rewriting
+the much larger feature payload):
+
+    8 bytes   magic ``PHTILE01``
+    8 bytes   header length (uint64 LE)
+    header    JSON: per-array name/dtype/shape/encoding/offset +
+              sha256 of the RAW (decoded) bytes, plus caller meta
+    payload   concatenated encoded array bytes
+
+Durability follows the PR 4 checkpoint contract: writes build a temp file
+in the store directory, fsync, then publish with ONE atomic rename — a
+kill at any instant leaves either the previous complete part file or the
+new one, never a torn hybrid.  Reads verify every array's sha256 digest
+after decode and refuse corruption loudly (:class:`CorruptTileError`,
+deliberately NOT an ``OSError`` so the retry layer does not burn its
+budget re-reading bit-rot).  All IO routes through
+:func:`photon_tpu.fault.retry.retry_call` (sites ``tile:read`` /
+``tile:write``): transient failures back off and retry, every attempt
+heartbeats the run watchdog, and a configured ``--stall-timeout`` bounds
+each attempt — the retry/timeout/backoff triangle covers the disk edge.
+
+Optional compression (``PHOTON_TILE_COMPRESS=1``) trades CPU for disk
+bandwidth: multi-byte arrays are delta-coded at their item width
+(wraparound integer subtraction — exactly invertible), byte-shuffled so
+high-order bytes group into runs, and zlib-deflated; an encoding that
+fails to shrink falls back to raw per array.  Either way the roundtrip is
+bit-exact — spilled and host-resident streamed runs produce identical
+tiles, which the tests pin with ``np.array_equal``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from photon_tpu.telemetry import NULL_SESSION
+
+MAGIC = b"PHTILE01"
+COMPRESS_VAR = "PHOTON_TILE_COMPRESS"
+
+# Store roles: one immutable feature block + one mutable score tile per
+# chunk (see module docstring for why they are separate part files).
+FEATURES = "feat"
+TILES = "tile"
+
+
+class CorruptTileError(RuntimeError):
+    """A part file failed digest verification (or is structurally torn).
+
+    NOT an ``OSError``: retrying a read cannot heal bit-rot, so the retry
+    layer must surface this immediately instead of spending its budget."""
+
+
+def _dtype_token(dtype: np.dtype) -> str:
+    """Serializable dtype identity.  ``dtype.str`` alone loses extension
+    dtypes — ml_dtypes.bfloat16 stringifies as the opaque void ``'<V2'``
+    (and ``np.dtype('<V2')`` round-trips to a JAX-rejected void array) —
+    so extension dtypes are stored by NAME and resolved through
+    ml_dtypes at read."""
+    s = np.dtype(dtype).str
+    if s.endswith(("V2", "V1")) or s.startswith(("|V", "<V", ">V")):
+        return f"name:{np.dtype(dtype).name}"
+    return s
+
+
+def _resolve_dtype(token: str) -> np.dtype:
+    if token.startswith("name:"):
+        name = token[5:]
+        try:
+            return np.dtype(name)
+        except TypeError:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+    return np.dtype(token)
+
+
+def compress_enabled(override: Optional[bool] = None) -> bool:
+    """Resolve the ``PHOTON_TILE_COMPRESS`` gate (default off: score tiles
+    and feature chunks are usually incompressible-ish f32 noise on CPU
+    fixtures; real column streams with locality are where the CPU-for-
+    bandwidth trade wins)."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get(COMPRESS_VAR, "").strip().lower() in (
+        "1", "on", "true", "shuffle", "delta",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Array codec: raw | dsz (delta + byte-shuffle + zlib), bit-exact roundtrip
+# ---------------------------------------------------------------------------
+
+
+def _encode(arr: np.ndarray, compress: bool) -> Tuple[bytes, str]:
+    raw = arr.tobytes()  # C-order flat item stream
+    if not compress or arr.size == 0:
+        return raw, "raw"
+    itemsize = arr.dtype.itemsize
+    if itemsize in (2, 4, 8):
+        flat = np.frombuffer(raw, dtype=np.dtype(f"<u{itemsize}"))
+        delta = np.empty_like(flat)
+        delta[0] = flat[0]
+        # Wraparound unsigned subtraction: exactly invertible by cumsum
+        # at the same width, no overflow UB.
+        np.subtract(flat[1:], flat[:-1], out=delta[1:])
+        shuffled = (
+            delta.view(np.uint8).reshape(-1, itemsize).T.copy().tobytes()
+        )
+        encoding = "dsz"
+    else:
+        shuffled = raw
+        encoding = "z"
+    packed = zlib.compress(shuffled, 1)
+    if len(packed) >= len(raw):
+        return raw, "raw"  # incompressible: raw is strictly better
+    return packed, encoding
+
+
+def _decode(
+    buf: bytes, dtype: np.dtype, shape: tuple, encoding: str
+) -> np.ndarray:
+    if encoding == "raw":
+        # frombuffer is read-only; copy so cached arrays are writable
+        # (score tiles are mutated in place by row updates).
+        return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+    raw = zlib.decompress(buf)
+    if encoding == "z":
+        return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+    if encoding != "dsz":
+        raise CorruptTileError(f"unknown array encoding {encoding!r}")
+    itemsize = np.dtype(dtype).itemsize
+    width = np.dtype(f"<u{itemsize}")
+    shuffled = np.frombuffer(raw, dtype=np.uint8)
+    delta = np.ascontiguousarray(
+        shuffled.reshape(itemsize, -1).T
+    ).view(width)
+    flat = np.cumsum(delta, dtype=width)  # wraparound inverse of the delta
+    return flat.view(np.uint8).view(dtype).reshape(shape).copy()
+
+
+# ---------------------------------------------------------------------------
+# Part-file container
+# ---------------------------------------------------------------------------
+
+
+def _pack(
+    arrays: Dict[str, np.ndarray],
+    meta: dict,
+    compress: bool,
+    digests: Optional[Dict[str, str]] = None,
+) -> bytes:
+    """``digests`` lets a caller that already hashed an array's raw bytes
+    (sha256 of ``arr.tobytes()``) pass the hex digest in instead of
+    paying a second tile-sized hash here — the write-through publish path
+    hashes every tile for its checkpoint digest anyway."""
+    entries = []
+    payloads = []
+    offset = 0
+    digests = digests or {}
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        buf, encoding = _encode(arr, compress)
+        entries.append({
+            "name": name,
+            "dtype": _dtype_token(arr.dtype),
+            "shape": list(arr.shape),
+            "encoding": encoding,
+            "offset": offset,
+            "nbytes": len(buf),
+            "sha256": (
+                digests.get(name)
+                or hashlib.sha256(arr.tobytes()).hexdigest()
+            ),
+        })
+        payloads.append(buf)
+        offset += len(buf)
+    header = json.dumps(
+        {"version": 1, "arrays": entries, "meta": meta or {}}
+    ).encode()
+    return b"".join(
+        [MAGIC, struct.pack("<Q", len(header)), header, *payloads]
+    )
+
+
+def _read_header(f) -> dict:
+    magic = f.read(len(MAGIC))
+    if magic != MAGIC:
+        raise CorruptTileError(
+            f"bad part-file magic {magic!r} (torn or foreign file)"
+        )
+    raw_len = f.read(8)
+    if len(raw_len) != 8:
+        raise CorruptTileError(
+            "part file truncated inside the header length field"
+        )
+    (hlen,) = struct.unpack("<Q", raw_len)
+    try:
+        return json.loads(f.read(hlen).decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CorruptTileError(f"unreadable part-file header: {e}") from None
+
+
+def _unpack(
+    path: str, verify: bool = True, names=None
+) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Decode a part file (optionally only the arrays in ``names`` — the
+    header carries per-array offsets, so a selective read never touches
+    the skipped payloads' bytes)."""
+    with open(path, "rb") as f:
+        header = _read_header(f)
+        base = f.tell()
+        arrays: Dict[str, np.ndarray] = {}
+        for entry in header["arrays"]:
+            if names is not None and entry["name"] not in names:
+                continue
+            f.seek(base + entry["offset"])
+            buf = f.read(entry["nbytes"])
+            if len(buf) != entry["nbytes"]:
+                raise CorruptTileError(
+                    f"{path}: truncated payload for {entry['name']!r}"
+                )
+            try:
+                arr = _decode(
+                    buf, _resolve_dtype(entry["dtype"]),
+                    tuple(entry["shape"]), entry["encoding"],
+                )
+            except (zlib.error, ValueError, TypeError) as e:
+                # A flipped bit in a compressed payload surfaces as
+                # zlib.error, a header/payload size disagreement as
+                # ValueError — corruption either way, same contract as a
+                # digest mismatch (NOT retriable).
+                raise CorruptTileError(
+                    f"{path}: undecodable payload for {entry['name']!r} "
+                    f"({e}); on-disk tile corrupted"
+                ) from None
+            if verify:
+                digest = hashlib.sha256(arr.tobytes()).hexdigest()
+                if digest != entry["sha256"]:
+                    raise CorruptTileError(
+                        f"{path}: content digest mismatch in "
+                        f"{entry['name']!r} (on-disk tile corrupted); "
+                        "refusing the read"
+                    )
+            arrays[entry["name"]] = arr
+    return arrays, header.get("meta", {})
+
+
+class TileStore:
+    """The disk tier: per-chunk part files under one directory, with
+    atomic publish, digest-verified reads, guarded/retried IO, and
+    ``tiles.disk_bytes`` accounting.
+
+    Thread safety: reads and writes of DISTINCT (kind, chunk) part files
+    may run concurrently (io-pool prefetch workers vs the write-back on
+    the descent thread); the byte accounting is lock-protected.  Two
+    concurrent writers of the SAME part file are last-publish-wins — the
+    streamed descent never does that (tile write-back is serial on the
+    descent thread).
+    """
+
+    def __init__(self, root: str, telemetry=None, compress: Optional[bool] = None):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.telemetry = telemetry or NULL_SESSION
+        self.compress = compress_enabled(compress)
+        self._lock = threading.Lock()
+        self._file_bytes: Dict[str, int] = {}
+        for name in os.listdir(self.root):
+            if name.endswith(".pt"):
+                try:
+                    self._file_bytes[name] = os.path.getsize(
+                        os.path.join(self.root, name)
+                    )
+                except OSError:
+                    continue
+        self._publish_bytes_gauge()
+
+    # -- paths / accounting ---------------------------------------------------
+    def path(self, kind: str, k: int) -> str:
+        return os.path.join(self.root, f"{kind}-{int(k):06d}.pt")
+
+    def has(self, kind: str, k: int) -> bool:
+        return os.path.isfile(self.path(kind, k))
+
+    @property
+    def disk_bytes(self) -> int:
+        with self._lock:
+            return sum(self._file_bytes.values())
+
+    def _note_file(self, name: str, nbytes: Optional[int]) -> None:
+        with self._lock:
+            if nbytes is None:
+                self._file_bytes.pop(name, None)
+            else:
+                self._file_bytes[name] = nbytes
+        self._publish_bytes_gauge()
+
+    def _publish_bytes_gauge(self) -> None:
+        self.telemetry.gauge("tiles.disk_bytes").set(self.disk_bytes)
+
+    # -- guarded IO -----------------------------------------------------------
+    def write(
+        self, kind: str, k: int, arrays: Dict[str, np.ndarray],
+        meta: Optional[dict] = None,
+        digests: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Publish one part file atomically (temp + fsync + rename).  The
+        whole attempt — serialize, write, publish — retries as a unit
+        under the ``tile:write`` site, so an injected/transient failure
+        anywhere in the sequence costs backoff, not the run.  ``digests``
+        forwards caller-precomputed raw-byte sha256 hexes to the header
+        (see :func:`_pack`)."""
+        from photon_tpu.fault.atomic import atomic_write_bytes
+        from photon_tpu.fault.injection import fault_point
+        from photon_tpu.fault.retry import retry_call
+
+        final = self.path(kind, k)
+        blob = _pack(arrays, meta, self.compress, digests=digests)
+
+        def attempt():
+            fault_point("tile:write", kind=kind, chunk=k)
+            # The PR 4 publication protocol verbatim (temp + fsync +
+            # rename + parent-dir fsync), so a completed tile publish
+            # survives power loss exactly like a checkpoint does.
+            atomic_write_bytes(final, blob)
+
+        retry_call(attempt, site="tile:write", telemetry=self.telemetry)
+        self.telemetry.counter("tiles.store_writes", kind=kind).inc()
+        self.telemetry.counter(
+            "tiles.store_write_bytes", kind=kind
+        ).inc(len(blob))
+        self._note_file(os.path.basename(final), len(blob))
+
+    def read(
+        self, kind: str, k: int, verify: bool = True, names=None
+    ) -> Tuple[Dict[str, np.ndarray], dict]:
+        """Load one part file's arrays + meta, digest-verified.  With
+        ``names``, decode only those arrays (the header's per-array
+        offsets make the skipped payloads free).  Transient failures
+        retry (``tile:read``); corruption raises
+        :class:`CorruptTileError` immediately."""
+        from photon_tpu.fault.injection import fault_point
+        from photon_tpu.fault.retry import retry_call
+
+        path = self.path(kind, k)
+
+        def attempt():
+            fault_point("tile:read", kind=kind, chunk=k)
+            return _unpack(path, verify=verify, names=names)
+
+        arrays, meta = retry_call(
+            attempt, site="tile:read", telemetry=self.telemetry
+        )
+        self.telemetry.counter("tiles.store_reads", kind=kind).inc()
+        return arrays, meta
+
+    def read_meta(self, kind: str, k: int) -> dict:
+        """Header-only read (no payload decode) — the cheap digest probe
+        the resume path uses to adopt on-disk tiles."""
+        from photon_tpu.fault.injection import fault_point
+        from photon_tpu.fault.retry import retry_call
+
+        path = self.path(kind, k)
+
+        def attempt():
+            fault_point("tile:read", kind=kind, chunk=k)
+            with open(path, "rb") as f:
+                return _read_header(f).get("meta", {})
+
+        return retry_call(attempt, site="tile:read", telemetry=self.telemetry)
+
+    def delete(self, kind: str, k: int) -> None:
+        path = self.path(kind, k)
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        self._note_file(os.path.basename(path), None)
+
+    def reset_tiles(self, num_chunks: int, kind: str = TILES) -> None:
+        """Drop every score-tile part file of ``kind`` (fresh, non-resume
+        runs must not read a previous run's tiles as their zero state)."""
+        for k in range(num_chunks):
+            self.delete(kind, k)
+
+    def reset_all(self) -> None:
+        """Drop EVERY part file + the dataset identity — the foreign/
+        stale-spill-dir reset (a different dataset or chunk plan may have
+        published under chunk ids the new plan never touches)."""
+        for name in list(os.listdir(self.root)):
+            if name.endswith(".pt"):
+                try:
+                    os.remove(os.path.join(self.root, name))
+                except OSError:
+                    pass
+                self._note_file(name, None)
+        try:
+            os.remove(self.dataset_meta_path())
+        except OSError:
+            pass
+
+    # -- dataset identity -----------------------------------------------------
+    _DATASET_META = "dataset.json"
+
+    def dataset_meta_path(self) -> str:
+        return os.path.join(self.root, self._DATASET_META)
+
+    def read_dataset_meta(self) -> Optional[dict]:
+        # Deliberately lenient: a missing/unreadable identity file simply
+        # means "not this dataset" and triggers a fresh spill.
+        try:
+            with open(self.dataset_meta_path()) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def write_dataset_meta(self, meta: dict) -> None:
+        from photon_tpu.fault.atomic import atomic_write_json
+        from photon_tpu.fault.retry import retry_call
+
+        retry_call(
+            lambda: atomic_write_json(self.dataset_meta_path(), meta),
+            site="tile:write", telemetry=self.telemetry,
+        )

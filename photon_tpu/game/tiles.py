@@ -27,6 +27,15 @@ The per-chunk map + cross-chunk reduce shape — every training pass is
 ``reduce(map(chunk))`` with the reduction inside jit per chunk — is the
 DrJAX MapReduce idiom (arXiv:2403.07128) expressed at the host loop level,
 which is where it must live once the mapped axis no longer fits on device.
+
+ISSUE 11 adds the THIRD tier: :mod:`photon_tpu.game.tile_store` part
+files behind an LRU :class:`HostTileCache` (``--max-host-mb``), a
+:class:`SpilledChunkSource` whose disk→host reads run one stage ahead of
+the h2d window, and :class:`SpilledScoreTable` tiles written through to
+disk — the full disk→host→device pipeline with per-tier
+``stream.stall_s{tier}`` / ``stream.prefetch_overlap_s{tier}``
+measurement, bounding the HOST working set the way PR 10 bounded device
+residency.
 """
 
 from __future__ import annotations
@@ -35,13 +44,24 @@ import dataclasses
 import hashlib
 import threading
 import time
-from collections import deque
-from concurrent.futures import ThreadPoolExecutor
+from collections import OrderedDict, deque
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from photon_tpu.game.tile_store import (
+    FEATURES as FEAT_KIND,
+    TILES as TILE_KIND,
+)
 from photon_tpu.telemetry import NULL_SESSION
+
+# The residual table's on-disk tile kind (part files named
+# ``tile-residuals-NNNNNN.pt``): the table's telemetry path rides the
+# FILE NAME so a second spilled table sharing the store can never
+# overwrite these — external readers (bench parity check, tests) import
+# this instead of assuming the bare ``tile`` kind.
+RESIDUAL_TILE_KIND = f"{TILE_KIND}-residuals"
 
 # Chunks the streamer keeps in flight beyond the one being consumed: chunk
 # k+1 uploads while chunk k computes (double buffering).  The device-memory
@@ -116,6 +136,16 @@ def resident_bytes_estimate(data, n_coordinates: int = 2) -> int:
     return 2 * per_row_bytes(data) * n + 2 * max(1, n_coordinates) * n * 4
 
 
+def stream_host_bytes_estimate(data, n_coordinates: int = 2) -> int:
+    """HOST bytes the streamed (out-of-core) fit pins without a disk tier:
+    the feature chunks (the dataset rows themselves) plus the ``[C, rows]``
+    float32 residual score tiles.  The quantity ``--max-host-mb`` budgets:
+    past it, the disk-backed tile store spills both and bounds the host
+    working set to the LRU cache instead (ISSUE 11)."""
+    n = data.num_examples
+    return per_row_bytes(data) * n + max(1, n_coordinates) * n * 4
+
+
 def chunk_rows_for_budget(data, max_resident_mb: float) -> int:
     """Chunk size such that the streamer's in-flight window —
     ``PREFETCH_DEPTH + 1`` chunks — fits the device budget."""
@@ -171,10 +201,12 @@ class ChunkStreamer:
     ``(prefetch + 1) × chunk_bytes``.
 
     Telemetry (shared across every pass this streamer drives):
-    ``stream.stall_s`` — consumer wall time blocked on an unready chunk;
-    ``stream.prefetch_overlap_s`` — load seconds hidden behind compute;
-    ``stream.chunks`` — chunks delivered; ``peak_in_flight_bytes`` — the
-    high-water in-flight device residency (exported by the descent as the
+    ``stream.stall_s{tier=h2d}`` — consumer wall time blocked on an
+    unready chunk; ``stream.prefetch_overlap_s{tier=h2d}`` — load seconds
+    hidden behind compute (the disk tier reports the same pair under
+    ``tier=disk`` from :class:`SpilledChunkSource`); ``stream.chunks`` —
+    chunks delivered; ``peak_in_flight_bytes`` — the high-water in-flight
+    device residency (exported by the descent as the
     ``residuals.device_bytes`` gauge, the chunk-budget assertion).
     """
 
@@ -213,8 +245,11 @@ class ChunkStreamer:
         from photon_tpu.utils.io_pool import io_threads
 
         tel = self.telemetry
-        stall_c = tel.counter("stream.stall_s")
-        overlap_c = tel.counter("stream.prefetch_overlap_s")
+        # Per-tier labels (ISSUE 11): this streamer IS the host→device
+        # stage; the disk→host stage (SpilledChunkSource) reports under
+        # tier="disk" on the same counter names.
+        stall_c = tel.counter("stream.stall_s", tier="h2d")
+        overlap_c = tel.counter("stream.prefetch_overlap_s", tier="h2d")
         chunks_c = tel.counter("stream.chunks")
 
         def timed_load(k: int):
@@ -269,6 +304,416 @@ class ChunkStreamer:
             # (their results are dropped with the futures).
             for f in futs:
                 f.cancel()
+
+
+# ---------------------------------------------------------------------------
+# Host tier: LRU cache over the disk-backed tile store (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def _entry_nbytes(value) -> int:
+    """Host bytes of a cached entry: arrays, or any dict/tuple/list nest
+    of them (a feature payload is the store's ``(arrays, meta)`` pair)."""
+    if isinstance(value, dict):
+        return sum(_entry_nbytes(v) for v in value.values())
+    if isinstance(value, (tuple, list)):
+        return sum(_entry_nbytes(v) for v in value)
+    return int(getattr(value, "nbytes", 0))
+
+
+@dataclasses.dataclass
+class _CacheEntry:
+    value: object
+    nbytes: int
+    load_s: float
+    consumed: bool = False
+
+
+class HostTileCache:
+    """Bounded LRU host cache keyed by ``(kind, chunk_id)`` between the
+    disk tier (:class:`photon_tpu.game.tile_store.TileStore`) and the
+    host→device streamer — the ``--max-host-mb`` budget, mirroring
+    ``--max-resident-mb`` one tier up.
+
+    Thread-safe with single-flight loads: concurrent misses of one key
+    (an io-pool disk prefetch racing the h2d worker) share ONE disk read.
+    Insertion evicts least-recently-used entries until the budget holds
+    (the incoming entry is kept even when it alone exceeds the budget —
+    the caller needs the data either way; the cache then simply holds
+    one oversized entry until the next insert).
+
+    Telemetry: ``tiles.cache_hits`` / ``tiles.cache_misses`` /
+    ``tiles.cache_evictions`` counters and the live
+    ``tiles.host_cache_bytes`` gauge (CI asserts it never exceeds the
+    budget after an eviction pass).
+    """
+
+    def __init__(self, max_bytes: Optional[int] = None, telemetry=None):
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError(f"max_bytes must be > 0, got {max_bytes}")
+        self.max_bytes = max_bytes
+        self.telemetry = telemetry or NULL_SESSION
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _CacheEntry]" = OrderedDict()
+        self._inflight: Dict[tuple, Future] = {}
+        self._bytes = 0
+        self._hits = self.telemetry.counter("tiles.cache_hits")
+        self._misses = self.telemetry.counter("tiles.cache_misses")
+        self._evictions = self.telemetry.counter("tiles.cache_evictions")
+        self._gauge = self.telemetry.gauge("tiles.host_cache_bytes")
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def _evict_locked(self) -> None:
+        # The entry just inserted sits at the MRU end, so the `> 1` bound
+        # both protects it and implements the oversized-entry allowance
+        # (a lone entry larger than the budget stays until the next
+        # insert displaces it).
+        while (
+            self.max_bytes is not None
+            and self._bytes > self.max_bytes
+            and len(self._entries) > 1
+        ):
+            _, entry = self._entries.popitem(last=False)
+            self._bytes -= entry.nbytes
+            self._evictions.inc()
+        self._gauge.set(self._bytes)
+
+    def _insert_locked(self, key: tuple, entry: _CacheEntry) -> None:
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old.nbytes
+        self._entries[key] = entry
+        self._bytes += entry.nbytes
+        self._evict_locked()
+
+    def put(self, key: tuple, value) -> None:
+        """Insert/replace (write-through warm path: the tile just written
+        to the store is the hottest possible entry)."""
+        with self._lock:
+            self._insert_locked(
+                key, _CacheEntry(value, _entry_nbytes(value), 0.0, True)
+            )
+
+    def invalidate(self, key: tuple) -> None:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry.nbytes
+            self._gauge.set(self._bytes)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self._gauge.set(0)
+
+    def _do_load(self, key, fut: Future, loader, consumed: bool):
+        """Single-flight load body: loads, inserts, resolves waiters.
+        ``consumed=False`` marks a prefetch — the first real consumer's
+        :meth:`get` then reports the hidden read time as overlap."""
+        try:
+            t0 = time.monotonic()
+            value = loader()
+            load_s = time.monotonic() - t0
+        except BaseException as e:
+            with self._lock:
+                self._inflight.pop(key, None)
+            fut.set_exception(e)
+            raise
+        with self._lock:
+            self._insert_locked(
+                key,
+                _CacheEntry(value, _entry_nbytes(value), load_s, consumed),
+            )
+            self._inflight.pop(key, None)
+        self._misses.inc()
+        fut.set_result(value)
+        return value, load_s
+
+    def get(self, key: tuple, loader: Callable[[], object]):
+        """``(value, hidden_load_s)``: the cached value (loading it via
+        ``loader`` on a miss), plus — on the FIRST consumption of an entry
+        a prefetch loaded — the disk-read seconds that consumption just
+        hid (disk-tier overlap).  Hot hits and own loads return 0.0."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self._hits.inc()
+                hidden = 0.0 if entry.consumed else entry.load_s
+                entry.consumed = True
+                return entry.value, hidden
+            fut = self._inflight.get(key)
+            if fut is None:
+                fut = Future()
+                self._inflight[key] = fut
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            # A prefetcher (or sibling worker) is mid-read: share its one
+            # disk read.  The wall time spent here is the caller's own
+            # stall measurement; mark the entry consumed so a LATER hit
+            # cannot re-report the read as hidden overlap.
+            value = fut.result()
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    entry.consumed = True
+            self._hits.inc()
+            return value, 0.0
+        value, _ = self._do_load(key, fut, loader, consumed=True)
+        return value, 0.0
+
+    def prefetch(self, key: tuple, loader: Callable[[], object]) -> None:
+        """Warm ``key`` in the background (io-pool worker) — the
+        disk→host stage that runs one step ahead of the h2d upload."""
+        from photon_tpu.utils.io_pool import submit
+
+        with self._lock:
+            if key in self._entries or key in self._inflight:
+                return
+
+        def warm():
+            with self._lock:
+                if key in self._entries or key in self._inflight:
+                    return
+                fut = Future()
+                self._inflight[key] = fut
+            try:
+                self._do_load(key, fut, loader, consumed=False)
+            except BaseException:
+                # Surfacing happens on the consumer's own (retried,
+                # guarded) read — a failed warm must not kill the pool.
+                pass
+
+        try:
+            submit(warm, pool="tile-prefetch")
+        except RuntimeError:
+            pass  # interpreter shutting down: prefetch is best-effort
+
+
+# ---------------------------------------------------------------------------
+# Chunk feature sources: resident host slices vs the spilled disk tier
+# ---------------------------------------------------------------------------
+
+
+class ResidentChunkSource:
+    """PR 10 behavior: chunk features are numpy VIEWS over the host-
+    resident dataset."""
+
+    tier = "host"
+
+    def __init__(self, data, plan: ChunkPlan):
+        self.data = data
+        self.plan = plan
+
+    def chunk(self, k: int):
+        lo, hi = self.plan.bounds(k)
+        return slice_rows(self.data, lo, hi)
+
+
+def _shard_schema(data) -> dict:
+    from photon_tpu.game.data import DenseShard
+
+    out = {}
+    for name, shard in data.shards.items():
+        if isinstance(shard, DenseShard):
+            out[name] = {"kind": "dense", "dtype": shard.x.dtype.str}
+        else:
+            out[name] = {"kind": "sparse", "dim": int(shard.dim_)}
+    return out
+
+
+def dataset_fingerprint(data, chunk_rows: int) -> dict:
+    """Cheap identity of (dataset, chunk plan) for spill-dir reuse: shape,
+    schema, and a content hash of the per-row scalar columns (one pass
+    over 12·n bytes — features are not re-hashed; a dataset that changes
+    features while keeping labels/weights/offsets bit-identical is out of
+    scope and documented)."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(data.label, np.float32).tobytes())
+    h.update(np.ascontiguousarray(data.weight, np.float32).tobytes())
+    h.update(np.ascontiguousarray(data.offset, np.float32).tobytes())
+    return {
+        "n": int(data.num_examples),
+        "chunk_rows": int(chunk_rows),
+        "shards": _shard_schema(data),
+        "scalar_sha256": h.hexdigest(),
+    }
+
+
+def spill_dataset(store, data, plan: ChunkPlan, telemetry=None) -> int:
+    """Write every chunk's feature block into the store (skipping chunks
+    already published by a previous run over the SAME dataset+plan — the
+    store's ``dataset.json`` pins that identity; any mismatch resets the
+    store).  Returns the number of chunks actually written."""
+    from photon_tpu.game.data import DenseShard
+
+    tel = telemetry or NULL_SESSION
+    fp = dataset_fingerprint(data, plan.chunk_rows)
+    if store.read_dataset_meta() != fp:
+        # Foreign/stale spill dir: drop everything, re-publish identity
+        # LAST (a kill mid-spill leaves no matching dataset.json, so the
+        # next run re-spills from scratch instead of trusting a torn set).
+        store.reset_all()
+    written = 0
+    with tel.span("tiles.spill", chunks=plan.num_chunks):
+        for k in range(plan.num_chunks):
+            if store.has(FEAT_KIND, k):
+                continue
+            lo, hi = plan.bounds(k)
+            arrays = {
+                "label": data.label[lo:hi],
+                "offset": data.offset[lo:hi],
+                "weight": data.weight[lo:hi],
+            }
+            for name, shard in data.shards.items():
+                if isinstance(shard, DenseShard):
+                    arrays[f"s:{name}:x"] = shard.x[lo:hi]
+                else:
+                    arrays[f"s:{name}:ids"] = shard.ids[lo:hi]
+                    arrays[f"s:{name}:vals"] = shard.vals[lo:hi]
+            store.write(
+                FEAT_KIND, k, arrays,
+                meta={"chunk": k, "rows": hi - lo,
+                      "shards": _shard_schema(data)},
+            )
+            written += 1
+    if store.read_dataset_meta() != fp:
+        store.write_dataset_meta(fp)
+    tel.counter("tiles.chunks_spilled").inc(written)
+    return written
+
+
+class SpilledChunkSource:
+    """Feature chunks served from the disk tier through the LRU host
+    cache, with disk→host prefetch scheduled ONE STAGE AHEAD of the h2d
+    window: when the streamer's worker loads chunk ``k`` (host→device),
+    this source warms chunks ``k+1 .. k+stage_ahead`` on io-pool workers,
+    so in steady state the disk read of a chunk completes while its
+    predecessors upload and compute.
+
+    Per-tier telemetry (same measured-overlap contract as the streamer):
+    ``stream.stall_s{tier=disk}`` — time an h2d load spent blocked on an
+    uncached disk read; ``stream.prefetch_overlap_s{tier=disk}`` — disk
+    read seconds hidden behind the pipeline (prefetched reads consumed
+    later).
+    """
+
+    tier = "disk"
+
+    def __init__(
+        self, store, plan: ChunkPlan, cache: HostTileCache, telemetry=None,
+        stage_ahead: int = PREFETCH_DEPTH + 1,
+    ):
+        self.store = store
+        self.plan = plan
+        self.cache = cache
+        self.telemetry = telemetry or NULL_SESSION
+        self.stage_ahead = max(1, int(stage_ahead))
+        self._stall_c = self.telemetry.counter("stream.stall_s", tier="disk")
+        self._overlap_c = self.telemetry.counter(
+            "stream.prefetch_overlap_s", tier="disk"
+        )
+
+    def _loader(self, k: int):
+        return lambda: self.store.read(FEAT_KIND, k)
+
+    def _rebuild(self, payload):
+        from photon_tpu.game.data import DenseShard, GameDataset, SparseShard
+
+        arrays, meta = payload
+        shards = {}
+        for name, schema in meta["shards"].items():
+            if schema["kind"] == "dense":
+                shards[name] = DenseShard(arrays[f"s:{name}:x"])
+            else:
+                shards[name] = SparseShard(
+                    arrays[f"s:{name}:ids"], arrays[f"s:{name}:vals"],
+                    schema["dim"],
+                )
+        return GameDataset(
+            label=arrays["label"], offset=arrays["offset"],
+            weight=arrays["weight"], shards=shards, id_columns={},
+        )
+
+    def chunk(self, k: int):
+        # Warm the successors first: the disk stage must run ahead even
+        # when THIS chunk is about to stall (first touch of the stream).
+        for j in range(k + 1, min(k + 1 + self.stage_ahead,
+                                  self.plan.num_chunks)):
+            self.cache.prefetch((FEAT_KIND, j), self._loader(j))
+        t0 = time.monotonic()
+        payload, hidden_s = self.cache.get((FEAT_KIND, k), self._loader(k))
+        wait = time.monotonic() - t0
+        self._stall_c.inc(wait)
+        self._overlap_c.inc(max(0.0, hidden_s - wait))
+        return self._rebuild(payload)
+
+
+@dataclasses.dataclass
+class SpillContext:
+    """The assembled disk tier of one spilled streamed fit: the part-file
+    store, the budgeted host cache, and the chunk feature source reading
+    through them — built once per estimator
+    (:meth:`photon_tpu.game.estimator.GameEstimator._spill_context`) and
+    threaded through the descent and every streamed coordinate."""
+
+    store: object
+    cache: HostTileCache
+    source: SpilledChunkSource
+
+
+# ---------------------------------------------------------------------------
+# Compensated cross-chunk accumulator (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+
+class NeumaierAccumulator:
+    """Neumaier-compensated float64 accumulator for the streamed L-BFGS
+    cross-chunk value+grad reduce: the per-chunk terms arrive as f32
+    device results, and the compensated f64 sum makes the cross-chunk
+    accumulation error independent of the chunk COUNT — a 1-chunk and a
+    1000-chunk pass reduce to the same f64 total up to the per-chunk f32
+    inputs themselves (the remaining streamed-vs-resident floor)."""
+
+    def __init__(self, dim: int):
+        self._v = 0.0
+        self._vc = 0.0
+        self._g = np.zeros(dim, np.float64)
+        self._gc = np.zeros(dim, np.float64)
+
+    def add(self, value: float, grad: np.ndarray) -> None:
+        v = float(value)
+        t = self._v + v
+        if abs(self._v) >= abs(v):
+            self._vc += (self._v - t) + v
+        else:
+            self._vc += (v - t) + self._v
+        self._v = t
+        # host-sync: per-chunk grads arrive as host numpy by construction
+        # (the streamed reduce's d2h is marked at its call site).
+        g = np.asarray(grad, np.float64)
+        t = self._g + g
+        self._gc += np.where(
+            np.abs(self._g) >= np.abs(g),
+            (self._g - t) + g,
+            (g - t) + self._g,
+        )
+        self._g = t
+
+    @property
+    def value(self) -> float:
+        return self._v + self._vc
+
+    @property
+    def grad(self) -> np.ndarray:
+        return self._g + self._gc
 
 
 # ---------------------------------------------------------------------------
@@ -343,11 +788,10 @@ class TiledScoreTable:
         # out-of-core tier keeps score state at host level, streaming only
         # the working chunk to device.
         self.base = np.asarray(base_offset, np.float32)
-        c = len(self.names)
-        self.tiles: List[np.ndarray] = [
-            np.zeros((c, plan.rows(k)), np.float32)
-            for k in range(plan.num_chunks)
-        ]
+        # The Neumaier partials stay host-RESIDENT in every mode (12
+        # bytes/row beside the base offset): every per-chunk read needs
+        # them, and they are two orders smaller than the tiles+features
+        # the ``--max-host-mb`` budget spills.
         self.totals: List[np.ndarray] = [
             np.zeros(plan.rows(k), np.float32) for k in range(plan.num_chunks)
         ]
@@ -355,7 +799,27 @@ class TiledScoreTable:
             np.zeros(plan.rows(k), np.float32) for k in range(plan.num_chunks)
         ]
         self._pending_guard: list = []
+        self._init_tiles()
         self.telemetry.gauge(f"{self._PATH}.tile_chunks").set(plan.num_chunks)
+
+    # -- tile residency hooks (overridden by the spilled subclass) ------------
+    def _init_tiles(self) -> None:
+        c = len(self.names)
+        self.tiles: List[np.ndarray] = [
+            np.zeros((c, self.plan.rows(k)), np.float32)
+            for k in range(self.plan.num_chunks)
+        ]
+
+    def tile(self, k: int) -> np.ndarray:
+        """Chunk ``k``'s ``[C, rows_k]`` score tile (host float32)."""
+        return self.tiles[k]
+
+    def _publish_tile(self, k: int, tile: np.ndarray) -> None:
+        """Land a mutated tile: refresh the chunk's compensated partials
+        (recomputed from the tile on every row update — never
+        incrementally drifted, same rule as the resident engine)."""
+        self.tiles[k] = tile
+        self.totals[k], self.comps[k] = _neumaier_rows_np(tile)
 
     @property
     def num_chunks(self) -> int:
@@ -383,10 +847,9 @@ class TiledScoreTable:
             c = self._row[name]
             for k in range(self.num_chunks):
                 lo, hi = self.plan.bounds(k)
-                self.tiles[k][c] = host[lo:hi]
-                self.totals[k], self.comps[k] = _neumaier_rows_np(
-                    self.tiles[k]
-                )
+                tile = self.tile(k)
+                tile[c] = host[lo:hi]
+                self._publish_tile(k, tile)
         self.telemetry.counter(f"{self._PATH}.updates", coordinate=name).inc()
 
     # -- per-chunk reads ------------------------------------------------------
@@ -397,7 +860,7 @@ class TiledScoreTable:
         lo, hi = self.plan.bounds(k)
         c = self._row[name]
         return self.base[lo:hi] + (
-            (self.totals[k] - self.tiles[k][c]) + self.comps[k]
+            (self.totals[k] - self.tile(k)[c]) + self.comps[k]
         )
 
     def offsets_full(self, name: str) -> np.ndarray:
@@ -423,7 +886,9 @@ class TiledScoreTable:
     def scores_for(self, name: str) -> np.ndarray:
         """Coordinate ``name``'s current score vector (host, ``[n]``)."""
         c = self._row[name]
-        return np.concatenate([tile[c] for tile in self.tiles])
+        return np.concatenate(
+            [self.tile(k)[c] for k in range(self.num_chunks)]
+        )
 
     # -- guard / snapshot contract (mirrors the engines) ----------------------
     def drain_guard_flags(self) -> list:
@@ -450,6 +915,7 @@ class TiledScoreTable:
         """Rebuild tiles from checkpointed rows (resume path).  Stored
         directly — checkpointed rows were guarded at write time, and
         routing them through update() would enqueue phantom guard flags."""
+        loaded = {}
         for name, row in rows.items():
             if name not in self._row:
                 continue
@@ -460,23 +926,34 @@ class TiledScoreTable:
                     f"checkpointed row for {name!r} has shape {host.shape}, "
                     f"want ({self.n},)"
                 )
-            c = self._row[name]
-            for k in range(self.num_chunks):
-                lo, hi = self.plan.bounds(k)
-                self.tiles[k][c] = host[lo:hi]
+            loaded[self._row[name]] = host
+        # Chunk-outer: ONE read-modify-write per tile (the spilled table
+        # publishes each tile once, not once per coordinate).
         for k in range(self.num_chunks):
-            self.totals[k], self.comps[k] = _neumaier_rows_np(self.tiles[k])
+            lo, hi = self.plan.bounds(k)
+            tile = self.tile(k)
+            for c, host in loaded.items():
+                tile[c] = host[lo:hi]
+            self._publish_tile(k, tile)
+
+    def clear(self) -> None:
+        """Zero every tile (the deterministic-rebuild reset of the spilled
+        resume path)."""
+        for k in range(self.num_chunks):
+            tile = self.tile(k)
+            tile[:] = 0.0
+            self._publish_tile(k, tile)
+
+    def tile_digest(self, k: int) -> str:
+        """Chunk ``k``'s tile content digest — sha256/16 of the raw tile
+        bytes, the PR 10 checkpoint digest contract."""
+        return hashlib.sha256(self.tile(k).tobytes()).hexdigest()[:16]
 
     def tile_digests(self) -> List[str]:
         """Per-chunk content digests of the score tiles (sha256/16): stamped
         into mid-epoch checkpoints so a resume can verify the rebuilt tiles
         match the interrupted run's state chunk for chunk."""
-        out = []
-        for k in range(self.num_chunks):
-            h = hashlib.sha256()
-            h.update(self.tiles[k].tobytes())
-            out.append(h.hexdigest()[:16])
-        return out
+        return [self.tile_digest(k) for k in range(self.num_chunks)]
 
 
 class TiledResidualTable(TiledScoreTable):
@@ -492,6 +969,172 @@ class TiledValidationTable(TiledScoreTable):
     _PATH = "validation"
 
 
+class SpilledScoreTable(TiledScoreTable):
+    """Score tiles resident at the DISK tier (ISSUE 11): every read goes
+    through the LRU host cache, every publish writes through to the
+    :class:`~photon_tpu.game.tile_store.TileStore` part file (atomic
+    rename — a torn write-back keeps the previous tile), so the host
+    working set of the score plane is the cache budget, not ``C × n``.
+
+    Numerics are IDENTICAL to the host-resident tiled table: the store
+    roundtrip is bit-exact and the partials are recomputed by the same
+    ``_neumaier_rows_np`` on the same tile bytes — spilled vs resident
+    streamed runs produce ``np.array_equal`` tiles (pinned by tests).
+
+    Checkpoint contract: :meth:`snapshot_rows` returns ``{}`` — the
+    on-disk tiles are REFERENCED by the checkpoint's per-chunk digests,
+    not re-saved into it; :meth:`attach_resume` adopts them at resume
+    (digest-verified at read — corruption is refused loudly), and the
+    descent rebuilds deterministically from the checkpointed models when
+    the referenced tiles are stale (e.g. a kill tore the update sequence
+    mid-write-back).
+    """
+
+    def __init__(
+        self,
+        base_offset: np.ndarray,
+        names: Sequence[str],
+        plan: ChunkPlan,
+        store,
+        cache: HostTileCache,
+        telemetry=None,
+    ):
+        self._store = store
+        self._cache = cache
+        super().__init__(base_offset, names, plan, telemetry=telemetry)
+        self.telemetry.gauge(f"{self._PATH}.tiles_spilled").set(1)
+
+    # -- residency hooks ------------------------------------------------------
+    def _init_tiles(self) -> None:
+        # No [C, rows_k] host allocation: a None digest marks the implicit
+        # all-zero tile (nothing published yet).
+        self._digests: List[Optional[str]] = [None] * self.plan.num_chunks
+
+    @property
+    def _tile_kind(self) -> str:
+        # The _PATH rides the part-file NAME, not just the cache key: two
+        # spilled tables sharing one store (e.g. a future spilled
+        # validation table) must not overwrite each other's tiles.
+        return f"{TILE_KIND}-{self._PATH}"
+
+    def _key(self, k: int) -> tuple:
+        return (TILE_KIND, self._PATH, k)
+
+    def _zero_tile(self, k: int) -> np.ndarray:
+        return np.zeros((len(self.names), self.plan.rows(k)), np.float32)
+
+    def tile(self, k: int) -> np.ndarray:
+        def load():
+            if not self._store.has(self._tile_kind, k):
+                return self._zero_tile(k)
+            arrays, _ = self._store.read(self._tile_kind, k)
+            return arrays["tile"]
+
+        tile, _ = self._cache.get(self._key(k), load)
+        return tile
+
+    def _publish_tile(self, k: int, tile: np.ndarray) -> None:
+        totals, comps = _neumaier_rows_np(tile)
+        self.totals[k], self.comps[k] = totals, comps
+        # One hash serves both contracts: the full sha256 goes to the
+        # part-file header (via ``digests=``, saving _pack re-hashing the
+        # tile bytes) and its 16-char prefix is the checkpoint digest.
+        full = hashlib.sha256(tile.tobytes()).hexdigest()
+        digest = full[:16]
+        # Write-through: the store is always current, so an LRU eviction
+        # never loses state and a kill at any instant leaves every chunk's
+        # PREVIOUS complete tile readable (atomic publish).
+        self._store.write(
+            self._tile_kind, k,
+            {"tile": tile, "total": totals, "comp": comps},
+            meta={"chunk": k, "path": self._PATH, "tile_digest": digest},
+            digests={"tile": full},
+        )
+        self._digests[k] = digest
+        self._cache.put(self._key(k), tile)
+
+    # -- digest / checkpoint contract ----------------------------------------
+    def tile_digest(self, k: int) -> str:
+        d = self._digests[k]
+        if d is None:
+            # The implicit zero tile: sha of all-zero f32 bytes.
+            nbytes = 4 * len(self.names) * self.plan.rows(k)
+            d = hashlib.sha256(b"\x00" * nbytes).hexdigest()[:16]
+            self._digests[k] = d
+        return d
+
+    def snapshot_rows(self) -> dict:
+        """Spilled checkpoints REFERENCE the on-disk tiles (via the
+        per-chunk digests in the stream payload) instead of re-saving the
+        rows — the d2h+npz cost of the score plane drops out of every
+        mid-epoch snapshot."""
+        return {}
+
+    def reset_store(self) -> None:
+        """Fresh (non-resume) runs must not read a previous run's
+        published tiles as their zero state."""
+        self._store.reset_tiles(self.num_chunks, kind=self._tile_kind)
+        for k in range(self.num_chunks):
+            self._cache.invalidate(self._key(k))
+        self._init_tiles()
+        for k in range(self.num_chunks):
+            self.totals[k][:] = 0.0
+            self.comps[k][:] = 0.0
+
+    def attach_resume(self) -> List[int]:
+        """Adopt the interrupted run's on-disk tiles: loads each published
+        tile's partials + digest (payload sha256-verified at read — a
+        corrupted tile raises :class:`~photon_tpu.game.tile_store.
+        CorruptTileError` and the resume is refused); returns the chunk
+        ids with NO published tile (implicit zero — the descent's digest
+        compare against the checkpoint decides whether that is the true
+        state or a stale store needing a model rebuild)."""
+        missing: List[int] = []
+        for k in range(self.num_chunks):
+            if not self._store.has(self._tile_kind, k):
+                self._digests[k] = None
+                self.totals[k][:] = 0.0
+                self.comps[k][:] = 0.0
+                missing.append(k)
+                continue
+            # Selective read: the partials are ~1/C the tile's size and
+            # the digest lives in the header — the dominant tile payload
+            # is neither decoded nor pushed through the budgeted LRU here
+            # (first training access loads it lazily).
+            arrays, meta = self._store.read(
+                self._tile_kind, k, names=("total", "comp")
+            )
+            digest = meta.get("tile_digest")
+            if digest is None:
+                # Foreign/legacy part file without the header digest:
+                # fall back to one full read.
+                full, _ = self._store.read(self._tile_kind, k)
+                digest = hashlib.sha256(
+                    full["tile"].tobytes()
+                ).hexdigest()[:16]
+                self._cache.put(self._key(k), full["tile"])
+            self.totals[k] = np.ascontiguousarray(
+                arrays["total"], np.float32
+            )
+            self.comps[k] = np.ascontiguousarray(arrays["comp"], np.float32)
+            self._digests[k] = digest
+        self.telemetry.counter(f"{self._PATH}.tiles_attached").inc(
+            self.num_chunks - len(missing)
+        )
+        return missing
+
+
+class SpilledResidualTable(SpilledScoreTable):
+    """Training-side spilled score table (the ``residuals`` telemetry
+    path, like :class:`TiledResidualTable`)."""
+
+
+# The exported constant and the table's own kind derivation
+# (``_tile_kind`` = f"{TILE_KIND}-{_PATH}") must agree: external readers
+# (bench parity check, tests) look part files up by RESIDUAL_TILE_KIND.
+assert RESIDUAL_TILE_KIND == f"{TILE_KIND}-{SpilledResidualTable._PATH}"
+
+
 # ---------------------------------------------------------------------------
 # Chunked model scoring (shared by training re-score and validation)
 # ---------------------------------------------------------------------------
@@ -503,20 +1146,22 @@ def score_model_chunks(
     plan: ChunkPlan,
     streamer: ChunkStreamer,
     entity_idx: Optional[np.ndarray] = None,
+    source=None,
 ) -> np.ndarray:
     """Score one coordinate model over ``data`` chunk by chunk: each chunk's
     features upload on the streamer's worker threads (prefetch overlapping
     the previous chunk's margin kernel + fetch), margins compute on device,
     and the per-chunk d2h fetches assemble the host ``[n]`` score vector the
     tiled tables consume.  ``entity_idx`` (random models) is the
-    pre-computed per-row entity index against the MODEL's vocabulary."""
+    pre-computed per-row entity index against the MODEL's vocabulary.
+    ``source`` overrides where chunk FEATURES come from (the spilled disk
+    tier); default is host slices of ``data``."""
     import jax.numpy as jnp
 
     from photon_tpu.game.data import DenseShard
     from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
 
-    shard = data.shard(model.shard_name)
-    dense = isinstance(shard, DenseShard)
+    dense = isinstance(data.shard(model.shard_name), DenseShard)
     is_random = isinstance(model, RandomEffectModel)
     if is_random and entity_idx is None:
         from photon_tpu.game.data import entity_index_for
@@ -528,13 +1173,15 @@ def score_model_chunks(
         )
     if not is_random and not isinstance(model, FixedEffectModel):
         raise TypeError(f"cannot chunk-score a {type(model).__name__}")
+    src = source or ResidentChunkSource(data, plan)
 
     def load(k: int):
         lo, hi = plan.bounds(k)
+        shard = src.chunk(k).shard(model.shard_name)
         if dense:
-            feats = jnp.asarray(shard.x[lo:hi])
+            feats = jnp.asarray(shard.x)
         else:
-            feats = (jnp.asarray(shard.ids[lo:hi]), jnp.asarray(shard.vals[lo:hi]))
+            feats = (jnp.asarray(shard.ids), jnp.asarray(shard.vals))
         if is_random:
             return feats, jnp.asarray(entity_idx[lo:hi].astype(np.int32))
         return feats, None

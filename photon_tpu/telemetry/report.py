@@ -153,6 +153,60 @@ def _render_pipeline_section(report: dict) -> list:
     return lines
 
 
+def _render_streaming_section(report: dict) -> list:
+    """The out-of-core stream's measured tier economics (``stream.*`` /
+    ``tiles.*``): per-tier stall vs hidden-overlap seconds for the
+    disk→host and host→device stages, plus the host-cache and disk-store
+    shape of a spilled run.  Empty when the run never streamed."""
+    metrics = report.get("metrics") or {}
+    counters = metrics.get("counters") or []
+    gauges = metrics.get("gauges") or []
+
+    def plain(name, coll):
+        for m in coll:
+            if m["name"] == name and not m.get("labels"):
+                return m["value"]
+        return None
+
+    def by_tier(name):
+        out = {}
+        for m in counters:
+            if m["name"] == name:
+                out[(m.get("labels") or {}).get("tier", "")] = m["value"]
+        return out
+
+    if plain("stream.chunks", counters) is None:
+        return []
+    lines = ["", "## Streaming tiers", "",
+             f"- **chunks delivered**: {_fmt(plain('stream.chunks', counters))}"]
+    stalls = by_tier("stream.stall_s")
+    overlaps = by_tier("stream.prefetch_overlap_s")
+    tiers = [t for t in ("disk", "h2d") if t in stalls or t in overlaps]
+    if tiers:
+        lines += ["", "| tier | stall (s) | overlap hidden (s) |",
+                  "|---|---|---|"]
+        for tier in tiers:
+            lines.append(
+                f"| {tier} | {_fmt(stalls.get(tier, 0.0))} "
+                f"| {_fmt(overlaps.get(tier, 0.0))} |"
+            )
+    cache = {
+        name: plain(name, counters)
+        for name in ("tiles.cache_hits", "tiles.cache_misses",
+                     "tiles.cache_evictions")
+        if plain(name, counters) is not None
+    }
+    for name in ("tiles.host_cache_bytes", "tiles.disk_bytes"):
+        value = plain(name, gauges)
+        if value is not None:
+            cache[name] = value
+    if cache:
+        lines.append("")
+        for name, value in cache.items():
+            lines.append(f"- **{name}**: {_fmt(value)}")
+    return lines
+
+
 def _render_entity_solves_section(report: dict) -> list:
     """The random-effect size-bin layout at a glance (``solves.*`` gauges):
     per (coordinate, bin) — routed solver, row capacity, live vs padded
@@ -286,6 +340,7 @@ def render_markdown(report: dict) -> str:
             lines.append(f"| {name} | {secs:.3f} |")
 
     lines += _render_pipeline_section(report)
+    lines += _render_streaming_section(report)
     lines += _render_entity_solves_section(report)
     lines += _render_serving_section(report)
 

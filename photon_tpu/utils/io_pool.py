@@ -46,25 +46,35 @@ def io_threads() -> int:
     return env_int("PHOTON_IO_THREADS", default, minimum=1)
 
 
-_submit_pool: Optional[ThreadPoolExecutor] = None
+_submit_pools: dict = {}
 _submit_lock = threading.Lock()
 
+# Named background pools: each distinct overlap workload gets its own
+# small bounded executor, so e.g. the disk→host tile prefetch of a spilled
+# streamed fit cannot starve the warm-start key-join prefetch (both are
+# "one short job beside device compute" patterns, but with very different
+# blocking profiles — key joins are CPU, tile prefetches are disk IO).
+_POOL_WORKERS = {"default": 2, "tile-prefetch": 2}
 
-def submit(fn: Callable[[], R]):
+
+def submit(fn: Callable[[], R], pool: str = "default"):
     """Fire one background call on a small shared io-pool executor and
     return its Future — the overlap primitive for host work that should run
     beside device compute (e.g. the foreign-vocabulary warm-start key join
-    prefetched while the fixed-effect coordinate trains).  The pool is
-    lazily created, bounded (2 threads — these are occasional scalar jobs,
-    not the bulk pipelines ``map_ordered`` serves), and process-lifetime;
-    submitted work must be short and must not block indefinitely."""
-    global _submit_pool
+    prefetched while the fixed-effect coordinate trains, or a spilled
+    chunk's disk→host read warmed one stage ahead of its h2d upload).
+    Pools are lazily created, bounded (2 threads each — these are
+    occasional short jobs, not the bulk pipelines ``map_ordered`` serves),
+    and process-lifetime; submitted work must be short and must not block
+    indefinitely."""
     with _submit_lock:
-        if _submit_pool is None:
-            _submit_pool = ThreadPoolExecutor(
-                max_workers=2, thread_name_prefix="photon-io-submit"
+        ex = _submit_pools.get(pool)
+        if ex is None:
+            ex = _submit_pools[pool] = ThreadPoolExecutor(
+                max_workers=_POOL_WORKERS.get(pool, 2),
+                thread_name_prefix=f"photon-io-submit-{pool}",
             )
-        return _submit_pool.submit(fn)
+        return ex.submit(fn)
 
 
 def map_ordered(

@@ -57,6 +57,16 @@ def test_serving_hot_path_is_guarded():
     assert "photon_tpu/serving/batcher.py" in guarded
 
 
+def test_tile_store_is_guarded():
+    """The disk tier of out-of-core GAME rides the default guard set
+    (ISSUE 11 satellite): the store is pure host IO by design — a device
+    fetch inside a part-file read/write would serialize the disk edge
+    against the device stream it exists to overlap."""
+    from check_host_sync import DEFAULT_FILES
+
+    assert "photon_tpu/game/tile_store.py" in set(DEFAULT_FILES)
+
+
 def test_checker_ignores_jnp_and_comments(tmp_path):
     f = tmp_path / "f.py"
     f.write_text(

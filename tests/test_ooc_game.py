@@ -243,10 +243,13 @@ def test_streamed_fit_matches_resident_both_modes(fits, game_data):
 
 
 def test_streamed_logistic_fit_tracks_resident(game_data):
-    """Logistic parity sits at the chunked-accumulation solver floor
-    (~2–5e-4 on this fixture — see ROADMAP 'Out-of-core GAME' edge (d));
-    pin it under a documented looser bound so a real regression (wrong
-    offsets, broken tiles) still fails loudly."""
+    """Logistic parity now sits at the TWO-SOLVER f32 plateau floor
+    (~4–6e-4 on this fixture): the ISSUE 11 Neumaier-compensated f64
+    cross-chunk value+grad accumulator removed the chunk-count drift the
+    ROADMAP flagged (the streamed fit is now identical across chunk
+    sizes), so the pin tightens 2e-3 → 1e-3; the remainder is the two
+    L-BFGS implementations stopping on the f32 value plateau, not the
+    chunked accumulation."""
     train, val = game_data
     config = _config()
     resident = GameEstimator(
@@ -260,7 +263,7 @@ def test_streamed_logistic_fit_tracks_resident(game_data):
     diff = np.abs(
         resident.model.score(val) - streamed.model.score(val)
     ).max()
-    assert diff <= 2e-3, diff
+    assert diff <= 1e-3, diff
 
 
 def test_single_chunk_and_divisible_plans_match_partial_chunk_fit(game_data):
@@ -384,9 +387,13 @@ def test_streamed_device_bytes_bounded_by_chunk_window(game_data):
     counters = {
         m["name"]: m["value"] for m in snap["counters"] if not m["labels"]
     }
+    tiered = {
+        (m["name"], m["labels"].get("tier")): m["value"]
+        for m in snap["counters"] if "tier" in m["labels"]
+    }
     assert counters["stream.chunks"] > 0
-    assert "stream.stall_s" in counters
-    assert "stream.prefetch_overlap_s" in counters
+    assert ("stream.stall_s", "h2d") in tiered
+    assert ("stream.prefetch_overlap_s", "h2d") in tiered
     # The acceptance bound: peak in-flight device residency stays inside
     # the (prefetch + 1)-chunk window of the budget.  Entity sub-blocks
     # are sized by the same budget, so the whole streamed fit obeys it.
@@ -555,6 +562,367 @@ def test_warm_join_prefetch_overlaps_and_matches(game_data):
     assert prefetch_warm_joins(
         {"re0": coord}, GameModel({"re0": own}, "linear_regression")
     ) == 0
+
+
+# -- disk-backed tile store (ISSUE 11) ---------------------------------------
+
+def _spilled_estimator(train, val, spill_dir, **kwargs):
+    return GameEstimator(
+        "linear_regression", train, validation_data=val,
+        stream_chunks=CHUNK, spill_dir=str(spill_dir), **kwargs,
+    )
+
+
+@pytest.fixture(scope="module")
+def spilled_fit(game_data, tmp_path_factory):
+    """One spilled fit under a host budget of ~1.5 feature chunks: big
+    enough that no single entry exceeds the budget (the gauge bound is
+    strict), small enough that streaming all chunks + tiles MUST evict."""
+    train, val = game_data
+    spill_dir = tmp_path_factory.mktemp("tile_store")
+    session = TelemetrySession("t-spilled-fit")
+    budget_bytes = int(1.5 * CHUNK * per_row_bytes(train))
+    result = _spilled_estimator(
+        train, val, spill_dir, max_host_mb=budget_bytes / (1 << 20),
+        telemetry=session,
+    ).fit([_config()])[0]
+    return result, session, spill_dir, budget_bytes
+
+
+def test_spilled_fit_matches_host_resident_streamed_bitwise(
+    spilled_fit, fits, game_data
+):
+    """The ISSUE 11 acceptance bar: a spilled streamed fit is
+    BIT-IDENTICAL to the host-resident streamed fit — the disk roundtrip
+    and the cache/eviction churn change nothing."""
+    train, val = game_data
+    result, _, _, _ = spilled_fit
+    host = fits["stream"]
+    for name, host_model in host.model.coordinates.items():
+        sp_model = result.model.coordinates[name]
+        if hasattr(host_model, "table"):
+            assert np.array_equal(
+                np.asarray(host_model.table), np.asarray(sp_model.table)
+            ), name
+        else:
+            assert np.array_equal(
+                np.asarray(host_model.model.coefficients.means),
+                np.asarray(sp_model.model.coefficients.means),
+            ), name
+    np.testing.assert_array_equal(
+        host.model.score(val), result.model.score(val)
+    )
+    for name, value in host.metrics.items():
+        assert abs(value - result.metrics[name]) <= 1e-6, name
+
+
+def test_spilled_tiles_on_disk_match_recomputation(
+    spilled_fit, game_data
+):
+    """The PUBLISHED tiles equal a bit-exact recomputation from the final
+    models (write-through write-back worked; roundtrip lossless)."""
+    from photon_tpu.game.tile_store import TileStore
+    from photon_tpu.game.tiles import RESIDUAL_TILE_KIND as TILES
+    from photon_tpu.game.tiles import score_model_chunks
+
+    train, _ = game_data
+    result, _, spill_dir, _ = spilled_fit
+    plan = ChunkPlan(train.num_examples, CHUNK)
+    store = TileStore(str(spill_dir))
+    last = result.descent.last_model.coordinates
+    names = list(last)
+    oracle = ChunkStreamer()
+    rows = {
+        name: score_model_chunks(last[name], train, plan, oracle)
+        for name in names
+    }
+    for k in range(plan.num_chunks):
+        arrays, meta = store.read(TILES, k)
+        lo, hi = plan.bounds(k)
+        want = np.stack([rows[name][lo:hi] for name in names])
+        assert np.array_equal(arrays["tile"], want), k
+        assert len(meta["tile_digest"]) == 16
+
+
+def test_spilled_eviction_respects_host_budget(spilled_fit):
+    """The host budget is ~1.5 feature chunks while the full tile+feature
+    set spans 3 chunks: eviction MUST fire, and the cache gauge must end
+    inside the budget (every entry is smaller than the budget, so the
+    oversized-entry allowance never applies)."""
+    _, session, _, budget_bytes = spilled_fit
+    snap = session.registry.snapshot()
+    counters = {
+        m["name"]: m["value"] for m in snap["counters"] if not m["labels"]
+    }
+    gauges = {
+        m["name"]: m["value"] for m in snap["gauges"] if not m["labels"]
+    }
+    assert counters["tiles.cache_evictions"] > 0
+    assert counters["tiles.cache_misses"] > 0
+    assert 0 < gauges["tiles.host_cache_bytes"] <= budget_bytes
+    assert gauges["tiles.disk_bytes"] > 0
+    # Per-tier stalls measured on BOTH edges.
+    tiered = {
+        (m["name"], m["labels"].get("tier")): m["value"]
+        for m in snap["counters"] if "tier" in m["labels"]
+    }
+    assert ("stream.stall_s", "disk") in tiered
+    assert ("stream.stall_s", "h2d") in tiered
+
+
+def test_spilled_mid_epoch_kill_then_resume_exact(game_data, tmp_path):
+    """Mid-epoch kill→resume with SPILLED tiles: the checkpoint carries
+    digests only (rows empty — on-disk tiles referenced, not re-saved)
+    and the resumed fit is exact."""
+    from photon_tpu.fault.checkpoint import DescentCheckpointer
+    from photon_tpu.fault.injection import (
+        FaultPlan,
+        InjectedKillError,
+        set_plan,
+    )
+
+    train, val = game_data
+    config = _config(iters=2)
+    spill_dir = tmp_path / "store"
+    baseline = _spilled_estimator(train, val, spill_dir).fit([config])[0]
+    ck = str(tmp_path / "ck")
+    set_plan(FaultPlan.parse("descent:kill:iter=1:coord=re0"))
+    try:
+        with pytest.raises(InjectedKillError):
+            _spilled_estimator(train, val, spill_dir).fit(
+                [config], checkpoint_dir=ck, resume="auto"
+            )
+    finally:
+        set_plan(None)
+    state = DescentCheckpointer(os.path.join(ck, "cfg-000")).load("latest")
+    assert state.stream["cursor"] == 1
+    assert state.stream["spilled"] is True
+    assert state.residual_rows == {}  # referenced, not re-saved
+    assert len(state.stream["tile_digests"]) == ChunkPlan(
+        train.num_examples, CHUNK
+    ).num_chunks
+    resumed = _spilled_estimator(train, val, spill_dir).fit(
+        [config], checkpoint_dir=ck, resume="auto"
+    )[0]
+    np.testing.assert_array_equal(
+        baseline.model.score(val), resumed.model.score(val)
+    )
+    np.testing.assert_array_equal(
+        baseline.model.score(train), resumed.model.score(train)
+    )
+    assert baseline.metrics == resumed.metrics
+
+
+def test_spilled_resume_with_corrupt_tile_refused(game_data, tmp_path):
+    """A corrupted on-disk tile is refused via digest at read during
+    resume — never silently adopted."""
+    from photon_tpu.fault.injection import (
+        FaultPlan,
+        InjectedKillError,
+        set_plan,
+    )
+    from photon_tpu.game.tile_store import CorruptTileError, TileStore
+    from photon_tpu.game.tiles import RESIDUAL_TILE_KIND as TILES
+
+    train, val = game_data
+    config = _config(iters=2)
+    spill_dir = tmp_path / "store"
+    ck = str(tmp_path / "ck")
+    set_plan(FaultPlan.parse("descent:kill:iter=1:coord=re0"))
+    try:
+        with pytest.raises(InjectedKillError):
+            _spilled_estimator(train, val, spill_dir).fit(
+                [config], checkpoint_dir=ck, resume="auto"
+            )
+    finally:
+        set_plan(None)
+    store = TileStore(str(spill_dir))
+    path = store.path(TILES, 0)
+    blob = bytearray(open(path, "rb").read())
+    blob[-5] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(blob)
+    with pytest.raises(CorruptTileError):
+        _spilled_estimator(train, val, spill_dir).fit(
+            [config], checkpoint_dir=ck, resume="auto"
+        )
+
+
+def test_spilled_resume_rebuilds_stale_tiles(game_data, tmp_path):
+    """A STALE (valid but torn-sequence) on-disk tile set is rebuilt
+    deterministically from the checkpointed models: resume stays exact
+    even after the store lost a write-back."""
+    from photon_tpu.fault.injection import (
+        FaultPlan,
+        InjectedKillError,
+        set_plan,
+    )
+    from photon_tpu.game.tile_store import TileStore
+    from photon_tpu.game.tiles import RESIDUAL_TILE_KIND as TILES
+
+    train, val = game_data
+    config = _config(iters=2)
+    spill_dir = tmp_path / "store"
+    baseline = _spilled_estimator(train, val, spill_dir).fit([config])[0]
+    ck = str(tmp_path / "ck")
+    set_plan(FaultPlan.parse("descent:kill:iter=1:coord=re0"))
+    try:
+        with pytest.raises(InjectedKillError):
+            _spilled_estimator(train, val, spill_dir).fit(
+                [config], checkpoint_dir=ck, resume="auto"
+            )
+    finally:
+        set_plan(None)
+    # Simulate a torn update sequence: drop one published tile (a VALID
+    # store state that no longer matches the checkpoint digests).
+    TileStore(str(spill_dir)).delete(TILES, 1)
+    session = TelemetrySession("t-rebuild")
+    resumed = _spilled_estimator(
+        train, val, spill_dir, telemetry=session
+    ).fit([config], checkpoint_dir=ck, resume="auto")[0]
+    counters = {
+        m["name"]: m["value"]
+        for m in session.registry.snapshot()["counters"]
+        if not m["labels"]
+    }
+    assert counters.get("tiles.rebuilt", 0) == 1
+    np.testing.assert_array_equal(
+        baseline.model.score(val), resumed.model.score(val)
+    )
+    assert baseline.metrics == resumed.metrics
+
+
+def test_spilled_fit_with_injected_tile_read_faults(
+    game_data, tmp_path, monkeypatch
+):
+    """Transient ``tile:read`` faults during a spilled fit are retried to
+    a clean, bit-identical run (the retry/backoff triangle on the disk
+    edge)."""
+    from photon_tpu.fault.injection import FaultPlan, set_plan
+
+    monkeypatch.setenv("PHOTON_IO_RETRY_BASE_S", "0")
+    monkeypatch.setenv("PHOTON_IO_RETRIES", "8")
+    train, val = game_data
+    config = _config(iters=1)
+    clean = _spilled_estimator(train, val, tmp_path / "clean").fit(
+        [config]
+    )[0]
+    session = TelemetrySession("t-tilefaults")
+    set_plan(FaultPlan.parse("tile:read:p=0.5", seed=7))
+    try:
+        faulted = _spilled_estimator(
+            train, val, tmp_path / "faulted", telemetry=session
+        ).fit([config])[0]
+    finally:
+        set_plan(None)
+    np.testing.assert_array_equal(
+        clean.model.score(val), faulted.model.score(val)
+    )
+    counters = {
+        (m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+        for m in session.registry.snapshot()["counters"]
+    }
+    assert counters.get(("io.retries", (("site", "tile:read"),)), 0) > 0
+
+
+def test_spilled_fit_with_compression_bit_identical(
+    game_data, tmp_path, monkeypatch
+):
+    """`PHOTON_TILE_COMPRESS=1` (delta + byte-shuffle + zlib) trades CPU
+    for disk bandwidth without touching a single bit of the result."""
+    monkeypatch.setenv("PHOTON_TILE_COMPRESS", "1")
+    train, val = game_data
+    config = _config(iters=1)
+    host = GameEstimator(
+        "linear_regression", train, validation_data=val,
+        stream_chunks=CHUNK,
+    ).fit([config])[0]
+    compressed = _spilled_estimator(train, val, tmp_path / "store").fit(
+        [config]
+    )[0]
+    np.testing.assert_array_equal(
+        host.model.score(val), compressed.model.score(val)
+    )
+    from photon_tpu.game.tile_store import TileStore
+
+    assert TileStore(str(tmp_path / "store")).compress
+
+
+def test_spill_estimator_gates(game_data):
+    train, val = game_data
+    with pytest.raises(ValueError, match="spill_dir"):
+        GameEstimator("linear_regression", train, spill_dir="/tmp/x")
+    with pytest.raises(ValueError, match="max_host_mb"):
+        GameEstimator(
+            "linear_regression", train, stream_chunks=CHUNK,
+            spill_dir="/tmp/x", max_host_mb=0,
+        )
+    with pytest.raises(ValueError, match="spill_dir"):
+        GameEstimator(
+            "linear_regression", train, stream_chunks=CHUNK,
+            max_host_mb=1.0,
+        )
+
+
+def test_train_game_max_host_mb_auto_enables_spilling(tmp_path):
+    """ISSUE 11 satellite: the auto-enable gate folds the HOST estimate
+    in — a dataset past ``--max-host-mb`` auto-enables streaming AND the
+    disk-backed tile store instead of OOM-ing the host cache."""
+    import json
+
+    from photon_tpu.drivers import train_game
+
+    out = tmp_path / "out"
+    train_game.run(train_game.build_parser().parse_args([
+        "--input", "synthetic-game:60:4:6:3",
+        "--task", "linear_regression",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=25",
+        "--coordinate", "re0:type=random,shard=re0,entity=re0,max_iters=25",
+        "--descent-iterations", "1",
+        "--validation-split", "0.25",
+        "--max-host-mb", "0.001",
+        "--output-dir", str(out),
+    ]))
+    assert (out / "tile_store").is_dir()
+    with open(out / "telemetry" / "run_report.json") as f:
+        report = json.load(f)
+    gauges = {m["name"]: m["value"] for m in report["metrics"]["gauges"]}
+    assert gauges["stream.spilled"] == 1
+    assert gauges["stream.chunk_rows"] >= 1
+    assert gauges["stream.host_estimate_bytes"] > 0.001 * (1 << 20)
+    assert gauges["tiles.disk_bytes"] > 0
+    # A generous host budget keeps the non-spilled path.
+    out2 = tmp_path / "out2"
+    train_game.run(train_game.build_parser().parse_args([
+        "--input", "synthetic-game:60:4:6:3",
+        "--task", "linear_regression",
+        "--coordinate", "fixed:type=fixed,shard=global,max_iters=25",
+        "--coordinate", "re0:type=random,shard=re0,entity=re0,max_iters=25",
+        "--descent-iterations", "1",
+        "--validation-split", "0.25",
+        "--stream-chunks", "53",
+        "--max-host-mb", "10000",
+        "--output-dir", str(out2),
+    ]))
+    assert not (out2 / "tile_store").exists()
+    with open(out2 / "telemetry" / "run_report.json") as f:
+        report = json.load(f)
+    gauges = {m["name"]: m["value"] for m in report["metrics"]["gauges"]}
+    assert "stream.spilled" not in gauges
+
+
+def test_train_game_spill_dir_requires_streaming(tmp_path):
+    from photon_tpu.drivers import train_game
+
+    with pytest.raises(ValueError, match="streamed mode"):
+        train_game.run(train_game.build_parser().parse_args([
+            "--input", "synthetic-game:60:4:6:3",
+            "--task", "linear_regression",
+            "--coordinate", "fixed:type=fixed,shard=global,max_iters=25",
+            "--descent-iterations", "1",
+            "--spill-dir", str(tmp_path / "store"),
+            "--output-dir", str(tmp_path / "out"),
+        ]))
 
 
 def test_mid_epoch_checkpoint_carries_solve_quarantine(game_data, tmp_path):

@@ -370,6 +370,42 @@ def test_render_markdown_checkpoint_pipeline_section(tmp_path):
     assert "## Host-IO pool" not in text2
 
 
+def test_render_markdown_streaming_tiers_section(tmp_path):
+    """The stream.*/tiles.* row block (ISSUE 11): per-tier stall/overlap
+    table + the host-cache/disk-store shape of a spilled run; absent
+    metrics -> absent section."""
+    session = TelemetrySession("ooc-test")
+    session.counter("stream.chunks").inc(24)
+    session.counter("stream.stall_s", tier="h2d").inc(0.25)
+    session.counter("stream.stall_s", tier="disk").inc(1.5)
+    session.counter("stream.prefetch_overlap_s", tier="h2d").inc(0.75)
+    session.counter("stream.prefetch_overlap_s", tier="disk").inc(2.0)
+    session.counter("tiles.cache_hits").inc(90)
+    session.counter("tiles.cache_misses").inc(10)
+    session.counter("tiles.cache_evictions").inc(4)
+    session.gauge("tiles.host_cache_bytes").set(8192)
+    session.gauge("tiles.disk_bytes").set(1 << 20)
+    session.finalize(str(tmp_path))
+    text = render_markdown(
+        json.load(open(tmp_path / "telemetry" / "run_report.json"))
+    )
+    assert "## Streaming tiers" in text
+    assert "**chunks delivered**: 24" in text
+    assert "| disk | 1.5 | 2 |" in text
+    assert "| h2d | 0.25 | 0.75 |" in text
+    assert "**tiles.cache_evictions**: 4" in text
+    assert "**tiles.host_cache_bytes**: 8192" in text
+    assert "**tiles.disk_bytes**:" in text
+
+    plain = TelemetrySession("no-stream")
+    plain.counter("rows").inc()
+    plain.finalize(str(tmp_path / "plain"))
+    text2 = render_markdown(
+        json.load(open(tmp_path / "plain" / "telemetry" / "run_report.json"))
+    )
+    assert "## Streaming tiers" not in text2
+
+
 def test_render_markdown_serving_section(tmp_path):
     """The serving.* row block (ISSUE 9 satellite): request/batch counters,
     the coalescing and host-syncs-per-batch ratios, latency distributions;

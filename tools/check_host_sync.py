@@ -48,6 +48,11 @@ DEFAULT_FILES = (
     # stats drain (descent.host_syncs), same contract as resident.
     "photon_tpu/game/tiles.py",
     "photon_tpu/game/stream_descent.py",
+    # The disk tier of the out-of-core stream: pure host IO by design —
+    # it must NEVER touch device data (a d2h inside a store read/write
+    # would serialize the disk edge against the device stream it exists
+    # to overlap).
+    "photon_tpu/game/tile_store.py",
     "photon_tpu/fault/checkpoint.py",
     # The preemption/watchdog layers run ON the hot loop's thread (the
     # boundary checks) or beside it (the heartbeat thread): neither may
