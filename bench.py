@@ -1258,6 +1258,248 @@ def _bench_serving() -> None:
     })
 
 
+def _bench_fleet() -> None:
+    """Fleet-serving macro-bench (``--mode fleet`` — the ISSUE 12
+    tentpole's measurement, and the serving number that rides BENCH_*.json
+    going forward).
+
+    Replays GENERATED traffic — power-law entity popularity, diurnal ramp,
+    a cold-start storm segment — through the replicated serving fleet over
+    the real TCP loopback transport, and measures what the single-scorer
+    serving bench cannot: QPS-vs-replicas scaling, admitted-request p50/p99
+    under offered load past saturation, and the admission-control shed
+    fraction that keeps the tail bounded there.
+
+    In-bench acceptance (raises on violation):
+
+    - per-request score parity vs the host oracle ≤ 1e-3 on EVERY served
+      request of every leg (storm requests included — they must ride the
+      zero-row fallback, not corrupt);
+    - 2-replica QPS ≥ 1.6x single-replica on the same replayed traffic —
+      asserted where the host can physically scale (≥ 2 effective cores or
+      a real accelerator); on a single-core CPU fixture thread-backed
+      replicas share the one core, so the bar drops to a no-collapse floor
+      (≥ 0.6x) and the emitted ``scaling_bar`` says which bar applied;
+    - at 2x-saturation offered load, admitted-request p99 ≤ 2x the
+      unsaturated p99, with the shed fraction (> 10%) reported;
+    - ZERO jax compile events across every post-warmup leg (the recompile-
+      freedom contract holds fleet-wide, storm and saturation included);
+    - the storm segment's unknown entities are counted
+      (``serving.cold_entities`` > 0) — the fallback actually exercised.
+    """
+    import dataclasses as _dc
+
+    import jax.monitoring
+    from jax._src import monitoring as monitoring_src
+
+    from photon_tpu.serving import (
+        ScoringClient,
+        ServingFleet,
+        TrafficSpec,
+        generate_traffic,
+        host_score_request,
+        replay_open_loop,
+        request_spec_for_dataset,
+        run_closed_loop_outcomes,
+    )
+    from photon_tpu.telemetry import TelemetrySession
+
+    platform, model, data = _serving_fixture()
+    max_batch, clients = 128, 8
+    n_requests = 1000 if platform != "cpu" else 300
+    spec = request_spec_for_dataset(model, data)
+    base_traffic = TrafficSpec(
+        requests=n_requests, mean_rows=8.0, max_rows=max_batch,
+        popularity="powerlaw", alpha=1.1, ramp="diurnal",
+        storm_frac=0.05, storm_at=0.7, seed=0,
+    )
+    traffic = generate_traffic(data, model, base_traffic)
+
+    def check_parity(outcomes, leg):
+        """Every served response vs the host oracle of ITS OWN request
+        (each leg replays its own seeded traffic)."""
+        worst = 0.0
+        for out in outcomes:
+            if out.status != "ok":
+                continue
+            want = host_score_request(model, out.item.request)
+            worst = max(worst, float(np.max(np.abs(
+                np.asarray(out.scores, np.float64) - want
+            ))))
+        if worst > 1e-3:
+            raise AssertionError(
+                f"fleet/host parity broke on the {leg} leg: "
+                f"max |delta| {worst:.2e}"
+            )
+        return worst
+
+    compile_events = []
+
+    def listener(event, **kwargs):
+        if "compile" in event:
+            compile_events.append(event)
+
+    # -- capacity legs: closed-loop clients over the TCP loopback ingest ----
+    def measure_capacity(n_replicas, session):
+        from photon_tpu.serving import AdmissionPolicy
+
+        fleet = ServingFleet(
+            model, replicas=n_replicas, request_spec=spec,
+            max_batch=max_batch, max_delay_s=0.001, telemetry=session,
+            # safety > 1: admission compares 2x the projected queue wait
+            # against the deadline budget, absorbing EWMA estimation lag —
+            # the knob that keeps the admitted tail INSIDE the 2x-p99
+            # acceptance bound at the cost of shedding a little more.
+            admission=AdmissionPolicy(safety=2.0),
+        ).warmup()
+        server = fleet.serve()
+        client_pool = []
+
+        def factory(tid):
+            client = ScoringClient(server.address, telemetry=session)
+            client_pool.append(client)
+            return lambda item: client.score(item.request)
+
+        jax.monitoring.register_event_listener(listener)
+        try:
+            outcomes, wall = run_closed_loop_outcomes(
+                factory, traffic.items, clients=clients
+            )
+        finally:
+            monitoring_src._unregister_event_listener_by_callback(listener)
+            for client in client_pool:
+                client.close()
+        errors = [o for o in outcomes if o.status != "ok"]
+        if errors:
+            raise AssertionError(
+                f"{len(errors)} failed requests at {n_replicas} replicas; "
+                f"first: {errors[0].reason}"
+            )
+        parity = check_parity(outcomes, f"{n_replicas}-replica capacity")
+        return fleet, outcomes, len(outcomes) / wall, parity
+
+    session1 = TelemetrySession("bench-fleet-1r")
+    fleet1, _, qps1, _ = measure_capacity(1, session1)
+    fleet1.close()
+    session2 = TelemetrySession("bench-fleet-2r")
+    fleet2, _, qps2, parity_cap = measure_capacity(2, session2)
+
+    cores = len(os.sched_getaffinity(0))
+    can_scale = platform != "cpu" or cores >= 2
+    scaling = qps2 / qps1
+    scaling_bar = 1.6 if can_scale else 0.6
+    if scaling < scaling_bar:
+        raise AssertionError(
+            f"2-replica QPS scaling {scaling:.2f}x under the "
+            f"{scaling_bar:.1f}x bar ({qps2:.0f} vs {qps1:.0f} req/s, "
+            f"{cores} effective cores)"
+        )
+
+    # -- unsaturated vs 2x-saturation open-loop replays (in-process submit:
+    # the replay schedule needs the router's synchronous fast-fail) --------
+    # fleet2's per-row service EWMA is already warm from the capacity leg,
+    # so the saturation leg's admission projections are live from the first
+    # arrival — exactly how a long-running fleet meets an overload.
+    jax.monitoring.register_event_listener(listener)
+    try:
+        unsat = generate_traffic(data, model, _dc.replace(
+            base_traffic, target_qps=0.4 * qps2, seed=1,
+        ))
+        out_unsat = replay_open_loop(fleet2.submit, unsat, timeout_s=120.0)
+        ok_unsat = [o for o in out_unsat if o.status == "ok"]
+        if len(ok_unsat) != len(out_unsat):
+            raise AssertionError(
+                f"unsaturated replay shed/failed "
+                f"{len(out_unsat) - len(ok_unsat)} requests"
+            )
+        lat_unsat = np.sort([o.latency_s for o in ok_unsat])
+        p50_unsat = float(np.percentile(lat_unsat, 50))
+        p99_unsat = float(np.percentile(lat_unsat, 99))
+        check_parity(out_unsat, "unsaturated")
+
+        deadline_s = 1.5 * p99_unsat
+        sat = generate_traffic(data, model, _dc.replace(
+            base_traffic, target_qps=2.0 * qps2, seed=2,
+            deadline_ms=deadline_s * 1e3,
+        ))
+        out_sat = replay_open_loop(fleet2.submit, sat, timeout_s=120.0)
+    finally:
+        monitoring_src._unregister_event_listener_by_callback(listener)
+    ok_sat = [o for o in out_sat if o.status == "ok"]
+    shed_sat = [o for o in out_sat if o.status == "shed"]
+    errors_sat = [o for o in out_sat if o.status == "error"]
+    if errors_sat:
+        raise AssertionError(
+            f"{len(errors_sat)} failed requests in the saturation leg; "
+            f"first: {errors_sat[0].reason}"
+        )
+    if not ok_sat:
+        raise AssertionError("saturation leg admitted nothing")
+    lat_sat = np.sort([o.latency_s for o in ok_sat])
+    p99_sat = float(np.percentile(lat_sat, 99))
+    shed_fraction = len(shed_sat) / len(out_sat)
+    parity_sat = check_parity(out_sat, "saturation")
+    if p99_sat > 2.0 * p99_unsat:
+        raise AssertionError(
+            f"admitted-request p99 {p99_sat * 1e3:.2f} ms at 2x saturation "
+            f"exceeds 2x the unsaturated p99 ({p99_unsat * 1e3:.2f} ms) — "
+            "admission control is not bounding the tail"
+        )
+    if shed_fraction <= 0.10:
+        raise AssertionError(
+            f"only {shed_fraction:.1%} shed at 2x saturation offered load "
+            "— past-saturation load is not actually shedding"
+        )
+    if compile_events:
+        raise AssertionError(
+            f"{len(compile_events)} jax compile events after warmup "
+            f"(first: {compile_events[0]}) — fleet serving recompiled"
+        )
+
+    def totals(session, name):
+        return sum(
+            m["value"] for m in session.registry.snapshot()["counters"]
+            if m["name"] == name
+        )
+
+    for s in (session1, session2):
+        if totals(s, "serving.host_syncs") > totals(s, "serving.batches"):
+            raise AssertionError("serving.host_syncs exceeded one per batch")
+    cold = totals(session2, "serving.cold_entities")
+    if cold <= 0:
+        raise AssertionError(
+            "the cold-start storm never hit the zero-row fallback "
+            "(serving.cold_entities == 0)"
+        )
+    fleet2.close()
+
+    _emit("game_fleet_qps", qps2, "req/s", {
+        "replicas": 2,
+        "requests_per_leg": n_requests,
+        "clients": clients,
+        "transport": "tcp-loopback (capacity legs)",
+        "qps_1_replica": round(qps1, 2),
+        "qps_2_replicas": round(qps2, 2),
+        "scaling_x": round(scaling, 3),
+        "scaling_bar": scaling_bar,
+        "effective_cores": cores,
+        "latency_p50_unsat_ms": round(p50_unsat * 1e3, 3),
+        "latency_p99_unsat_ms": round(p99_unsat * 1e3, 3),
+        "latency_p99_saturated_ms": round(p99_sat * 1e3, 3),
+        "deadline_ms": round(deadline_s * 1e3, 3),
+        "offered_qps_saturated": round(2.0 * qps2, 1),
+        "admitted_saturated": len(ok_sat),
+        "shed_fraction_saturated": round(shed_fraction, 4),
+        "storm_requests": sum(
+            1 for item in traffic.items if item.kind == "storm"
+        ),
+        "cold_entities": int(cold),
+        "max_parity_delta": max(parity_cap, parity_sat),
+        "compiled_programs_2r": fleet2.compilations,
+        "platform": platform,
+    })
+
+
 def _bench_recovery() -> None:
     """Checkpoint write/restore overhead micro-bench (``--mode recovery``).
 
@@ -1781,6 +2023,7 @@ def main() -> None:
             "recovery": _bench_recovery,
             "entities": _bench_entities,
             "serving": _bench_serving,
+            "fleet": _bench_fleet,
             "ooc": _bench_ooc,
         }
         if mode == "ooc" and "--spill" in sys.argv[3:]:
@@ -1836,6 +2079,10 @@ def main() -> None:
                           ("game_validation", _bench_validation),
                           ("game_recovery", _bench_recovery),
                           ("game_serving", _bench_serving),
+                          # Fleet serving (ISSUE 12): replicated scorers
+                          # over the TCP ingest, traffic replay, admission
+                          # control — the serving number going forward.
+                          ("game_fleet", _bench_fleet),
                           # spill=True: game_ooc_disk_rows_per_sec + the
                           # per-tier stall fractions ride the default run
                           # (ISSUE 11).

@@ -1,21 +1,29 @@
-"""Online GAME scoring service driver (in-process request loop).
+"""Online GAME scoring service driver (fleet serving loop).
 
-Loads a saved GAME model ONCE into device-resident serving tables
-(photon_tpu.serving.GameScorer), pre-compiles the bucket ladder, then
-drives a closed-loop request stream through the async batcher — the
-serving-shape workload (``--clients`` concurrent users, request sizes drawn
-from a seeded long-tailed distribution) run in-process so the service layer
-is exercised and measured without a network stack.  Scores land in
-``<output-dir>/scores.txt`` in request order; the telemetry run report
-carries the full ``serving.*`` block (request/batch counters, bucket
-occupancy, padded fraction, latency distributions, cold entities,
-host-syncs-per-batch).
+Loads a saved GAME model ONCE (shared model artifact), builds ``--replicas``
+scorer replicas — each owning device-resident serving tables — behind the
+deadline-aware fleet router, pre-compiles every replica's bucket ladder,
+then drives a seeded traffic stream through the service with closed-loop
+clients.  ``--traffic powerlaw`` (default) generates requests through the
+fleet traffic generator — power-law entity popularity, optional cold-start
+storm segment — while ``--traffic geometric`` keeps the PR 9 seeded
+geometric row-window stream for bench continuity.  ``--transport tcp``
+serves over the real socket ingest (loopback; clients are
+``ScoringClient`` connections) instead of in-process submission, and
+``--deadline-ms`` arms admission control (requests whose queue-wait
+projection blows the budget are shed and counted, never queued).
+
+Scores land in ``<output-dir>/scores.txt`` in request order (admitted
+requests only); the telemetry run report carries the full ``serving.*``
+block including the "Serving fleet" section (per-replica QPS/depth, shed
+breakdown, deadline hit rate).
 
     python -m photon_tpu.drivers.serve_game \\
         --model out/best_model --input test.avro \\
         --feature-bags global=features,per_user=userFeatures \\
         --id-columns userId \\
-        --requests 500 --clients 8 --max-batch 128 --max-delay-ms 2 \\
+        --requests 500 --clients 8 --replicas 2 --transport tcp \\
+        --deadline-ms 25 --max-batch 128 --max-delay-ms 2 \\
         --output-dir served
 """
 
@@ -41,7 +49,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input", required=True,
                    help="request feature source: Avro file/dir/glob or "
                    "synthetic-game spec (see train_game); requests are row "
-                   "windows cut from it")
+                   "sets cut from it")
     p.add_argument("--feature-bags", default=None)
     p.add_argument("--id-columns", default=None)
     p.add_argument("--requests", type=int, default=256,
@@ -50,25 +58,47 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mean rows per request (geometric long-tail, "
                    "clipped to [1, --max-batch])")
     p.add_argument("--clients", type=int, default=4,
-                   help="closed-loop client threads")
+                   help="closed-loop client threads (tcp: one connection "
+                   "each)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="scorer replicas behind the fleet router (each "
+                   "owns its device-resident tables)")
+    p.add_argument("--traffic", choices=("powerlaw", "geometric"),
+                   default="powerlaw",
+                   help="request stream: power-law entity popularity via "
+                   "the fleet traffic generator (default), or the PR 9 "
+                   "seeded geometric row windows (bench continuity)")
+    p.add_argument("--popularity-alpha", type=float, default=1.1,
+                   help="power-law popularity exponent (powerlaw traffic)")
+    p.add_argument("--storm-frac", type=float, default=0.0,
+                   help="fraction of requests in a cold-start storm "
+                   "segment (unknown entities; powerlaw traffic)")
+    p.add_argument("--transport", choices=("inproc", "tcp"),
+                   default="inproc",
+                   help="inproc: submit straight to the router; tcp: "
+                   "serve over the loopback socket ingest")
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="per-request deadline budget; 0 disables "
+                   "admission shedding")
     p.add_argument("--max-batch", type=int, default=128,
                    help="bucket-ladder cap / batcher coalescing cap (rows)")
     p.add_argument("--max-delay-ms", type=float, default=2.0,
                    help="batcher window: max time the first queued request "
                    "waits for coalescing partners")
     p.add_argument("--seed", type=int, default=0,
-                   help="request-size stream seed")
+                   help="traffic stream seed")
     return p
 
 
 def request_sizes(n_requests: int, mean: float, cap: int,
                   seed: int) -> np.ndarray:
     """Seeded long-tailed request-size stream (geometric, clipped to
-    [1, cap]) — shared by this driver and ``bench.py --mode serving`` so
-    the measured arrival pattern is the served one."""
-    rng = np.random.default_rng(seed)
-    p = min(1.0, max(1.0 / max(mean, 1.0), 1e-6))
-    return np.clip(rng.geometric(p, size=n_requests), 1, max(1, cap))
+    [1, cap]) — shared by ``--traffic geometric``, the traffic generator,
+    and ``bench.py --mode serving`` so the measured arrival pattern is the
+    served one."""
+    from photon_tpu.serving.traffic import geometric_sizes
+
+    return geometric_sizes(n_requests, mean, cap, np.random.default_rng(seed))
 
 
 def _publish_text(output_dir: str, name: str, write_fn, session,
@@ -116,11 +146,13 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
     from photon_tpu.fault.retry import retry_call
     from photon_tpu.game.model_io import load_game_model
     from photon_tpu.serving import (
-        GameScorer,
-        RequestBatcher,
-        build_requests,
+        AdmissionPolicy,
+        ScoringClient,
+        ServingFleet,
+        TrafficSpec,
+        generate_traffic,
         request_spec_for_dataset,
-        run_closed_loop,
+        run_closed_loop_outcomes,
     )
 
     os.makedirs(args.output_dir, exist_ok=True)
@@ -139,34 +171,71 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         )
         logger.info("request source: %d rows", data.num_examples)
 
-    with logger.timed("build-scorer"):
-        scorer = GameScorer(
+    deadline_s = (
+        args.deadline_ms / 1000.0 if args.deadline_ms > 0 else None
+    )
+    with logger.timed("build-fleet"):
+        fleet = ServingFleet(
             model,
-            mesh=common.maybe_mesh(),
+            replicas=args.replicas,
             request_spec=request_spec_for_dataset(model, data),
             max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1000.0,
             telemetry=session,
+            admission=AdmissionPolicy(default_deadline_s=deadline_s),
         ).warmup()
-        logger.info("scorer warm: buckets %s, %d programs compiled",
-                    scorer.buckets, scorer.compilations)
+        logger.info("fleet warm: %d replicas, %d programs compiled",
+                    args.replicas, fleet.compilations)
 
-    sizes = request_sizes(
-        args.requests, args.request_rows_mean, args.max_batch, args.seed
+    spec = TrafficSpec(
+        requests=args.requests,
+        mean_rows=args.request_rows_mean,
+        max_rows=args.max_batch,
+        popularity=args.traffic,
+        alpha=args.popularity_alpha,
+        storm_frac=args.storm_frac if args.traffic == "powerlaw" else 0.0,
+        deadline_ms=args.deadline_ms if args.deadline_ms > 0 else None,
+        seed=args.seed,
     )
-    requests = build_requests(data, model, sizes)
+    traffic = generate_traffic(data, model, spec)
 
-    with logger.timed("serve"):
-        with RequestBatcher(
-            scorer, max_batch=args.max_batch,
-            max_delay_s=args.max_delay_ms / 1000.0, telemetry=session,
-        ) as batcher:
-            scores, latencies, wall = run_closed_loop(
-                batcher, requests, clients=args.clients
+    server = fleet.serve() if args.transport == "tcp" else None
+    clients: list = []
+
+    def factory(tid: int):
+        if server is None:
+            return lambda item: fleet.score(
+                item.request, deadline_s=item.deadline_s
             )
+        client = ScoringClient(server.address, telemetry=session)
+        clients.append(client)
+        return lambda item: client.score(
+            item.request, deadline_s=item.deadline_s
+        )
 
-    rows = int(sum(sizes))
-    qps = len(requests) / wall if wall > 0 else 0.0
-    lat_ms = np.sort(np.asarray(latencies, np.float64)) * 1e3
+    try:
+        with logger.timed("serve"):
+            outcomes, wall = run_closed_loop_outcomes(
+                factory, traffic.items, clients=args.clients
+            )
+    finally:
+        for client in clients:
+            client.close()
+        fleet.close()
+
+    ok = [o for o in outcomes if o.status == "ok"]
+    shed = [o for o in outcomes if o.status == "shed"]
+    errors = [o for o in outcomes if o.status == "error"]
+    if errors:
+        raise RuntimeError(
+            f"{len(errors)} request(s) failed; first: {errors[0].reason}"
+        )
+
+    rows = int(sum(o.item.request.num_rows for o in ok))
+    qps = len(ok) / wall if wall > 0 else 0.0
+    lat_ms = np.sort(np.asarray(
+        [o.latency_s for o in ok], np.float64
+    )) * 1e3 if ok else np.zeros(1)
     p50 = float(np.percentile(lat_ms, 50))
     p99 = float(np.percentile(lat_ms, 99))
     session.gauge("serving.qps").set(qps)
@@ -174,7 +243,12 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
 
     _publish_text(
         args.output_dir, "scores.txt",
-        lambda f: np.savetxt(f, np.concatenate(scores), fmt="%.8g"),
+        lambda f: np.savetxt(
+            f,
+            np.concatenate([o.scores for o in ok])
+            if ok else np.zeros(0, np.float32),
+            fmt="%.8g",
+        ),
         session, logger,
     )
 
@@ -184,7 +258,11 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         if m["name"] == "serving.cold_entities"
     ) if session.enabled else 0
     summary = {
-        "requests": len(requests),
+        "requests": len(outcomes),
+        "served": len(ok),
+        "shed": len(shed),
+        "shed_fraction": round(len(shed) / len(outcomes), 4)
+        if outcomes else 0.0,
         "rows": rows,
         "wall_s": round(wall, 4),
         "qps": round(qps, 2),
@@ -192,7 +270,11 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         "latency_p50_ms": round(p50, 3),
         "latency_p99_ms": round(p99, 3),
         "cold_entities": int(cold),
-        "compiled_programs": scorer.compilations,
+        "compiled_programs": fleet.compilations,
+        "replicas": args.replicas,
+        "transport": args.transport,
+        "traffic": args.traffic,
+        "deadline_ms": args.deadline_ms,
     }
     _publish_text(
         args.output_dir, "serving_summary.json",
@@ -200,9 +282,10 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         session, logger,
     )
     logger.info(
-        "served %d requests (%d rows) at %.1f req/s; latency p50 %.2f ms "
-        "p99 %.2f ms; %d cold entities",
-        summary["requests"], rows, qps, p50, p99, summary["cold_entities"],
+        "served %d/%d requests (%d rows, %d shed) at %.1f req/s; latency "
+        "p50 %.2f ms p99 %.2f ms; %d cold entities",
+        summary["served"], summary["requests"], rows, summary["shed"],
+        qps, p50, p99, summary["cold_entities"],
     )
     return summary
 

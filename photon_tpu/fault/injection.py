@@ -105,6 +105,12 @@ KNOWN_FAULT_SITES = {
     "tile:write": "transient IOError inside a tile-store publish "
                   "(before the atomic rename; retriable — the previous "
                   "part file stays intact)",
+    "serve:replica_kill": "kill a serving replica's scoring path (param "
+                          "replica=<id> targets one; the fleet router "
+                          "marks it dead and reroutes in-flight work)",
+    "transport:read": "transient IOError at a serving-transport frame "
+                      "read (retriable: the client reconnects and "
+                      "resends — scoring is idempotent)",
 }
 
 
@@ -139,6 +145,9 @@ class FaultRule:
                 return False
         if "coord" in self.params and self.params["coord"] != "*":
             if ctx.get("coordinate") != self.params["coord"]:
+                return False
+        if "replica" in self.params and self.params["replica"] != "*":
+            if str(ctx.get("replica")) != self.params["replica"]:
                 return False
         return True
 
@@ -275,7 +284,8 @@ def fault_point(site: str, **ctx) -> None:
     if rule is None:
         return
     scope, _, action = site.partition(":")
-    if action == "kill" or site in ("checkpoint:write", "checkpoint:stage"):
+    if action.endswith("kill") or site in ("checkpoint:write",
+                                           "checkpoint:stage"):
         raise InjectedKillError(f"injected kill at {site} ({ctx or rule.params})")
     raise InjectedIOError(f"injected IO fault at {site} ({ctx or rule.params})")
 

@@ -35,6 +35,7 @@ from photon_tpu.game.data import (
 )
 from photon_tpu.parallel.mesh import to_host
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel, model_for_task
+from photon_tpu.utils import pow2_at_least
 
 Array = jax.Array
 
@@ -234,24 +235,53 @@ class RandomEffectModel:
         path (the gather-join with no host round-trip)."""
         return _random_margins(jnp.asarray(self.table), entity_idx, feats, dense)
 
-    def serving_table(self, mesh=None) -> Array:
+    @property
+    def serving_capacity(self) -> int:
+        """Default row capacity of this coordinate's serving gather table:
+        the next power of two past ``num_entities + 1`` (entities + the
+        zero row).  Amortized doubling — the headroom is what lets a GROWN
+        vocabulary hot-swap into a live scorer in place: as long as the new
+        ``num_entities + 1`` still fits the capacity, the table SHAPE (and
+        with it every compiled bucket program) is unchanged and only the
+        movable zero-row index advances."""
+        return pow2_at_least(self.num_entities + 1)
+
+    def serving_table(self, mesh=None, capacity: Optional[int] = None) -> Array:
         """Flatten this coordinate's per-entity rows into ONE device-resident
-        gather table for the online scoring service: ``[num_entities + 1,
-        dim]``, row ``num_entities`` all-zero, sharded over the mesh rows.
+        gather table for the online scoring service: ``[capacity, dim]``
+        (default :attr:`serving_capacity` — amortized-doubling headroom),
+        rows ``num_entities`` … ``capacity - 1`` all-zero, sharded over the
+        mesh rows.
 
         Unknown entities (entity index -1) are pre-mapped by the scorer to
-        the trailing zero row, so the serving gather yields exactly zero
-        margin — the fixed-effect-only fallback — without a per-row output
-        mask (photon_tpu.serving counts them as ``serving.cold_entities``).
-        Rows the mesh padding adds past ``num_entities + 1`` are also zero
-        (reshard_to_mesh pads with the zero fill), so any index into the
-        padded tail stays harmless by construction."""
+        the movable zero row at index ``num_entities``, so the serving
+        gather yields exactly zero margin — the fixed-effect-only fallback
+        — without a per-row output mask (photon_tpu.serving counts them as
+        ``serving.cold_entities``).  Rows past ``num_entities`` — the
+        capacity headroom AND whatever reshard_to_mesh's padding adds — are
+        zero by construction, so any index into the tail stays harmless.
+
+        ``capacity`` pins the table shape explicitly: a live scorer
+        hot-swapping a grown model passes its SERVED capacity so the new
+        table keeps the compiled programs' shape.  A vocabulary that no
+        longer fits is a layout-shape change and is refused loudly — that
+        rebuild boundary is the amortized-doubling contract."""
         from photon_tpu.parallel.mesh import reshard_to_mesh
 
+        rows = self.num_entities + 1
+        capacity = self.serving_capacity if capacity is None else int(capacity)
+        if rows > capacity:
+            raise ValueError(
+                f"serving_table: vocabulary ({self.num_entities} entities "
+                f"+ zero row) exceeds the table capacity {capacity}; "
+                "capacity growth is a layout-shape change — rebuild the "
+                "scorer instead of hot-swapping"
+            )
         table = jnp.concatenate(
             [
                 jnp.asarray(self.table, jnp.float32),
-                jnp.zeros((1, self.dim), jnp.float32),
+                jnp.zeros((capacity - self.num_entities, self.dim),
+                          jnp.float32),
             ]
         )
         return reshard_to_mesh(table, mesh)

@@ -20,6 +20,14 @@ serving (PAPERS.md, 1803.06333) and DrJAX's keep-everything-in-jit idiom
 - :class:`~photon_tpu.serving.batcher.RequestBatcher` — an async batcher
   thread (the ``io_pool`` / ``AsyncPublisher`` depth-1 lineage from PR 5)
   coalescing concurrent requests under a max-delay/max-batch policy.
+- The FLEET tier (ISSUE 12): :class:`~photon_tpu.serving.fleet.ServingFleet`
+  assembles N scorer replicas (each owning device-resident tables on its
+  own sub-mesh) behind the queue-depth-aware, deadline-admission
+  :class:`~photon_tpu.serving.router.FleetRouter`, optionally fronted by
+  the stdlib socket ingest (:mod:`photon_tpu.serving.transport`), with
+  replayable generated traffic (:mod:`photon_tpu.serving.traffic`:
+  power-law popularity, diurnal ramps, cold-start storms) and canary
+  ``swap_model`` rollout with mirrored-traffic parity probes.
 
 The batch scoring driver (``drivers/score_game``, non-streamed) routes
 through the same :class:`GameScorer` gather-table build, so the online and
@@ -32,6 +40,17 @@ from photon_tpu.serving.batcher import (  # noqa: F401
     RequestBatcher,
     run_closed_loop,
 )
+from photon_tpu.serving.fleet import ServingFleet  # noqa: F401
+from photon_tpu.serving.router import (  # noqa: F401
+    AdmissionPolicy,
+    FleetRouter,
+    NoHealthyReplicaError,
+    ReplicaDeadError,
+    RequestShedError,
+    RolloutParityError,
+    ScorerReplica,
+    host_score_request,
+)
 from photon_tpu.serving.scorer import (  # noqa: F401
     GameScorer,
     ScoringRequest,
@@ -43,4 +62,17 @@ from photon_tpu.serving.scorer import (  # noqa: F401
     request_spec_for_model,
     request_windows,
     slice_request,
+)
+from photon_tpu.serving.traffic import (  # noqa: F401
+    Outcome,
+    Traffic,
+    TrafficSpec,
+    generate_traffic,
+    replay_open_loop,
+    run_closed_loop_outcomes,
+)
+from photon_tpu.serving.transport import (  # noqa: F401
+    ScoringClient,
+    ScoringServer,
+    TransportError,
 )

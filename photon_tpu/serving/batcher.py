@@ -69,6 +69,11 @@ class RequestBatcher:
         self._queue: deque = deque()
         self._cond = threading.Condition()
         self._stop = False
+        # Rows accepted but not yet resolved (queued + in the batch being
+        # scored): the queue-depth signal the fleet router dispatches and
+        # sheds on.  Kept under the SAME lock as the queue so a router
+        # reading depth mid-submit can never see a torn count.
+        self._inflight_rows = 0
         self._thread = threading.Thread(
             target=self._loop, name="serving-batcher", daemon=True
         )
@@ -83,9 +88,17 @@ class RequestBatcher:
             if self._stop:
                 raise RuntimeError("batcher is closed")
             self._queue.append(pending)
+            self._inflight_rows += pending.rows
             self._cond.notify()
         self.telemetry.counter("serving.requests").inc()
         return pending.future
+
+    def pending_rows(self) -> int:
+        """Rows submitted but not yet resolved (queued + scoring) — the
+        per-replica queue depth the fleet router's admission projection and
+        least-loaded dispatch read."""
+        with self._cond:
+            return self._inflight_rows
 
     def close(self) -> None:
         """Drain queued requests (they still get scored) and stop."""
@@ -129,6 +142,10 @@ class RequestBatcher:
                 rows += head.rows
             return batch
 
+    def _retire(self, batch: List[_Pending]) -> None:
+        with self._cond:
+            self._inflight_rows -= sum(p.rows for p in batch)
+
     def _loop(self) -> None:
         while True:
             batch = self._take_batch()
@@ -138,11 +155,13 @@ class RequestBatcher:
                 merged = concat_requests([p.request for p in batch])
                 scores = self.scorer.score_batch(merged)
             except BaseException as e:  # surface through every waiter
+                self._retire(batch)
                 for p in batch:
                     if not p.future.cancelled():
                         p.future.set_exception(e)
                 continue
             self.telemetry.histogram("serving.coalesced").observe(len(batch))
+            self._retire(batch)
             lo = 0
             now = time.monotonic()
             for p in batch:

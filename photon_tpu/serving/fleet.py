@@ -1,0 +1,189 @@
+"""Serving fleet: N scorer replicas + router + optional socket ingest.
+
+The one assembly point for the fleet tier (ISSUE 12 tentpole): builds N
+:class:`~photon_tpu.serving.router.ScorerReplica` instances from ONE model
+artifact (shared model distribution — the host-side model object is loaded
+once; each replica uploads its OWN device-resident tables from it), wires
+them behind a :class:`~photon_tpu.serving.router.FleetRouter` with
+deadline-aware admission control, and optionally attaches the
+:class:`~photon_tpu.serving.transport.ScoringServer` socket ingest.
+
+Per-replica device residency: with ``devices="split"`` (the default) the
+addressable devices are dealt round-robin across replicas and each scorer
+places its tables on its own sub-mesh (``reshard_to_mesh`` under each
+scorer's mesh) — on a multi-device platform replicas genuinely own
+disjoint device memory; on a single device they share it (thread-backed
+replicas, the CPU fixture's shape).
+
+Rollout and model lifecycle ride the router: :meth:`ServingFleet.rollout`
+is the staggered/canary ``swap_model`` (one replica first, mirrored-
+traffic parity probe, then the rest), and capacity-headroom serving
+tables (amortized doubling + movable zero row) mean a GROWN vocabulary
+publishes in place fleet-wide with zero recompiles.
+
+Residency contract (``tools/check_host_sync.py`` guards this module): the
+fleet layer moves requests and models between components — it never
+fetches device data itself.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from photon_tpu.serving.router import (
+    AdmissionPolicy,
+    FleetRouter,
+    ScorerReplica,
+)
+from photon_tpu.serving.scorer import (
+    DEFAULT_MAX_BATCH,
+    DEFAULT_MIN_BUCKET,
+    GameScorer,
+    ScoringRequest,
+    ShardSpec,
+)
+from photon_tpu.serving.batcher import DEFAULT_MAX_DELAY_S
+
+
+def _replica_meshes(n_replicas: int, mesh, devices) -> List[object]:
+    """One mesh (or None) per replica.  An explicit ``mesh`` is shared by
+    every replica; ``devices="split"`` deals the addressable devices
+    round-robin so each replica's tables live on its own sub-mesh; any
+    other value places every replica on the default device."""
+    if mesh is not None or devices != "split":
+        return [mesh] * n_replicas
+    import jax
+
+    devs = list(jax.devices())
+    if len(devs) <= 1:
+        return [None] * n_replicas
+    from photon_tpu.parallel.mesh import create_mesh
+
+    groups = [devs[i::n_replicas] for i in range(n_replicas)]
+    return [
+        create_mesh(devices=groups[i % len(groups)] or [devs[i % len(devs)]])
+        for i in range(n_replicas)
+    ]
+
+
+class ServingFleet:
+    """N replicated scorers behind a deadline-aware router.
+
+    Context-manager lifecycle; ``close()`` drains every replica's batcher
+    and stamps the per-replica QPS gauges.  ``submit``/``score`` go
+    through admission control (``deadline_s`` is a relative budget;
+    sheds raise :class:`~photon_tpu.serving.router.RequestShedError`).
+    """
+
+    def __init__(
+        self,
+        model,
+        replicas: int = 2,
+        mesh=None,
+        devices: str = "split",
+        request_spec: Optional[Dict[str, ShardSpec]] = None,
+        buckets=None,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        min_bucket: int = DEFAULT_MIN_BUCKET,
+        max_delay_s: float = DEFAULT_MAX_DELAY_S,
+        telemetry=None,
+        admission: Optional[AdmissionPolicy] = None,
+    ):
+        from photon_tpu.telemetry import NULL_SESSION
+
+        if replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        self.model = model
+        self.telemetry = telemetry or NULL_SESSION
+        meshes = _replica_meshes(int(replicas), mesh, devices)
+        self.replicas: List[ScorerReplica] = []
+        for i in range(int(replicas)):
+            scorer = GameScorer(
+                model,
+                mesh=meshes[i],
+                request_spec=request_spec,
+                buckets=buckets,
+                max_batch=max_batch,
+                min_bucket=min_bucket,
+                telemetry=self.telemetry,
+            )
+            self.replicas.append(
+                ScorerReplica(
+                    f"r{i}", scorer,
+                    max_batch=max_batch, max_delay_s=max_delay_s,
+                    telemetry=self.telemetry,
+                )
+            )
+        self.router = FleetRouter(
+            self.replicas, telemetry=self.telemetry, admission=admission
+        )
+        self._server = None
+        self.telemetry.gauge("serving.replicas").set(int(replicas))
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str, telemetry=None, logger=None,
+                       **kwargs) -> "ServingFleet":
+        """Shared model-artifact distribution: the artifact is read ONCE
+        (retried like any guarded model load) and every replica builds its
+        device tables from the same host object."""
+        from photon_tpu.fault.retry import retry_call
+        from photon_tpu.game.model_io import load_game_model
+
+        model, _ = retry_call(
+            lambda: load_game_model(model_dir),
+            site="model:load", telemetry=telemetry, logger=logger,
+        )
+        return cls(model, telemetry=telemetry, **kwargs)
+
+    # -- serving -------------------------------------------------------------
+    def warmup(self) -> "ServingFleet":
+        """AOT-compile every replica's bucket ladder; after this the fleet
+        can never recompile on any arrival pattern."""
+        for replica in self.replicas:
+            replica.scorer.warmup()
+        return self
+
+    @property
+    def compilations(self) -> int:
+        return sum(r.scorer.compilations for r in self.replicas)
+
+    def submit(self, request: ScoringRequest,
+               deadline_s: Optional[float] = None):
+        return self.router.submit(request, deadline_s=deadline_s)
+
+    def score(self, request: ScoringRequest,
+              deadline_s: Optional[float] = None):
+        return self.submit(request, deadline_s=deadline_s).result()
+
+    def rollout(self, model, **kwargs) -> None:
+        """Staggered/canary ``swap_model`` across the fleet (see
+        :meth:`photon_tpu.serving.router.FleetRouter.rollout`)."""
+        self.router.rollout(model, **kwargs)
+        self.model = model
+
+    # -- transport -----------------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Attach the socket ingest; returns the
+        :class:`~photon_tpu.serving.transport.ScoringServer` (its
+        ``.address`` is the bound ``(host, port)``)."""
+        from photon_tpu.serving.transport import ScoringServer
+
+        if self._server is not None:
+            raise RuntimeError("fleet already serving")
+        self._server = ScoringServer(
+            self.router, host=host, port=port, telemetry=self.telemetry
+        )
+        return self._server
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        self.router.close()
+
+    def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -21,10 +21,20 @@ their scores are sliced off before anything leaves the scorer.
 
 Unknown entities: each random coordinate's table is the model's
 :meth:`~photon_tpu.game.model.RandomEffectModel.serving_table` —
-``[entities + 1, dim]`` with the trailing row all-zero — and request rows
-whose entity key is outside the vocabulary gather that zero row, falling
-back to a fixed-effect-only score.  They are counted on device and surface
-as ``serving.cold_entities{coordinate=...}``.
+``[capacity, dim]`` with every row past the vocabulary all-zero — and
+request rows whose entity key is outside the vocabulary gather the zero
+row at index ``num_entities``, falling back to a fixed-effect-only score.
+They are counted on device and surface as
+``serving.cold_entities{coordinate=...}``.
+
+Capacity headroom: tables allocate at the model's amortized-doubling
+:attr:`~photon_tpu.game.model.RandomEffectModel.serving_capacity` (next
+pow2 past entities + 1), and the zero-row index rides the published
+serving state as a DEVICE argument — not a constant baked into the
+compiled programs.  A retrained model whose grown vocabulary still fits
+the served capacity therefore hot-swaps in place with zero recompiles
+(the zero row just moves); only a capacity/dim change — a real
+layout-shape change — still refuses and requires a new scorer.
 """
 
 from __future__ import annotations
@@ -230,13 +240,17 @@ def build_requests(
 
 @dataclasses.dataclass(frozen=True)
 class _CoordPlan:
-    """Static per-coordinate scoring plan baked into every bucket program."""
+    """Static per-coordinate scoring plan baked into every bucket program.
+
+    Deliberately carries the table CAPACITY (the compiled shape) and not
+    the entity count: the zero-row index is dynamic published state, so a
+    swap that only grows the vocabulary within capacity compares equal."""
 
     name: str
     kind: str  # "fixed" | "random"
     shard: str
     column: Optional[str] = None  # random: id column joined on
-    zero_row: int = 0  # random: index of the all-zero fallback row
+    capacity: int = 0  # random: table rows (vocabulary + zero-row headroom)
 
 
 class GameScorer:
@@ -285,39 +299,53 @@ class GameScorer:
         # -- device-resident model tables (loaded once; replaceable by
         # swap_model without recompiling — the programs take them as
         # arguments) ----------------------------------------------------------
-        plan, tables, vocab = self._build_tables(model)
+        plan, tables, zero_rows, vocab = self._build_tables(model)
         self._plan = tuple(plan)
         self._tables = tuple(tables)
+        self._zero_rows = zero_rows
         self._vocab = vocab
-        # The ONE published (tables, vocab) pair: score_batch unpacks it
-        # once at entry, so a swap can never hand one batch a mixed state.
-        self._serving = (self._tables, self._vocab)
+        # The ONE published (tables, zero_rows, vocab) triple: score_batch
+        # unpacks it once at entry, so a swap can never hand one batch a
+        # mixed state.
+        self._serving = (self._tables, self._zero_rows, self._vocab)
         self._record_model_gauges(model, self._tables)
 
-    def _build_tables(self, model: GameModel):
+    def _build_tables(self, model: GameModel,
+                      capacities: Optional[Dict[str, int]] = None):
         """Device placement of one model's serving state: the static
-        per-coordinate plan, the device table tuple, and the host
+        per-coordinate plan, the device table tuple, the movable zero-row
+        index vector (one int32 per random coordinate, in plan order —
+        published state, never baked into a program), and the host
         vocabularies the ingest join runs against.  Shared by ``__init__``
-        and :meth:`swap_model` so the two can never build differently.
+        and :meth:`swap_model` so the two can never build differently;
+        the swap passes its SERVED ``capacities`` so a grown vocabulary
+        builds at the compiled shape (and refuses past it).
         Sets NO gauges — :meth:`_record_model_gauges` publishes telemetry
         only for a model that actually serves (a refused swap must not
         leave gauges describing the rejected model)."""
         plan: List[_CoordPlan] = []
         tables: List[jax.Array] = []
+        zero_rows: List[int] = []
         vocab: Dict[str, np.ndarray] = {}
         for name, coord in model.coordinates.items():
             if isinstance(coord, FixedEffectModel):
                 plan.append(_CoordPlan(name, "fixed", coord.shard_name))
                 tables.append(coord.serving_weights(self.mesh))
             elif isinstance(coord, RandomEffectModel):
+                capacity = (capacities or {}).get(
+                    name, coord.serving_capacity
+                )
                 plan.append(
                     _CoordPlan(
                         name, "random", coord.shard_name,
                         column=coord.entity_column,
-                        zero_row=coord.num_entities,
+                        capacity=int(capacity),
                     )
                 )
-                tables.append(coord.serving_table(self.mesh))
+                tables.append(
+                    coord.serving_table(self.mesh, capacity=capacity)
+                )
+                zero_rows.append(coord.num_entities)
                 # host-sync: build/swap-time only — entity vocabularies are
                 # host numpy by construction (the key join runs at ingest).
                 vocab[name] = np.asarray(coord.keys)
@@ -329,7 +357,12 @@ class GameScorer:
                 raise ValueError(
                     f"request spec is missing shard {coord.shard_name!r}"
                 )
-        return plan, tables, vocab
+        # host-sync: build/swap-time only — the movable zero-row vector is
+        # assembled on host and uploaded once per published model.
+        zero_dev = put_request(
+            jnp.asarray(np.asarray(zero_rows, np.int32)), self.mesh
+        )
+        return plan, tables, zero_dev, vocab
 
     def _record_model_gauges(self, model: GameModel, tables) -> None:
         """Publish the SERVED model's residency/entity gauges (called only
@@ -339,6 +372,11 @@ class GameScorer:
                 self.telemetry.gauge(
                     "serving.entities", coordinate=name
                 ).set(coord.num_entities)
+                self.telemetry.gauge(
+                    "serving.table_capacity", coordinate=name
+                ).set(next(
+                    c.capacity for c in self._plan if c.name == name
+                ))
         self.telemetry.gauge("serving.model_bytes").set(
             sum(t.nbytes for t in tables)
         )
@@ -348,22 +386,31 @@ class GameScorer:
         table tuple is built (uploaded) FIRST — double-buffered next to the
         serving tables — then published in one reference assignment, so no
         request is dropped and nothing recompiles (every bucket program
-        takes the tables as arguments; the per-coordinate plan, which IS
-        baked into the programs, must match the served model's — same
-        coordinate names/kinds/shards/entity counts.  Vocabulary growth is
-        a rebuild, the open ROADMAP serving edge (c)).
+        takes the tables AND the zero-row index vector as arguments; the
+        per-coordinate plan, which IS baked into the programs, must match
+        the served model's — same coordinate names/kinds/shards/table
+        capacities.  A GROWN vocabulary that still fits the served
+        capacity swaps in place: the new entities' rows upload into the
+        headroom and the zero-row index advances — ROADMAP continual-
+        training blocker (b) cleared.  Growth PAST capacity, or a changed
+        dim/coordinate set, is a layout-shape change and refuses).
 
-        In-flight requests complete against whichever tuple they captured
+        In-flight requests complete against whichever triple they captured
         at dispatch: the old tables stay alive until their last dispatch
         retires (the runtime holds the references), then free.  Counted as
         ``serving.swaps``."""
-        plan, tables, vocab = self._build_tables(model)
+        capacities = {
+            c.name: c.capacity for c in self._plan if c.kind == "random"
+        }
+        plan, tables, zero_rows, vocab = self._build_tables(
+            model, capacities=capacities
+        )
         if tuple(plan) != self._plan:
             raise ValueError(
                 "swap_model: the new model's serving plan does not match "
                 f"the compiled programs (served {self._plan}, new "
-                f"{tuple(plan)}); a changed coordinate layout or entity "
-                "count requires a new GameScorer"
+                f"{tuple(plan)}); a changed coordinate layout or table "
+                "capacity requires a new GameScorer"
             )
         for new, old in zip(tables, self._tables):
             if new.shape != old.shape or new.dtype != old.dtype:
@@ -376,13 +423,14 @@ class GameScorer:
 
         # The upload completes BEFORE publication: a request arriving the
         # instant after the swap reads fully-materialized tables.
-        _jax.block_until_ready(tables)
+        _jax.block_until_ready((tables, zero_rows))
         # One-assignment publication: score_batch reads ``self._serving``
         # exactly once at entry, so every batch scores against ONE model's
-        # tables + vocabulary — never a mix of old and new.
+        # tables + zero rows + vocabulary — never a mix of old and new.
         self._tables = tuple(tables)
+        self._zero_rows = zero_rows
         self._vocab = vocab
-        self._serving = (self._tables, self._vocab)
+        self._serving = (self._tables, self._zero_rows, self._vocab)
         self.model = model
         self._record_model_gauges(model, self._tables)
         self.telemetry.counter("serving.swaps").inc()
@@ -408,14 +456,14 @@ class GameScorer:
         return self
 
     def _donate_argnums(self) -> tuple:
-        """Donate request buffers (args 1–3: feats/idx/offset) on
+        """Donate request buffers (args 2–4: feats/idx/offset) on
         accelerators only.  See the comment at the jit site: on CPU the
         placed buffers can alias the staged host memory and each other
         across replicas, and donating an aliased buffer corrupts scores."""
         devices = self._tables[0].devices() if self._tables else set()
         if any(d.platform == "cpu" for d in devices):
             return ()
-        return (1, 2, 3)
+        return (2, 3, 4)
 
     # -- program build -------------------------------------------------------
     def _program(self, bucket: int, layout: str = "request"):
@@ -431,17 +479,22 @@ class GameScorer:
             )
         plan, spec = self._plan, self.request_spec
 
-        def score(tables, feats, idx, offset, n_valid):
+        def score(tables, zero_rows, feats, idx, offset, n_valid):
             valid = jnp.arange(bucket, dtype=jnp.int32) < n_valid
             total = offset
             colds = []
+            random_pos = 0
             for c, table in zip(plan, tables):
                 dense = spec[c.shard].dense
                 if c.kind == "fixed":
                     total = total + _fixed_margins(table, feats[c.shard], dense)
                 else:
                     raw = idx[c.name]
-                    safe = jnp.where(raw >= 0, raw, c.zero_row)
+                    # The zero row is DYNAMIC published state (it moves when
+                    # a grown vocabulary hot-swaps in), never a baked
+                    # constant — otherwise growth would mean recompiles.
+                    safe = jnp.where(raw >= 0, raw, zero_rows[random_pos])
+                    random_pos += 1
                     total = total + serving_gather_margins(
                         table, safe, feats[c.shard], dense
                     )
@@ -473,7 +526,7 @@ class GameScorer:
                 "ignore", message="Some donated buffers were not usable"
             )
             program = jitted.lower(
-                self._tables, *abstract_like(sample)
+                self._tables, self._zero_rows, *abstract_like(sample)
             ).compile()
         self._programs[(bucket, layout)] = program
         self.compilations += 1
@@ -615,13 +668,14 @@ class GameScorer:
     def _score_padded(self, request: ScoringRequest, bucket: int,
                       n: int, layout: str = "request") -> np.ndarray:
         t0 = time.monotonic()
-        # ONE read of the published (tables, vocab) pair: a concurrent
-        # swap_model cannot hand this batch old tables + a new vocabulary.
-        tables, vocab = self._serving
+        # ONE read of the published (tables, zero_rows, vocab) triple: a
+        # concurrent swap_model cannot hand this batch old tables + a new
+        # vocabulary (or a moved zero row).
+        tables, zero_rows, vocab = self._serving
         program = self._program(bucket, layout=layout)
         feats, idx, offset = self._stage(request, bucket, n, vocab)
         placed = self._place(feats, idx, offset, n, layout=layout)
-        out, cold_dev = program(tables, *placed)
+        out, cold_dev = program(tables, zero_rows, *placed)
         # The response must OWN its memory (the copy below): on CPU the
         # fetch can alias the device output buffer, and with donated inputs
         # that buffer is recycled by the very next batch — a zero-copy view

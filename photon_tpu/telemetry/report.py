@@ -304,6 +304,87 @@ def _render_serving_section(report: dict) -> list:
     return lines
 
 
+def _render_fleet_section(report: dict) -> list:
+    """The serving fleet at a glance (``serving.replica_*`` / shed /
+    rollout metrics): per-replica traffic and health, the admission-control
+    shed breakdown, the deadline hit rate over admitted requests, and the
+    canary-rollout timeline.  Empty when the run never routed requests
+    through a fleet (single-scorer serving keeps the plain "Online
+    serving" section only)."""
+    metrics = report.get("metrics") or {}
+    counters = metrics.get("counters") or []
+    gauges = metrics.get("gauges") or []
+
+    def by_label(coll, name, label):
+        out = {}
+        for m in coll:
+            if m["name"] == name:
+                key = (m.get("labels") or {}).get(label, "?")
+                out[key] = out.get(key, 0) + m["value"]
+        return out
+
+    def total(name):
+        return sum(m["value"] for m in counters if m["name"] == name)
+
+    replica_requests = by_label(counters, "serving.replica_requests",
+                                "replica")
+    if not replica_requests:
+        return []
+    replica_rows = by_label(counters, "serving.replica_rows", "replica")
+    replica_deaths = by_label(counters, "serving.replica_deaths", "replica")
+    rerouted = by_label(counters, "serving.rerouted", "replica")
+    replica_qps = by_label(gauges, "serving.replica_qps", "replica")
+    replica_depth = by_label(gauges, "serving.replica_depth", "replica")
+    lines = ["", "## Serving fleet", "",
+             "| replica | requests | rows | qps | depth peak (rows) "
+             "| deaths | rerouted off |",
+             "|---|---|---|---|---|---|---|"]
+    for rid in sorted(replica_requests):
+        lines.append(
+            f"| {rid} | {_fmt(replica_requests[rid])} "
+            f"| {_fmt(replica_rows.get(rid, 0))} "
+            f"| {_fmt(replica_qps.get(rid))} "
+            f"| {_fmt(replica_depth.get(rid))} "
+            f"| {_fmt(replica_deaths.get(rid, 0))} "
+            f"| {_fmt(rerouted.get(rid, 0))} |"
+        )
+    admitted = total("serving.admitted")
+    shed = by_label(counters, "serving.shed", "reason")
+    shed_total = sum(shed.values())
+    offered = admitted + shed_total
+    lines.append("")
+    lines.append(f"- **admitted**: {_fmt(admitted)} of {_fmt(offered)} "
+                 "offered")
+    if shed_total:
+        breakdown = ", ".join(
+            f"{reason}={_fmt(count)}" for reason, count in sorted(shed.items())
+        )
+        lines.append(
+            f"- **shed**: {_fmt(shed_total)} "
+            f"({shed_total / offered:.1%} of offered) — {breakdown}"
+        )
+    missed = total("serving.deadline_missed")
+    if admitted:
+        lines.append(
+            f"- **deadline hit rate**: {(admitted - missed) / admitted:.1%}"
+            f" of admitted ({_fmt(missed)} missed)"
+        )
+    rollout_steps = []
+    for m in gauges:
+        if m["name"] == "serving.rollout_step":
+            labels = m.get("labels") or {}
+            rollout_steps.append(
+                (m["value"], labels.get("replica", "?"),
+                 labels.get("phase", "?"))
+            )
+    if rollout_steps:
+        timeline = " → ".join(
+            f"{rid}:{phase}" for _, rid, phase in sorted(rollout_steps)
+        )
+        lines.append(f"- **rollout timeline**: {timeline}")
+    return lines
+
+
 def render_markdown(report: dict) -> str:
     """Human-readable view of a run report dict."""
     lines = [
@@ -343,6 +424,7 @@ def render_markdown(report: dict) -> str:
     lines += _render_streaming_section(report)
     lines += _render_entity_solves_section(report)
     lines += _render_serving_section(report)
+    lines += _render_fleet_section(report)
 
     metrics = report.get("metrics") or {}
     counters = metrics.get("counters") or []

@@ -57,6 +57,20 @@ def test_serving_hot_path_is_guarded():
     assert "photon_tpu/serving/batcher.py" in guarded
 
 
+def test_fleet_serving_is_guarded():
+    """The fleet tier rides the default guard set (ISSUE 12 satellite):
+    the router moves requests between host queues (its only sanctioned
+    fetches are the explicit parity-oracle markers), the transport is
+    pure wire IO, and the fleet assembly never touches device data — an
+    unmarked sync in any of them must fail CI."""
+    from check_host_sync import DEFAULT_FILES
+
+    guarded = set(DEFAULT_FILES)
+    assert "photon_tpu/serving/router.py" in guarded
+    assert "photon_tpu/serving/transport.py" in guarded
+    assert "photon_tpu/serving/fleet.py" in guarded
+
+
 def test_tile_store_is_guarded():
     """The disk tier of out-of-core GAME rides the default guard set
     (ISSUE 11 satellite): the store is pure host IO by design — a device

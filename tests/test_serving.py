@@ -465,11 +465,16 @@ def test_serve_game_driver_end_to_end(tmp_path):
         "--input", "synthetic-game:40:4:6:4:1:21",
         "--requests", "25",
         "--clients", "3",
+        # The PR 9 stream (consecutive row windows), kept as --traffic
+        # geometric for bench continuity: the scores.txt spot-check below
+        # relies on request windows starting at row 0.
+        "--traffic", "geometric",
         "--max-batch", "32",
         "--max-delay-ms", "1",
         "--output-dir", str(out),
     ]))
     assert summary["requests"] == 25
+    assert summary["served"] == 25 and summary["shed"] == 0
     assert summary["qps"] > 0
     assert summary["latency_p99_ms"] >= summary["latency_p50_ms"]
     scores = np.loadtxt(str(out / "scores.txt"))
@@ -594,6 +599,65 @@ def test_swap_model_mid_closed_loop_no_dropped_requests():
     assert _counter_total(session, "serving.swaps") == 1
 
 
+def test_swap_model_grown_vocabulary_within_capacity():
+    """Satellite (ISSUE 12): the serving tables carry amortized-doubling
+    capacity headroom and a MOVABLE zero-row index, so a model whose grown
+    vocabulary still fits the served capacity hot-swaps in place — zero
+    recompiles, the new entity scores its own (non-zero) row, and it is no
+    longer counted cold."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    model, data = _fixture(seed=41)
+    session = TelemetrySession("test-grow-swap")
+    scorer = GameScorer(
+        model, request_spec=request_spec_for_dataset(model, data),
+        max_batch=16, telemetry=session,
+    ).warmup()
+    compiled = scorer.compilations
+    per_entity = model.coordinates["per_entity"]
+    new_key = np.asarray([10_000], per_entity.keys.dtype)
+    grown = per_entity.with_entities(
+        np.unique(np.concatenate([per_entity.keys, new_key]))
+    )
+    # Give the onboarded entity a real (non-zero) coefficient row so its
+    # served margin is distinguishable from the cold fallback.
+    new_idx = int(np.searchsorted(grown.keys, new_key[0]))
+    new_row = np.arange(1, grown.dim + 1, dtype=np.float32)
+    grown = dataclasses.replace(
+        grown, table=jnp.asarray(grown.table).at[new_idx].set(new_row)
+    )
+    bigger = GameModel(
+        coordinates={**model.coordinates, "per_entity": grown},
+        task_type=model.task_type,
+    )
+    scorer.swap_model(bigger)
+
+    x_fixed = data.shards["global"].x[:2]
+    x_rand = data.shards["re0"].x[:2]
+    cold_before = _counter_total(session, "serving.cold_entities")
+    got = scorer.score_batch(ScoringRequest(
+        features={"global": x_fixed, "re0": x_rand},
+        entity_ids={"re0": np.asarray(
+            [10_000, 999_999], per_entity.keys.dtype
+        )},
+    ))
+    fixed_only = x_fixed @ np.asarray(
+        model.coordinates["fixed"].coefficients.means
+    )
+    np.testing.assert_allclose(
+        got, fixed_only + np.array([x_rand[0] @ new_row, 0.0]),
+        rtol=1e-4, atol=1e-4,
+    )
+    # The grown entity is served (not cold); the truly unknown one still
+    # rides the (moved) zero row and counts.
+    assert _counter_total(session, "serving.cold_entities") == \
+        cold_before + 1
+    assert scorer.compilations == compiled
+    assert _counter_total(session, "serving.swaps") == 1
+
+
 def test_swap_model_rejects_layout_changes():
     model, data = _fixture(seed=41)
     scorer = GameScorer(
@@ -601,15 +665,26 @@ def test_swap_model_rejects_layout_changes():
         max_batch=16,
     ).warmup()
     per_entity = model.coordinates["per_entity"]
-    # A grown vocabulary changes the zero-row index baked into the
-    # compiled programs: swap must refuse (rebuild instead).
+    # Growth PAST the table capacity is a layout-shape change: the compiled
+    # programs' gather-table shape would have to grow — refuse (rebuild).
+    capacity = 1
+    while capacity < per_entity.num_entities + 1:
+        capacity *= 2
+    extra = np.arange(
+        20_000, 20_000 + capacity, dtype=per_entity.keys.dtype
+    )
     grown = per_entity.with_entities(
-        np.unique(np.concatenate([per_entity.keys,
-                                  np.asarray(["zz-new-entity"])]))
+        np.unique(np.concatenate([per_entity.keys, extra]))
     )
     bigger = GameModel(
         coordinates={**model.coordinates, "per_entity": grown},
         task_type=model.task_type,
     )
-    with pytest.raises(ValueError, match="swap_model"):
+    with pytest.raises(ValueError, match="layout-shape change"):
         scorer.swap_model(bigger)
+    # A changed coordinate SET refuses too (plan mismatch).
+    with pytest.raises(ValueError, match="swap_model"):
+        scorer.swap_model(GameModel(
+            coordinates={"fixed": model.coordinates["fixed"]},
+            task_type=model.task_type,
+        ))

@@ -66,6 +66,14 @@ DEFAULT_FILES = (
     # per-request round-trip the latency budget cannot absorb.
     "photon_tpu/serving/scorer.py",
     "photon_tpu/serving/batcher.py",
+    # The fleet tier above the scorer: the router moves requests between
+    # host queues (its only sanctioned fetches are the explicit parity
+    # oracle), the transport is pure wire/host IO, and the fleet assembly
+    # never touches device data at all.  A d2h anywhere here would add a
+    # per-request round-trip the serving latency budget cannot absorb.
+    "photon_tpu/serving/router.py",
+    "photon_tpu/serving/transport.py",
+    "photon_tpu/serving/fleet.py",
 )
 
 SYNC_PATTERN = re.compile(
