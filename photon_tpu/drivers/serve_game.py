@@ -77,6 +77,18 @@ def build_parser() -> argparse.ArgumentParser:
                    default="inproc",
                    help="inproc: submit straight to the router; tcp: "
                    "serve over the loopback socket ingest")
+    p.add_argument("--replica-backend", choices=("thread", "subprocess"),
+                   default="thread",
+                   help="replica runtime: threads in this process, or one "
+                   "child process per replica (own Python/jax runtime, "
+                   "frame protocol over loopback, devices dealt per child)")
+    p.add_argument("--supervise", action="store_true",
+                   help="attach the self-healing supervisor: health probes "
+                   "(ping + known-answer score vs the host oracle), "
+                   "crash/hang detection, backed-off resurrection with "
+                   "canary-gated rejoin, flap quarantine")
+    p.add_argument("--probe-interval-ms", type=float, default=500.0,
+                   help="supervisor health-probe interval")
     p.add_argument("--deadline-ms", type=float, default=0.0,
                    help="per-request deadline budget; 0 disables "
                    "admission shedding")
@@ -178,14 +190,25 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         fleet = ServingFleet(
             model,
             replicas=args.replicas,
+            backend=args.replica_backend,
             request_spec=request_spec_for_dataset(model, data),
             max_batch=args.max_batch,
             max_delay_s=args.max_delay_ms / 1000.0,
             telemetry=session,
             admission=AdmissionPolicy(default_deadline_s=deadline_s),
         ).warmup()
-        logger.info("fleet warm: %d replicas, %d programs compiled",
-                    args.replicas, fleet.compilations)
+        if args.supervise:
+            from photon_tpu.serving import SupervisorPolicy
+
+            fleet.supervise(
+                SupervisorPolicy(
+                    probe_interval_s=args.probe_interval_ms / 1000.0
+                ),
+                logger=logger,
+            )
+        logger.info("fleet warm: %d %s replicas, %d programs compiled%s",
+                    args.replicas, args.replica_backend, fleet.compilations,
+                    ", supervised" if args.supervise else "")
 
     spec = TrafficSpec(
         requests=args.requests,
@@ -252,11 +275,14 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         session, logger,
     )
 
-    cold = sum(
-        m["value"]
-        for m in session.registry.snapshot().get("counters", [])
-        if m["name"] == "serving.cold_entities"
-    ) if session.enabled else 0
+    def _counter(name):
+        return sum(
+            m["value"]
+            for m in session.registry.snapshot().get("counters", [])
+            if m["name"] == name
+        ) if session.enabled else 0
+
+    cold = _counter("serving.cold_entities")
     summary = {
         "requests": len(outcomes),
         "served": len(ok),
@@ -272,6 +298,11 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         "cold_entities": int(cold),
         "compiled_programs": fleet.compilations,
         "replicas": args.replicas,
+        "replica_backend": args.replica_backend,
+        "supervised": bool(args.supervise),
+        "replica_deaths": int(_counter("serving.replica_deaths")),
+        "resurrections": int(_counter("serving.replica_resurrections")),
+        "quarantined": int(_counter("serving.replica_quarantined")),
         "transport": args.transport,
         "traffic": args.traffic,
         "deadline_ms": args.deadline_ms,

@@ -111,6 +111,17 @@ KNOWN_FAULT_SITES = {
     "transport:read": "transient IOError at a serving-transport frame "
                       "read (retriable: the client reconnects and "
                       "resends — scoring is idempotent)",
+    "replica:crash": "hard-exit a serving replica's backing runtime "
+                     "(a subprocess child os._exit()s; a thread replica "
+                     "latches dead mid-batch) — the supervisor detects "
+                     "the crash and resurrects",
+    "replica:hang": "wedge a serving replica's scoring path without "
+                    "failing it (consumed, not raised: the batch/child "
+                    "sleeps) — detection must come from the supervisor's "
+                    "probe deadline, exactly like a real hang",
+    "replica:spawn": "transient failure spawning/respawning a serving "
+                     "replica (retriable: the supervisor retries with "
+                     "capped exponential backoff)",
 }
 
 
@@ -284,8 +295,8 @@ def fault_point(site: str, **ctx) -> None:
     if rule is None:
         return
     scope, _, action = site.partition(":")
-    if action.endswith("kill") or site in ("checkpoint:write",
-                                           "checkpoint:stage"):
+    if action.endswith(("kill", "crash")) or site in ("checkpoint:write",
+                                                      "checkpoint:stage"):
         raise InjectedKillError(f"injected kill at {site} ({ctx or rule.params})")
     raise InjectedIOError(f"injected IO fault at {site} ({ctx or rule.params})")
 
@@ -297,3 +308,15 @@ def consume_nan_injection(coordinate: Optional[str]) -> bool:
     if plan is None or coordinate is None:
         return False
     return plan.consume("solve:nan", coordinate=coordinate) is not None
+
+
+def consume_hang_injection(replica: Optional[str]) -> bool:
+    """True when the plan wants this serving replica's path to WEDGE (site
+    ``replica:hang:replica=<id>`` — the probe-timeout leg): the consumer
+    simulates the hang (a wedged batch, a sleeping child) instead of
+    raising, so detection has to come from the supervisor's probe deadline
+    exactly as it would for a real hang; consumes one fire."""
+    plan = active_plan()
+    if plan is None or replica is None:
+        return False
+    return plan.consume("replica:hang", replica=str(replica)) is not None

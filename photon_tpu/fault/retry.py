@@ -56,11 +56,18 @@ class RetryPolicy:
     retry_on: Tuple[Type[BaseException], ...] = (OSError,)
     stall_timeout_s: float = 0.0
 
-    def delay(self, attempt: int, rng: random.Random) -> float:
+    def delay(self, attempt: int, rng: Optional[random.Random] = None) -> float:
         """Backoff before retry ``attempt`` (0-based): exponential, capped,
-        with up to ``jitter`` fractional noise on top."""
-        base = min(self.max_delay_s, self.base_delay_s * (2.0 ** attempt))
-        return base * (1.0 + self.jitter * rng.random())
+        with up to ``jitter`` fractional noise on top.  ``rng`` defaults to
+        the module RNG so out-of-loop callers (the fleet supervisor's
+        respawn backoff) can reuse the one backoff shape."""
+        # min(attempt, 62): 2.0**attempt overflows float range past ~1024
+        # attempts (long-lived callers like the supervisor's respawn loop);
+        # the cap is far past where max_delay_s saturates anyway.
+        base = min(self.max_delay_s,
+                   self.base_delay_s * (2.0 ** min(attempt, 62)))
+        roll = rng.random() if rng is not None else random.random()
+        return base * (1.0 + self.jitter * roll)
 
 
 def default_policy() -> RetryPolicy:

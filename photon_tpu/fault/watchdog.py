@@ -84,6 +84,16 @@ def progress_ages() -> Dict[str, float]:
         return {name: now - t for name, t in _beats.items()}
 
 
+def age_of(name: str) -> Optional[float]:
+    """Seconds since ``name``'s last heartbeat, or None when the site has
+    no live heartbeat (never marked, or retired by :func:`complete`).  The
+    fleet supervisor's hang check reads single replica sites through this
+    instead of snapshotting the whole table every probe."""
+    with _beats_lock:
+        t = _beats.get(name)
+    return None if t is None else time.monotonic() - t
+
+
 def clear_heartbeats() -> None:
     """Drop all recorded heartbeats (run scoped: a finished run's stale
     sites must not look stalled to the next run's watchdog)."""

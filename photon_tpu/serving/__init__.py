@@ -28,6 +28,16 @@ serving (PAPERS.md, 1803.06333) and DrJAX's keep-everything-in-jit idiom
   replayable generated traffic (:mod:`photon_tpu.serving.traffic`:
   power-law popularity, diurnal ramps, cold-start storms) and canary
   ``swap_model`` rollout with mirrored-traffic parity probes.
+- The SELF-HEALING tier (ISSUE 13): ``ServingFleet(backend="subprocess")``
+  runs each replica as a child process with its own Python/jax runtime
+  (:mod:`photon_tpu.serving.replica_proc` — shared wire-format model
+  artifact, frame protocol over loopback, per-child device deal), and
+  :class:`~photon_tpu.serving.supervisor.ReplicaSupervisor`
+  (``fleet.supervise()``) closes the availability loop: health probes
+  (exit codes, heartbeat hangs, ping deadlines, known-answer scores vs
+  the host oracle), backed-off resurrection whose rejoin is gated by
+  mirrored-traffic parity probes against the CURRENT model, and
+  permanent quarantine for flapping replicas.
 
 The batch scoring driver (``drivers/score_game``, non-streamed) routes
 through the same :class:`GameScorer` gather-table build, so the online and
@@ -41,6 +51,17 @@ from photon_tpu.serving.batcher import (  # noqa: F401
     run_closed_loop,
 )
 from photon_tpu.serving.fleet import ServingFleet  # noqa: F401
+from photon_tpu.serving.replica_proc import (  # noqa: F401
+    ModelStore,
+    ReplicaSpawnError,
+    SubprocessReplica,
+)
+from photon_tpu.serving.supervisor import (  # noqa: F401
+    RejoinParityError,
+    ReplicaSupervisor,
+    SupervisorPolicy,
+    probe_request_for,
+)
 from photon_tpu.serving.router import (  # noqa: F401
     AdmissionPolicy,
     FleetRouter,
@@ -72,6 +93,7 @@ from photon_tpu.serving.traffic import (  # noqa: F401
     run_closed_loop_outcomes,
 )
 from photon_tpu.serving.transport import (  # noqa: F401
+    AsyncScoringClient,
     ScoringClient,
     ScoringServer,
     TransportError,

@@ -22,26 +22,52 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import List, Optional
 
 from photon_tpu.serving.scorer import (
     GameScorer,
     ScoringRequest,
     concat_requests,
+    padded_cost,
 )
 
 DEFAULT_MAX_DELAY_S = 0.002
 
 
-class _Pending:
-    __slots__ = ("request", "future", "enqueued", "rows")
+def resolve_once(future: Future, value=None,
+                 exc: Optional[BaseException] = None) -> None:
+    """Resolve a pending future exactly once — the shared guard for every
+    path where two resolvers can race the same future: a future abandoned
+    by the supervisor (a hung replica torn down mid-batch) may already
+    carry its ReplicaDeadError when the wedged batcher thread finally
+    comes back, and an async transport future can be failed by the
+    submit-side send error, the reader's decode, and the dead-connection
+    sweep.  The loser's write must be a no-op, not an InvalidStateError
+    that kills the resolving thread."""
+    try:
+        if future.cancelled():
+            return
+        if exc is not None:
+            future.set_exception(exc)
+        else:
+            future.set_result(value)
+    except InvalidStateError:
+        pass
 
-    def __init__(self, request: ScoringRequest):
+
+_resolve = resolve_once  # internal alias for the call sites below
+
+
+class _Pending:
+    __slots__ = ("request", "future", "enqueued", "rows", "padded")
+
+    def __init__(self, request: ScoringRequest, padded: int):
         self.request = request
         self.future: Future = Future()
         self.enqueued = time.monotonic()
         self.rows = request.num_rows
+        self.padded = padded
 
 
 class RequestBatcher:
@@ -72,23 +98,34 @@ class RequestBatcher:
         # Rows accepted but not yet resolved (queued + in the batch being
         # scored): the queue-depth signal the fleet router dispatches and
         # sheds on.  Kept under the SAME lock as the queue so a router
-        # reading depth mid-submit can never see a torn count.
+        # reading depth mid-submit can never see a torn count.  The padded
+        # twin charges each request at its bucket-ladder cost — the unit
+        # the admission projection estimates wait in.
         self._inflight_rows = 0
+        self._inflight_padded = 0
+        self._current: List[_Pending] = []
         self._thread = threading.Thread(
             target=self._loop, name="serving-batcher", daemon=True
         )
         self._thread.start()
 
+    def _padded_cost(self, n: int) -> int:
+        try:
+            return padded_cost(n, self.scorer.buckets)
+        except Exception:  # a scorer stub without a ladder: raw rows
+            return int(n)
+
     # -- caller side ---------------------------------------------------------
     def submit(self, request: ScoringRequest) -> Future:
         """Enqueue one request; the returned future resolves to its ``[n]``
         float32 scores (or raises the scorer's failure)."""
-        pending = _Pending(request)
+        pending = _Pending(request, self._padded_cost(request.num_rows))
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is closed")
             self._queue.append(pending)
             self._inflight_rows += pending.rows
+            self._inflight_padded += pending.padded
             self._cond.notify()
         self.telemetry.counter("serving.requests").inc()
         return pending.future
@@ -100,12 +137,38 @@ class RequestBatcher:
         with self._cond:
             return self._inflight_rows
 
+    def pending_padded_rows(self) -> int:
+        """Pending work at its PADDED bucket-ladder cost — the unit the
+        admission projection multiplies by the per-row pace EWMA (padded
+        rows cost compute too; raw rows under-project near saturation)."""
+        with self._cond:
+            return self._inflight_padded
+
     def close(self) -> None:
-        """Drain queued requests (they still get scored) and stop."""
+        """Drain queued requests (they still get scored) and stop.  The
+        join is bounded: a batcher whose scorer is wedged (a hung replica
+        being torn down) must not wedge close() too."""
         with self._cond:
             self._stop = True
             self._cond.notify()
-        self._thread.join()
+        self._thread.join(timeout=10.0)
+
+    def abandon(self, exc: BaseException) -> None:
+        """Fail every pending request — queued AND the batch being scored —
+        with ``exc`` and stop accepting work: the dead/hung-replica
+        teardown.  The router's done-callbacks then reroute each failed
+        future exactly once.  Unlike :meth:`close`, abandon never joins the
+        batcher thread (it may be wedged inside the hung scorer call); the
+        thread is daemonic and its late resolutions are guarded no-ops."""
+        with self._cond:
+            self._stop = True
+            victims = list(self._current) + list(self._queue)
+            self._queue.clear()
+            self._inflight_rows = 0
+            self._inflight_padded = 0
+            self._cond.notify()
+        for p in victims:
+            _resolve(p.future, exc=exc)
 
     def __enter__(self) -> "RequestBatcher":
         return self
@@ -140,11 +203,20 @@ class RequestBatcher:
                     break
                 batch.append(self._queue.popleft())
                 rows += head.rows
+            self._current = batch
             return batch
 
     def _retire(self, batch: List[_Pending]) -> None:
         with self._cond:
-            self._inflight_rows -= sum(p.rows for p in batch)
+            # max(0, …): an abandon() already zeroed the counts (and failed
+            # these futures); the late retire must not drive them negative.
+            self._inflight_rows = max(
+                0, self._inflight_rows - sum(p.rows for p in batch)
+            )
+            self._inflight_padded = max(
+                0, self._inflight_padded - sum(p.padded for p in batch)
+            )
+            self._current = []
 
     def _loop(self) -> None:
         while True:
@@ -157,8 +229,7 @@ class RequestBatcher:
             except BaseException as e:  # surface through every waiter
                 self._retire(batch)
                 for p in batch:
-                    if not p.future.cancelled():
-                        p.future.set_exception(e)
+                    _resolve(p.future, exc=e)
                 continue
             self.telemetry.histogram("serving.coalesced").observe(len(batch))
             self._retire(batch)
@@ -169,8 +240,7 @@ class RequestBatcher:
                 self.telemetry.histogram("serving.request_latency_s").observe(
                     now - p.enqueued
                 )
-                if not p.future.cancelled():
-                    p.future.set_result(scores[lo:hi])
+                _resolve(p.future, scores[lo:hi])
                 lo = hi
 
 

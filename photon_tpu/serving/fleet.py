@@ -28,7 +28,8 @@ fetches device data itself.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Tuple
 
 from photon_tpu.serving.router import (
     AdmissionPolicy,
@@ -41,6 +42,7 @@ from photon_tpu.serving.scorer import (
     GameScorer,
     ScoringRequest,
     ShardSpec,
+    request_spec_for_model,
 )
 from photon_tpu.serving.batcher import DEFAULT_MAX_DELAY_S
 
@@ -73,6 +75,15 @@ class ServingFleet:
     and stamps the per-replica QPS gauges.  ``submit``/``score`` go
     through admission control (``deadline_s`` is a relative budget;
     sheds raise :class:`~photon_tpu.serving.router.RequestShedError`).
+
+    ``backend`` picks the replica runtime: ``"thread"`` (the PR 12 shape —
+    scorers in this process, per-replica sub-meshes via ``devices``) or
+    ``"subprocess"`` (ISSUE 13 — each replica is a CHILD PROCESS with its
+    own Python/jax runtime speaking the frame protocol over loopback,
+    devices dealt per child via ``JAX_PLATFORMS``/visible-device env; the
+    shared model artifact lives under ``workdir``).  ``supervise()``
+    attaches the self-healing supervisor — health probes, canary-gated
+    resurrection, flap quarantine — over either backend.
     """
 
     def __init__(
@@ -81,6 +92,7 @@ class ServingFleet:
         replicas: int = 2,
         mesh=None,
         devices: str = "split",
+        backend: str = "thread",
         request_spec: Optional[Dict[str, ShardSpec]] = None,
         buckets=None,
         max_batch: int = DEFAULT_MAX_BATCH,
@@ -88,32 +100,89 @@ class ServingFleet:
         max_delay_s: float = DEFAULT_MAX_DELAY_S,
         telemetry=None,
         admission: Optional[AdmissionPolicy] = None,
+        workdir: Optional[str] = None,
+        child_env: Optional[Dict[str, str]] = None,
+        spawn_timeout_s: float = 120.0,
     ):
         from photon_tpu.telemetry import NULL_SESSION
 
         if replicas < 1:
             raise ValueError("a fleet needs at least one replica")
+        if backend not in ("thread", "subprocess"):
+            raise ValueError(f"unknown replica backend {backend!r} "
+                             "(thread | subprocess)")
         self.model = model
+        self.backend = backend
         self.telemetry = telemetry or NULL_SESSION
-        meshes = _replica_meshes(int(replicas), mesh, devices)
+        self._model_lock = threading.Lock()
+        self._model_version = 0
+        self._rolling = 0
+        self._supervisor = None
+        self._store = None
+        self._workdir_owned = False
         self.replicas: List[ScorerReplica] = []
-        for i in range(int(replicas)):
-            scorer = GameScorer(
-                model,
-                mesh=meshes[i],
-                request_spec=request_spec,
-                buckets=buckets,
-                max_batch=max_batch,
-                min_bucket=min_bucket,
-                telemetry=self.telemetry,
+        if backend == "subprocess":
+            import tempfile
+
+            from photon_tpu.serving.replica_proc import (
+                ModelStore,
+                SubprocessReplica,
+                child_device_env,
             )
-            self.replicas.append(
-                ScorerReplica(
-                    f"r{i}", scorer,
-                    max_batch=max_batch, max_delay_s=max_delay_s,
+
+            if workdir is None:
+                workdir = tempfile.mkdtemp(prefix="photon-fleet-")
+                self._workdir_owned = True
+            self._store = ModelStore(workdir)
+            self._store.publish(model)  # the v0 shared artifact
+            spec = request_spec or request_spec_for_model(model)
+            try:
+                for i in range(int(replicas)):
+                    env = dict(child_device_env(i, int(replicas)))
+                    env.update(child_env or {})
+                    self.replicas.append(
+                        SubprocessReplica(
+                            f"r{i}", model, self._store,
+                            request_spec=spec, buckets=buckets,
+                            max_batch=max_batch, min_bucket=min_bucket,
+                            max_delay_s=max_delay_s,
+                            telemetry=self.telemetry,
+                            child_env=env, spawn_timeout_s=spawn_timeout_s,
+                        )
+                    )
+            except BaseException:
+                # Partial-spawn failure: a half-built fleet has no close()
+                # caller — reap the children already spawned and the owned
+                # workdir here, or they leak past the raised error.
+                for replica in self.replicas:
+                    try:
+                        replica.close()
+                    except Exception:  # noqa: BLE001 — best-effort reap
+                        pass
+                if self._workdir_owned:
+                    import shutil
+
+                    shutil.rmtree(workdir, ignore_errors=True)
+                raise
+        else:
+            meshes = _replica_meshes(int(replicas), mesh, devices)
+            for i in range(int(replicas)):
+                scorer = GameScorer(
+                    model,
+                    mesh=meshes[i],
+                    request_spec=request_spec,
+                    buckets=buckets,
+                    max_batch=max_batch,
+                    min_bucket=min_bucket,
                     telemetry=self.telemetry,
                 )
-            )
+                self.replicas.append(
+                    ScorerReplica(
+                        f"r{i}", scorer,
+                        max_batch=max_batch, max_delay_s=max_delay_s,
+                        telemetry=self.telemetry,
+                    )
+                )
         self.router = FleetRouter(
             self.replicas, telemetry=self.telemetry, admission=admission
         )
@@ -155,11 +224,70 @@ class ServingFleet:
               deadline_s: Optional[float] = None):
         return self.submit(request, deadline_s=deadline_s).result()
 
+    def current_model(self) -> Tuple[object, int]:
+        """The model the fleet serves NOW and its monotonic version — the
+        supervisor's resurrection target (a replica resurrected
+        mid-rollout re-syncs against this, never the model it died on)."""
+        with self._model_lock:
+            return self.model, self._model_version
+
     def rollout(self, model, **kwargs) -> None:
         """Staggered/canary ``swap_model`` across the fleet (see
-        :meth:`photon_tpu.serving.router.FleetRouter.rollout`)."""
-        self.router.rollout(model, **kwargs)
-        self.model = model
+        :meth:`photon_tpu.serving.router.FleetRouter.rollout`).
+
+        The fleet's (model, version) is published BEFORE the router
+        rollout runs and rolled back if it fails: a resurrection that
+        completes while the rollout is in flight must target the model
+        the fleet is converging TO — publishing only on return would let
+        a replica rejoin on the old model mid-promotion and leave the
+        fleet split until the next parity probe killed it again.  (If the
+        rollout aborts, a replica resurrected against the new model fails
+        its next known-answer probe and is re-resurrected on the restored
+        one — the rare-path analog of the same self-healing loop.)"""
+        with self._model_lock:
+            previous_model = self.model
+            self.model = model
+            self._model_version += 1
+            self._rolling += 1
+        try:
+            self.router.rollout(model, **kwargs)
+        except BaseException:
+            with self._model_lock:
+                self.model = previous_model
+                # The version stays MONOTONIC: the rollback is itself a
+                # new published state.  Restoring the old number would
+                # let a later rollout reuse it and defeat the
+                # supervisor's stale-oracle version check.
+                self._model_version += 1
+            raise
+        finally:
+            with self._model_lock:
+                self._rolling -= 1
+
+    def rollout_in_progress(self) -> bool:
+        """True while a staggered rollout is mid-flight — the window in
+        which different replicas legitimately serve different versions,
+        so the supervisor must not read a known-answer parity mismatch
+        as a replica fault."""
+        with self._model_lock:
+            return self._rolling > 0
+
+    def supervise(self, policy=None, logger=None, start: bool = True):
+        """Attach the self-healing supervisor (health probes, canary-gated
+        resurrection, flap quarantine); returns the
+        :class:`~photon_tpu.serving.supervisor.ReplicaSupervisor`.  With
+        ``start=False`` the supervisor is built but not threaded — tests
+        drive ``check_once()`` deterministically."""
+        from photon_tpu.serving.supervisor import ReplicaSupervisor
+
+        if self._supervisor is not None:
+            raise RuntimeError("fleet already supervised")
+        self._supervisor = ReplicaSupervisor(
+            self, policy=policy, telemetry=self.telemetry, logger=logger
+        )
+        if start:
+            self._supervisor.start()
+        return self._supervisor
 
     # -- transport -----------------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0):
@@ -177,10 +305,20 @@ class ServingFleet:
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:
+        # The supervisor stops FIRST: a teardown must not race a
+        # resurrection re-spawning the replicas being closed.
+        if self._supervisor is not None:
+            self._supervisor.stop()
+            self._supervisor = None
         if self._server is not None:
             self._server.close()
             self._server = None
         self.router.close()
+        if self._workdir_owned and self._store is not None:
+            import shutil
+
+            shutil.rmtree(self._store.workdir, ignore_errors=True)
+            self._store = None
 
     def __enter__(self) -> "ServingFleet":
         return self

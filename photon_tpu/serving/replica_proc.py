@@ -1,0 +1,712 @@
+"""Process-backed serving replicas: own runtime, frame protocol, respawn.
+
+PR 12's replicas are threads sharing one Python runtime — "replica
+isolation" there is an honest fiction (one GIL, one jax runtime, one
+process to crash).  This module makes it real (the ISSUE 13 tentpole;
+Snap ML's hierarchy — node-level processes each owning their device set,
+supervised from above — is the shape, PAPERS.md 1803.06333):
+
+- **The child** (``python -m photon_tpu.serving.replica_proc``) is a full
+  replica runtime: it loads the shared model ARTIFACT (the wire-format
+  model file every replica of a fleet reads), builds its own
+  :class:`~photon_tpu.serving.scorer.GameScorer`, AOT-warms the bucket
+  ladder, then serves the PR 12 length-prefixed frame protocol on a
+  loopback socket — ``score`` frames on the data connection, plus the
+  supervision vocabulary on a control connection: ``ping``/``pong``
+  (liveness), ``swap`` (hot-swap to a newer model artifact, zero child
+  recompiles — the scorer's capacity-headroom swap), ``shutdown``.
+  Device ownership comes from the environment the parent deals each child
+  (``JAX_PLATFORMS`` + visible-device vars): on a multi-core/multi-device
+  host each child owns its runtime and its devices; on the 1-core CPU
+  fixture children share the core (the PR 12 honest-scaling bar applies).
+- **The parent side** (:class:`SubprocessReplica`) is a drop-in
+  :class:`~photon_tpu.serving.router.ScorerReplica`: the router's
+  batcher coalesces requests exactly as for a thread replica, and the
+  replica's "scorer" (:class:`_RemoteScorer`) exchanges each micro-batch
+  as one frame on the data connection.  A dropped connection mid-batch is
+  the crash signal: the batch raises
+  :class:`~photon_tpu.serving.router.ReplicaDeadError` and the router
+  reroutes it exactly-once — the same path an injected
+  ``serve:replica_kill`` takes.
+- **Fault surface**: ``replica:spawn`` fires at the top of every (re)spawn
+  (retriable — the supervisor backs off and retries); ``replica:crash``
+  consumed INSIDE the child hard-exits it (``os._exit``), a real crash
+  with a real exit code; ``replica:hang`` consumed in the child wedges the
+  handler, a real hang only the supervisor's probe deadline can see.
+
+Residency contract (``tools/check_host_sync.py`` guards this module): the
+parent side is pure host IO (frames, numpy); the one sanctioned fetch is
+the artifact publish, which serializes the model tables to host once per
+published version.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from photon_tpu.fault.injection import (
+    InjectedKillError,
+    consume_hang_injection,
+    fault_point,
+)
+from photon_tpu.serving.router import (
+    ReplicaDeadError,
+    ScorerReplica,
+)
+from photon_tpu.serving.scorer import (
+    ShardSpec,
+    bucket_ladder,
+    padded_cost,
+)
+from photon_tpu.serving.transport import (
+    TransportError,
+    pack_control,
+    pack_error,
+    pack_request,
+    pack_scores,
+    payload_kind,
+    read_frame,
+    unpack_control,
+    unpack_request,
+    unpack_response,
+    write_frame,
+    _pack,
+    _unpack,
+)
+
+ARTIFACT_VERSION = 1
+CRASH_EXIT_CODE = 86  # the child's injected-crash exit status
+
+
+class ReplicaSpawnError(OSError):
+    """Spawning a replica child failed (an ``OSError``: the supervisor's
+    backoff-and-retry policy applies to a failed spawn exactly as the
+    retry layer's does to failed IO)."""
+
+
+# -- model wire artifact -------------------------------------------------------
+#
+# The shared model artifact every child loads (at boot and at swap) is ONE
+# frame payload — the same header + array-manifest wire format the scoring
+# protocol uses, so a model travels exactly like a request: fixed
+# coordinates carry their coefficient vector, random coordinates their
+# [entities, dim] table and sorted key vocabulary (string keys ride as
+# their <U* buffers like any id column).  Serving needs means only; the
+# artifact deliberately drops variances.
+
+
+def pack_model(model, version: int) -> bytes:
+    """One GAME model as a wire payload (the shared serving artifact)."""
+    from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
+
+    entries = []
+    meta = []
+    for name, coord in model.coordinates.items():
+        if isinstance(coord, FixedEffectModel):
+            meta.append({"name": name, "kind": "fixed",
+                         "shard": coord.shard_name,
+                         "task": coord.model.task_type})
+            entries.append(
+                ("coef", name,
+                 # host-sync: artifact publish — the coefficient vector is
+                 # fetched to host once per published model version.
+                 np.asarray(coord.coefficients.means, np.float32))
+            )
+        elif isinstance(coord, RandomEffectModel):
+            meta.append({"name": name, "kind": "random",
+                         "shard": coord.shard_name,
+                         "column": coord.entity_column,
+                         "task": coord.task_type})
+            # host-sync: artifact publish — the per-entity table is fetched
+            # to host once per published model version.
+            entries.append(("table", name, np.asarray(coord.table,
+                                                      np.float32)))
+            # host-sync: keys are host numpy by construction (publish-time).
+            entries.append(("keys", name, np.asarray(coord.keys)))
+        else:
+            raise TypeError(f"cannot publish a {type(coord).__name__}")
+    return _pack({
+        "v": ARTIFACT_VERSION, "kind": "model",
+        "task": model.task_type, "version": int(version), "coords": meta,
+        "_arrays": entries,
+    })
+
+
+def unpack_model(payload: bytes):
+    """``(GameModel, version)`` from a model artifact payload."""
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, model_for_task
+
+    header, arrays = _unpack(payload)
+    if header.get("kind") != "model":
+        raise TransportError(
+            f"unexpected artifact kind {header.get('kind')!r}"
+        )
+    slots: Dict[Tuple[str, str], np.ndarray] = {}
+    for entry, arr in zip(header.get("arrays", []), arrays):
+        slots[(entry["slot"], entry["name"])] = arr
+    coordinates = {}
+    for meta in header["coords"]:
+        name = meta["name"]
+        if meta["kind"] == "fixed":
+            coordinates[name] = FixedEffectModel(
+                model_for_task(
+                    meta["task"], Coefficients(slots[("coef", name)])
+                ),
+                meta["shard"],
+            )
+        else:
+            coordinates[name] = RandomEffectModel(
+                table=slots[("table", name)],
+                keys=slots[("keys", name)],
+                entity_column=meta["column"],
+                shard_name=meta["shard"],
+                task_type=meta["task"],
+            )
+    model = GameModel(coordinates=coordinates, task_type=header["task"])
+    return model, int(header.get("version", 0))
+
+
+def save_model_artifact(path: str, model, version: int) -> None:
+    """Atomic artifact publish: temp + fsync + rename, so a reader (a
+    booting child) sees the previous complete artifact or the new one."""
+    payload = pack_model(model, version)
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+        dir=os.path.dirname(path) or ".",
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_model_artifact(path: str, telemetry=None):
+    """``(GameModel, version)`` from an artifact file (retried like any
+    guarded model load)."""
+    from photon_tpu.fault.retry import retry_call
+
+    def attempt():
+        with open(path, "rb") as f:
+            return f.read()
+
+    return unpack_model(
+        retry_call(attempt, site="model:load", telemetry=telemetry)
+    )
+
+
+class ModelStore:
+    """Versioned shared model artifacts under one fleet workdir.
+
+    ``publish()`` writes the wire-format artifact ONCE per model object
+    (cached by identity, with a strong reference so the cache key cannot
+    be recycled) and returns its path+version; every child — at boot, at
+    swap, at respawn — loads from the same file: the shared-model-artifact
+    distribution the fleet tier is built on.
+
+    Only the newest ``keep`` versions stay cached (default 2: the served
+    model plus its predecessor, which an in-flight swap/rollback may
+    still reference) — a long-running fleet rolling models out
+    periodically must not grow host memory and workdir disk by one full
+    table set per rollout forever.  Re-publishing an evicted model (a
+    deep rollback) simply writes it again under a fresh version."""
+
+    def __init__(self, workdir: str, keep: int = 2):
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.keep = max(1, int(keep))
+        self._lock = threading.Lock()
+        self._published = []  # [(model, path, version)] — strong refs
+        self._next = 0
+
+    def publish(self, model) -> Tuple[str, int]:
+        with self._lock:
+            for m, path, version in self._published:
+                if m is model:
+                    return path, version
+            version = self._next
+            self._next += 1
+            path = os.path.join(self.workdir, f"model-v{version:06d}.bin")
+            save_model_artifact(path, model, version)
+            self._published.append((model, path, version))
+            while len(self._published) > self.keep:
+                _, old_path, _ = self._published.pop(0)
+                try:
+                    os.unlink(old_path)
+                except OSError:
+                    pass
+            return path, version
+
+
+# -- the child runtime ---------------------------------------------------------
+
+
+class _ChildService:
+    """The replica child's state: one scorer (+ artifact version) behind a
+    lock so a ``swap`` and a concurrent ``score`` can never interleave a
+    half-published model (the scorer's own one-assignment publication does
+    the real work; the lock only orders version bookkeeping)."""
+
+    def __init__(self, replica_id: str, scorer, version: int):
+        self.replica_id = replica_id
+        self.scorer = scorer
+        self.version = version
+        self.lock = threading.Lock()
+
+    def maybe_fault(self) -> None:
+        """The child-side fault surface: an injected ``replica:crash``
+        HARD-EXITS the child (a real crash with a real exit code — the
+        supervisor sees it via ``poll_exit``/the dropped connection), an
+        injected ``replica:hang`` wedges this handler thread (a real hang
+        only the probe deadline can see; the supervisor kills the child)."""
+        try:
+            fault_point("replica:crash", replica=self.replica_id)
+        except InjectedKillError:
+            os._exit(CRASH_EXIT_CODE)
+        if consume_hang_injection(self.replica_id):
+            time.sleep(3600.0)
+
+    def handle(self, sock: socket.socket, shutdown) -> None:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                payload = read_frame(sock)
+            except (OSError, TransportError):
+                return
+            kind = payload_kind(payload)
+            try:
+                if kind == "score":
+                    self.maybe_fault()
+                    request, _ = unpack_request(payload)
+                    out = pack_scores(self.scorer.score_batch(request))
+                elif kind == "ping":
+                    self.maybe_fault()
+                    out = pack_control(
+                        "pong", version=self.version, pid=os.getpid(),
+                        compilations=self.scorer.compilations,
+                    )
+                elif kind == "swap":
+                    header = unpack_control(payload)
+                    model, version = load_model_artifact(header["path"])
+                    with self.lock:
+                        self.scorer.swap_model(model)
+                        self.version = version
+                    out = pack_control("ok", version=version)
+                elif kind == "shutdown":
+                    out = pack_control("ok")
+                    try:
+                        write_frame(sock, out)
+                    except OSError:
+                        pass
+                    shutdown()
+                    return
+                else:
+                    out = pack_error(f"unknown frame kind {kind!r}")
+            except BaseException as e:  # surfaced as a typed frame
+                out = pack_error(f"{type(e).__name__}: {e}")
+            try:
+                write_frame(sock, out)
+            except OSError:
+                return
+
+
+def _child_main(argv=None) -> None:
+    import argparse
+
+    import socketserver
+
+    p = argparse.ArgumentParser("photon_tpu.serving.replica_proc")
+    p.add_argument("--artifact", required=True)
+    p.add_argument("--ready-file", required=True)
+    p.add_argument("--config", required=True, help="JSON replica config")
+    args = p.parse_args(argv)
+    cfg = json.loads(args.config)
+
+    # Parent-death watchdog: the parent holds our stdin pipe open for our
+    # whole life and never writes to it — EOF means the parent is GONE
+    # (crashed, SIGKILLed, or torn down racing a respawn), and an orphaned
+    # replica serving nobody forever is a resource leak, not availability.
+    def watch_parent():
+        try:
+            sys.stdin.buffer.read()
+        except Exception:  # noqa: BLE001 — any stdin failure == orphaned
+            pass
+        os._exit(0)
+
+    threading.Thread(target=watch_parent, name="parent-watch",
+                     daemon=True).start()
+
+    from photon_tpu.serving.scorer import GameScorer
+
+    model, version = load_model_artifact(args.artifact)
+    spec = {
+        shard: ShardSpec(kind=s["kind"], dim=int(s["dim"]),
+                         nnz=int(s.get("nnz", 0)))
+        for shard, s in cfg["spec"].items()
+    }
+    scorer = GameScorer(
+        model,
+        request_spec=spec,
+        buckets=tuple(cfg["buckets"]) if cfg.get("buckets") else None,
+        max_batch=int(cfg["max_batch"]),
+        min_bucket=int(cfg["min_bucket"]),
+    ).warmup()
+    service = _ChildService(cfg["replica_id"], scorer, version)
+
+    class _Handler(socketserver.BaseRequestHandler):
+        def handle(self):  # noqa: D102 — per-connection loop
+            service.handle(self.request, shutdown)
+
+    class _Server(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    server = _Server(("127.0.0.1", 0), _Handler)
+
+    def shutdown():
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    # Atomic readiness handshake: the parent polls for this file.
+    ready = {
+        "port": server.server_address[1],
+        "pid": os.getpid(),
+        "version": version,
+        "compilations": scorer.compilations,
+    }
+    tmp = args.ready_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ready, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, args.ready_file)
+    server.serve_forever()
+    server.server_close()
+
+
+# -- the parent side -----------------------------------------------------------
+
+
+def child_device_env(index: int, n_replicas: int) -> Dict[str, str]:
+    """The per-child device deal: each child pins the parent's platform via
+    ``JAX_PLATFORMS`` and, on device-backed platforms, owns a round-robin
+    slice of the visible devices — process-level replica isolation with
+    real per-replica device ownership.  The slice is cut from the
+    PARENT'S OWN visibility mask when one is set (``CUDA_VISIBLE_DEVICES=
+    2,3`` must deal ``2``/``3`` to the children, never absolute ids the
+    job was fenced away from).  On CPU there is nothing to deal (children
+    share the host's cores; the honest 1-core bar applies)."""
+    import jax
+
+    platform = jax.default_backend()
+    env = {"JAX_PLATFORMS": platform}
+    if platform in ("gpu", "cuda", "rocm", "tpu"):
+        var = ("TPU_VISIBLE_DEVICES" if platform == "tpu"
+               else "CUDA_VISIBLE_DEVICES")
+        mask = os.environ.get(var, "").strip()
+        if mask:
+            ids = [t.strip() for t in mask.split(",") if t.strip()]
+        else:
+            ids = [str(i) for i in range(jax.local_device_count())]
+        mine = ids[index % len(ids):: n_replicas] or [ids[index % len(ids)]]
+        env[var] = ",".join(mine)
+    return env
+
+
+class _RemoteScorer:
+    """Parent-side facade of a child's scorer: mirrors the GameScorer
+    surface the replica/batcher/router layers touch (bucket ladder, model,
+    compilations, warmup, swap) while ``score_batch`` is one frame
+    exchange on the data connection.  A dropped/reset connection raises
+    :class:`ReplicaDeadError` — the crash signal the router reroutes on."""
+
+    def __init__(self, replica_id: str, model, version: int,
+                 store: ModelStore, request_spec: Dict[str, ShardSpec],
+                 buckets, max_batch: int, min_bucket: int,
+                 port: int, compilations: int, telemetry=None,
+                 timeout_s: float = 300.0):
+        from photon_tpu.telemetry import NULL_SESSION
+
+        self.replica_id = replica_id
+        self.model = model
+        self.version = version
+        self.request_spec = request_spec
+        self.buckets = bucket_ladder(buckets, max_batch, min_bucket)
+        self.max_bucket = self.buckets[-1]
+        self.compilations = int(compilations)
+        self.telemetry = telemetry or NULL_SESSION
+        self._store = store
+        self._data_lock = threading.Lock()
+        self._ctrl_lock = threading.Lock()
+        self._data = self._connect(port, timeout_s)
+        self._ctrl = self._connect(port, timeout_s)
+
+    @staticmethod
+    def _connect(port: int, timeout_s: float) -> socket.socket:
+        sock = socket.create_connection(("127.0.0.1", port),
+                                        timeout=timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    # -- GameScorer surface ---------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch of {n} rows exceeds max bucket "
+                         f"{self.max_bucket}")
+
+    def padded_rows(self, n: int) -> int:
+        return padded_cost(n, self.buckets)
+
+    def warmup(self) -> "_RemoteScorer":
+        return self  # the child AOT-warmed its ladder at boot
+
+    def score_batch(self, request) -> np.ndarray:
+        payload = pack_request(request)
+        try:
+            with self._data_lock:
+                write_frame(self._data, payload)
+                return unpack_response(read_frame(self._data))
+        except OSError as e:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} child connection lost: {e}"
+            ) from e
+
+    def swap_model(self, model) -> None:
+        """Hot-swap the CHILD to a newer model: publish the shared
+        artifact (cached per model object — one file serves every replica
+        of the fleet) and instruct the child over the control connection.
+        The child's scorer does the capacity-headroom swap — zero child
+        recompiles, same refusal semantics as a thread replica."""
+        path, version = self._store.publish(model)
+        with self._ctrl_lock:
+            write_frame(self._ctrl, pack_control("swap", path=path,
+                                                 version=version))
+            header = unpack_control(read_frame(self._ctrl))
+        if header.get("kind") != "ok":
+            raise TransportError(
+                f"swap refused: unexpected reply {header.get('kind')!r}"
+            )
+        self.model = model
+        self.version = version
+
+    # -- supervision ----------------------------------------------------------
+    def ping(self, deadline_s: float) -> dict:
+        """Liveness ping frame with a hard deadline: the exchange runs
+        under the watchdog's ``call_with_timeout``, so a wedged child
+        surfaces as a retriable stall timeout — the probe-timeout path the
+        supervisor treats exactly like a crash."""
+        from photon_tpu.fault.watchdog import call_with_timeout
+
+        def exchange():
+            with self._ctrl_lock:
+                write_frame(self._ctrl, pack_control("ping"))
+                return unpack_control(read_frame(self._ctrl))
+
+        return call_with_timeout(
+            exchange, deadline_s, site=f"replica:{self.replica_id}:ping"
+        )
+
+    def shutdown(self, deadline_s: float = 5.0) -> None:
+        from photon_tpu.fault.watchdog import call_with_timeout
+
+        def exchange():
+            with self._ctrl_lock:
+                write_frame(self._ctrl, pack_control("shutdown"))
+                return unpack_control(read_frame(self._ctrl))
+
+        call_with_timeout(exchange, deadline_s,
+                          site=f"replica:{self.replica_id}:shutdown")
+
+    def disconnect(self) -> None:
+        for sock in (self._data, self._ctrl):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class SubprocessReplica(ScorerReplica):
+    """A serving replica whose runtime is a CHILD PROCESS — its own Python
+    and jax runtime, its own device set (dealt via the spawn environment),
+    speaking the frame protocol to the router over loopback sockets.
+
+    Drop-in for :class:`ScorerReplica`: the router dispatches, sheds,
+    reroutes, and rolls out against it unchanged.  Crash detection is
+    structural (child exit code via :meth:`poll_exit`, dropped data
+    connection mid-batch → :class:`ReplicaDeadError`); :meth:`respawn`
+    spawns a fresh child from the fleet's CURRENT model artifact."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        model,
+        store: ModelStore,
+        request_spec: Dict[str, ShardSpec],
+        buckets=None,
+        max_batch: int = 256,
+        min_bucket: int = 8,
+        max_delay_s: float = 0.002,
+        telemetry=None,
+        child_env: Optional[Dict[str, str]] = None,
+        spawn_timeout_s: float = 120.0,
+    ):
+        self._store = store
+        self._request_spec = dict(request_spec)
+        self._buckets = buckets
+        self._min_bucket = min_bucket
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self.child_env = dict(child_env or {})
+        self._proc: Optional[subprocess.Popen] = None
+        self._replica_id = replica_id
+        self._cfg_max_batch = int(max_batch)
+        scorer = self._spawn(model, telemetry=telemetry)
+        super().__init__(replica_id, scorer, max_batch=max_batch,
+                         max_delay_s=max_delay_s, telemetry=telemetry)
+
+    # -- child lifecycle ------------------------------------------------------
+    def _spawn(self, model, telemetry=None) -> _RemoteScorer:
+        """Spawn one child on the current shared artifact and connect —
+        the ``replica:spawn`` fault site (retriable: the supervisor backs
+        off and retries a failed spawn)."""
+        fault_point("replica:spawn", replica=self._replica_id)
+        artifact, version = self._store.publish(model)
+        ready_path = os.path.join(
+            self._store.workdir,
+            f"{self._replica_id}-ready-{os.getpid()}-{time.monotonic_ns()}"
+            ".json",
+        )
+        config = {
+            "replica_id": self._replica_id,
+            "spec": {
+                shard: {"kind": s.kind, "dim": s.dim, "nnz": s.nnz}
+                for shard, s in self._request_spec.items()
+            },
+            "buckets": list(self._buckets) if self._buckets else None,
+            "max_batch": self._cfg_max_batch,
+            "min_bucket": self._min_bucket,
+        }
+        env = dict(os.environ)
+        env.update(self.child_env)
+        log_path = os.path.join(self._store.workdir,
+                                f"{self._replica_id}.log")
+        log = open(log_path, "ab")
+        try:
+            # stdin is a PIPE the parent never writes: the child's
+            # parent-death watchdog reads it and exits on EOF, so a crashed
+            # (or respawn-racing) parent can never leak orphan children.
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "photon_tpu.serving.replica_proc",
+                 "--artifact", artifact, "--ready-file", ready_path,
+                 "--config", json.dumps(config)],
+                env=env, stdin=subprocess.PIPE, stdout=log, stderr=log,
+            )
+        finally:
+            log.close()
+        deadline = time.monotonic() + self._spawn_timeout_s
+        ready = None
+        while time.monotonic() < deadline:
+            code = proc.poll()
+            if code is not None:
+                raise ReplicaSpawnError(
+                    f"replica {self._replica_id} child exited {code} during "
+                    f"startup (log: {log_path})"
+                )
+            if os.path.exists(ready_path):
+                with open(ready_path) as f:
+                    ready = json.load(f)
+                break
+            time.sleep(0.02)
+        if ready is None:
+            proc.kill()
+            raise ReplicaSpawnError(
+                f"replica {self._replica_id} child not ready within "
+                f"{self._spawn_timeout_s:g}s (log: {log_path})"
+            )
+        try:
+            os.unlink(ready_path)
+        except OSError:
+            pass
+        self._proc = proc
+        return _RemoteScorer(
+            self._replica_id, model, version, self._store,
+            self._request_spec, self._buckets, self._cfg_max_batch,
+            self._min_bucket, port=int(ready["port"]),
+            compilations=int(ready.get("compilations", 0)),
+            telemetry=telemetry,
+        )
+
+    def poll_exit(self) -> Optional[int]:
+        return None if self._proc is None else self._proc.poll()
+
+    @property
+    def child_pid(self) -> Optional[int]:
+        return None if self._proc is None else self._proc.pid
+
+    def kill_backend(self) -> None:
+        """Tear the child down hard (the unhealthy-replica reaper): close
+        the sockets — which unwedges a batcher thread blocked on a hung
+        exchange — then SIGKILL the process."""
+        self.scorer.disconnect()
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+        if self._proc is not None:
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def respawn(self, model=None) -> None:
+        """Real resurrection: abandon whatever the old batcher held (the
+        router reroutes it), reap the dead child, spawn a FRESH child from
+        the fleet's current model artifact (re-warmed at boot), and attach
+        a fresh batcher.  Dispatch resumes only after ``router.revive()``
+        — the canary-gated rejoin."""
+        self.abandon_for_respawn()
+        self.kill_backend()
+        model = model if model is not None else self.scorer.model
+        self.scorer = self._spawn(model, telemetry=self.telemetry)
+        self.attach_fresh_batcher()
+
+    def ping(self, deadline_s: float) -> dict:
+        return self.scorer.ping(deadline_s)
+
+    def close(self) -> None:
+        # Drain FIRST: close()'s contract (queued requests still get
+        # scored) needs the child alive while the batcher empties; tearing
+        # the child down first would fail every drained request with
+        # ReplicaDeadError.  A dead/hung child makes the drain fail fast
+        # (socket errors) inside the batcher's bounded join.
+        super().close()
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                self.scorer.shutdown()
+            except (OSError, TransportError):
+                pass
+        self.kill_backend()
+
+
+if __name__ == "__main__":
+    _child_main()

@@ -38,9 +38,27 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-from photon_tpu.fault.injection import InjectedKillError, fault_point
+from photon_tpu.fault.injection import (
+    InjectedKillError,
+    consume_hang_injection,
+    fault_point,
+)
+from photon_tpu.fault.watchdog import complete as retire_heartbeat
+from photon_tpu.fault.watchdog import heartbeat
 from photon_tpu.serving.batcher import DEFAULT_MAX_DELAY_S, RequestBatcher
 from photon_tpu.serving.scorer import GameScorer, ScoringRequest
+
+
+_heartbeat_nonce = itertools.count(1)
+
+
+def replica_heartbeat_site(replica_id: str) -> str:
+    """A watchdog heartbeat site for one replica INSTANCE: the supervisor's
+    hang check and the scoring path's progress marks share it through
+    ``replica.heartbeat_site``.  The process-wide nonce keeps two fleets
+    in one process (both naming replicas ``r0``…) from cross-talking each
+    other's hang detection through a shared site name."""
+    return f"serving.replica.{replica_id}#{next(_heartbeat_nonce)}"
 
 
 class RequestShedError(RuntimeError):
@@ -69,6 +87,26 @@ class RolloutParityError(RuntimeError):
     """The canary's mirrored-traffic parity probe disagreed with the new
     model's host oracle; the rollout was aborted and the canary rolled
     back to the previous model."""
+
+
+def parity_worst(got, want) -> float:
+    """Worst absolute disagreement between served scores and the host
+    oracle — the ONE comparison the rollout canary gate, the supervisor's
+    known-answer probe, and the resurrection rejoin gate all use.
+    Deliberately paranoid: a shape mismatch or any non-finite value in
+    the served answer is infinite disagreement (``np.abs(nan) > tol`` is
+    False — a NaN-serving canary/replica must FAIL the gate, not slide
+    through it and get promoted fleet-wide)."""
+    # host-sync: probe-oracle comparison — host arrays both sides (the
+    # served response vs the host-scored answer).
+    got = np.asarray(got, np.float64)
+    want = np.asarray(want, np.float64)
+    if got.shape != want.shape:
+        return float("inf")
+    if got.size and not np.all(np.isfinite(got)):
+        return float("inf")
+    delta = np.abs(got - want)
+    return float(delta.max()) if delta.size else 0.0
 
 
 def host_score_request(model, request: ScoringRequest) -> np.ndarray:
@@ -127,11 +165,21 @@ def host_score_request(model, request: ScoringRequest) -> np.ndarray:
 
 class _KillableScorer:
     """The replica's scoring hook: delegates to the real scorer but (1)
-    declares the ``serve:replica_kill`` fault site so CI can kill a named
-    replica's scoring path deterministically, and (2) latches death — once
-    a kill fired, every later batch on this replica raises
-    :class:`ReplicaDeadError` (a dead replica stays dead; the one-shot
-    fault rule must not let the next batch silently succeed)."""
+    declares the ``serve:replica_kill`` and ``replica:crash`` fault sites
+    so CI can kill/crash a named replica's scoring path deterministically,
+    (2) latches death — once a kill fired, every later batch on this
+    replica raises :class:`ReplicaDeadError` (a dead replica stays dead
+    until the supervisor resurrects it; the one-shot fault rule must not
+    let the next batch silently succeed) — and (3) marks watchdog
+    heartbeats around each batch, the progress signal the supervisor's
+    hang detection reads.  An injected ``replica:hang`` WEDGES the batch
+    (the thread-backed shape of a hung runtime) until the replica is
+    declared dead from outside — detection has to come from the
+    supervisor's probe deadline, exactly like a real hang."""
+
+    # The wedge-simulation backstop: an unsupervised hung replica fails its
+    # batch after this long instead of holding the batcher thread forever.
+    HANG_CAP_S = 60.0
 
     def __init__(self, replica: "ScorerReplica", scorer: GameScorer):
         self._replica = replica
@@ -140,26 +188,63 @@ class _KillableScorer:
     def __getattr__(self, name):
         return getattr(self._scorer, name)
 
+    def _die(self, cause: str, exc: BaseException) -> None:
+        self._replica.death_cause = cause
+        self._replica.alive = False
+        raise ReplicaDeadError(
+            f"replica {self._replica.replica_id} {cause}: {exc}"
+        ) from exc
+
     def score_batch(self, request: ScoringRequest) -> np.ndarray:
-        if not self._replica.alive:
+        replica = self._replica
+        # ``rejoining`` lifts the dead-latch for the supervisor's rejoin
+        # parity probes only: the replica is still OUT of the dispatch set
+        # (alive stays False until revive), so no caller traffic can reach
+        # a replica that has not passed its canary gate.
+        if not replica.alive and not replica.rejoining:
+            raise ReplicaDeadError(f"replica {replica.replica_id} is dead")
+        heartbeat(replica.heartbeat_site)
+        if consume_hang_injection(replica.replica_id):
+            deadline = time.monotonic() + self.HANG_CAP_S
+            while replica.alive and time.monotonic() < deadline:
+                time.sleep(0.02)
             raise ReplicaDeadError(
-                f"replica {self._replica.replica_id} is dead"
+                f"replica {replica.replica_id} wedged (injected hang)"
             )
         try:
-            fault_point(
-                "serve:replica_kill", replica=self._replica.replica_id
-            )
-            return self._scorer.score_batch(request)
+            fault_point("serve:replica_kill", replica=replica.replica_id)
         except InjectedKillError as e:
-            self._replica.alive = False
-            raise ReplicaDeadError(
-                f"replica {self._replica.replica_id} killed: {e}"
-            ) from e
+            self._die("kill", e)
+        try:
+            fault_point("replica:crash", replica=replica.replica_id)
+        except InjectedKillError as e:
+            self._die("crash", e)
+        try:
+            scores = self._scorer.score_batch(request)
+        except ReplicaDeadError:
+            # The backend itself died mid-batch (a subprocess child's
+            # connection dropped): latch it like an injected crash.
+            if replica.alive:
+                replica.death_cause = replica.death_cause or "crash"
+                replica.alive = False
+            raise
+        heartbeat(replica.heartbeat_site)
+        return scores
 
 
 class ScorerReplica:
     """One serving replica: scorer + dedicated batcher + health/latency
-    state the router dispatches on."""
+    state the router dispatches on.
+
+    Supervision surface (the fleet supervisor drives these):
+    ``generation`` counts resurrections — death accounting is per
+    (replica, generation) so a replica that dies, rejoins, and dies again
+    is two deaths, not one latched event; ``death_cause`` labels the
+    death counter (kill/crash/hang/parity/error); ``quarantined`` is the
+    permanent flap verdict; :meth:`respawn` stands the serving path back
+    up (re-warmed, fresh batcher) WITHOUT returning it to dispatch — only
+    :meth:`FleetRouter.revive`, after the canary-gated rejoin probe, does
+    that."""
 
     def __init__(
         self,
@@ -174,6 +259,15 @@ class ScorerReplica:
         self.replica_id = replica_id
         self.scorer = scorer
         self.alive = True
+        self.heartbeat_site = replica_heartbeat_site(replica_id)
+        self.generation = 0
+        self.death_cause: Optional[str] = None
+        self.quarantined = False
+        # True between respawn and revive: the supervisor's rejoin probes
+        # may score, the router still never dispatches (alive is False).
+        self.rejoining = False
+        self._max_batch = max_batch
+        self._max_delay_s = max_delay_s
         self.telemetry = telemetry or scorer.telemetry or NULL_SESSION
         self.batcher = RequestBatcher(
             _KillableScorer(self, scorer),
@@ -181,9 +275,9 @@ class ScorerReplica:
             max_delay_s=max_delay_s,
             telemetry=self.telemetry,
         )
-        # EWMA seconds-per-row through this replica (queue wait included),
-        # the router's projection basis.  None until the first completion:
-        # a cold replica admits optimistically.
+        # EWMA seconds-per-PADDED-row through this replica (queue wait
+        # included), the router's projection basis.  None until the first
+        # completion: a cold replica admits optimistically.
         self.row_seconds: Optional[float] = None
         self.requests_served = 0
         self.depth_peak = 0
@@ -191,17 +285,85 @@ class ScorerReplica:
     def pending_rows(self) -> int:
         return self.batcher.pending_rows()
 
+    def pending_padded_rows(self) -> int:
+        return self.batcher.pending_padded_rows()
+
+    def padded_rows(self, n: int) -> int:
+        """``n`` request rows at their padded bucket-ladder cost (what the
+        projection charges — padded rows cost compute too)."""
+        try:
+            return self.scorer.padded_rows(n)
+        except Exception:
+            return int(n)
+
     def projected_wait_s(self, extra_rows: int) -> float:
         """Projected time for a new ``extra_rows``-row request to clear
-        this replica: live queue depth × measured per-row pace."""
+        this replica: live PADDED queue depth × measured per-padded-row
+        pace (bucket padding folded in — a raw-rows projection under-
+        estimates the wait and over-admits near saturation)."""
         if self.row_seconds is None:
             return 0.0
-        return (self.pending_rows() + extra_rows) * self.row_seconds
+        return (
+            self.pending_padded_rows() + self.padded_rows(extra_rows)
+        ) * self.row_seconds
 
     def submit(self, request: ScoringRequest) -> Future:
         return self.batcher.submit(request)
 
+    # -- supervision ---------------------------------------------------------
+    def poll_exit(self) -> Optional[int]:
+        """Exit code of the replica's backing process, or None while it
+        runs.  Thread-backed replicas have no backing process — always
+        None; the subprocess replica overrides this with the child's
+        ``Popen.poll()``."""
+        return None
+
+    def abandon_pending(self, exc: BaseException) -> None:
+        """Fail everything queued on (and in flight through) this replica
+        so the router's done-callbacks reroute it — the supervisor's
+        teardown step when it declares a replica dead."""
+        self.batcher.abandon(exc)
+
+    def abandon_for_respawn(self) -> None:
+        """First step of every respawn: fail whatever the dead batcher
+        still held (the router reroutes it exactly once)."""
+        self.batcher.abandon(
+            ReplicaDeadError(f"replica {self.replica_id} is being respawned")
+        )
+
+    def attach_fresh_batcher(self) -> None:
+        """Last step of every respawn: a fresh batcher over the (re)stood
+        scorer, and ``rejoining`` lifted so ONLY the supervisor's rejoin
+        probes can score — shared by the thread and subprocess respawn
+        paths so their rebuild semantics cannot drift."""
+        self.batcher = RequestBatcher(
+            _KillableScorer(self, self.scorer),
+            max_batch=self._max_batch,
+            max_delay_s=self._max_delay_s,
+            telemetry=self.telemetry,
+        )
+        self.rejoining = True
+
+    def respawn(self, model=None) -> None:
+        """Stand the dead serving path back up: abandon whatever the old
+        batcher still held, sync the scorer to ``model`` (the fleet's
+        CURRENT model — a replica resurrected mid-rollout must come back
+        on the model the fleet serves now, never the one it died on),
+        re-warm the bucket ladder, and attach a fresh batcher.  For a
+        thread-backed replica the runtime survived the "crash", so the
+        re-warm hits the cached programs — zero recompiles; the subprocess
+        replica overrides this with a real child respawn.  The replica
+        stays OUT of the dispatch set until ``router.revive()`` after the
+        rejoin parity probe."""
+        fault_point("replica:spawn", replica=self.replica_id)
+        self.abandon_for_respawn()
+        if model is not None and model is not self.scorer.model:
+            self.scorer.swap_model(model)
+        self.scorer.warmup()
+        self.attach_fresh_batcher()
+
     def close(self) -> None:
+        retire_heartbeat(self.heartbeat_site)
         self.batcher.close()
 
 
@@ -225,7 +387,8 @@ class AdmissionPolicy:
 
 class _Entry:
     __slots__ = ("request", "future", "rows", "deadline_at", "attempts",
-                 "dispatched_at", "pending_before")
+                 "dispatched_at", "pending_before", "padded",
+                 "padded_before", "projected_wait")
 
     def __init__(self, request: ScoringRequest, deadline_at: Optional[float]):
         self.request = request
@@ -235,6 +398,9 @@ class _Entry:
         self.attempts = 0
         self.dispatched_at = 0.0
         self.pending_before = 0
+        self.padded = 0
+        self.padded_before = 0
+        self.projected_wait: Optional[float] = None
 
 
 class FleetRouter:
@@ -270,7 +436,10 @@ class FleetRouter:
         # parity probe's traffic sample.
         self._mirror: deque = deque(maxlen=8)
         self._rollout_seq = itertools.count(1)
-        self._dead_ids: set = set()
+        # Death accounting is per (replica, generation): a resurrected
+        # replica's NEXT death is a new event, not a latched repeat — the
+        # supervisor's flap counting depends on every death being counted.
+        self._dead_keys: set = set()
         self._closed = False
 
     # -- admission + dispatch ------------------------------------------------
@@ -324,6 +493,12 @@ class FleetRouter:
     def _dispatch(self, entry: _Entry, replica: ScorerReplica) -> None:
         entry.attempts += 1
         entry.pending_before = replica.pending_rows()
+        entry.padded = replica.padded_rows(entry.rows)
+        entry.padded_before = replica.pending_padded_rows()
+        entry.projected_wait = (
+            None if replica.row_seconds is None
+            else replica.projected_wait_s(entry.rows)
+        )
         entry.dispatched_at = self.clock()
         t = self.telemetry
         t.counter("serving.replica_requests", replica=replica.replica_id).inc()
@@ -361,17 +536,24 @@ class FleetRouter:
         if exc is None:
             now = self.clock()
             replica.requests_served += 1
-            # Per-row pace sample: this request's submit->resolve time over
-            # the rows that were ahead of (and in) it — a Little's-law-ish
-            # estimate that tracks the replica's live drain rate.
-            sample = (now - entry.dispatched_at) / max(
-                1, entry.pending_before + entry.rows
-            )
+            # Per-PADDED-row pace sample: this request's submit->resolve
+            # time over the padded rows that were ahead of (and in) it — a
+            # Little's-law-ish estimate that tracks the replica's live
+            # drain rate in the unit the device actually pays (padding
+            # included), matching the projection's cost unit.
+            observed = now - entry.dispatched_at
+            sample = observed / max(1, entry.padded_before + entry.padded)
             alpha = self.admission.ewma_alpha
             replica.row_seconds = (
                 sample if replica.row_seconds is None
                 else (1 - alpha) * replica.row_seconds + alpha * sample
             )
+            if entry.projected_wait is not None:
+                # The over/under-shedding premium, measurable: how far the
+                # admission projection was from this request's real wait.
+                self.telemetry.histogram("serving.admission_error_s").observe(
+                    observed - entry.projected_wait
+                )
             if entry.deadline_at is not None and now > entry.deadline_at:
                 self.telemetry.counter("serving.deadline_missed").inc()
                 self.telemetry.histogram("serving.deadline_overrun_s").observe(
@@ -410,15 +592,56 @@ class FleetRouter:
             )
         )
 
-    def _mark_dead(self, replica: ScorerReplica, exc: BaseException) -> None:
+    def _mark_dead(self, replica: ScorerReplica, exc: BaseException,
+                   cause: Optional[str] = None) -> None:
         with self._lock:
-            first = replica.replica_id not in self._dead_ids
-            self._dead_ids.add(replica.replica_id)
+            key = (replica.replica_id, replica.generation)
+            first = key not in self._dead_keys
+            self._dead_keys.add(key)
             replica.alive = False
+            if cause and not replica.death_cause:
+                replica.death_cause = cause
         if first:
             self.telemetry.counter(
-                "serving.replica_deaths", replica=replica.replica_id
+                "serving.replica_deaths", replica=replica.replica_id,
+                cause=replica.death_cause or cause or "error",
             ).inc()
+            retire_heartbeat(replica.heartbeat_site)
+
+    def mark_unhealthy(self, replica: ScorerReplica, cause: str,
+                       detail: str = "") -> None:
+        """Supervisor verdict: declare a replica dead (failed health probe
+        — hang, crash, parity).  Death accounting + heartbeat retire; the
+        caller tears down in-flight work via ``replica.abandon_pending``
+        so the router reroutes it."""
+        self._mark_dead(
+            replica,
+            RuntimeError(
+                detail or f"replica {replica.replica_id} unhealthy ({cause})"
+            ),
+            cause=cause,
+        )
+
+    def revive(self, replica: ScorerReplica) -> None:
+        """Return a resurrected replica to the dispatch set.  The
+        supervisor calls this ONLY after the canary-gated rejoin parity
+        probe passed — resurrection is gated exactly like a rollout canary.
+        The generation bump re-arms death accounting; the pace EWMA resets
+        so the rejoined replica admits optimistically like a cold one."""
+        with self._lock:
+            replica.generation += 1
+            replica.death_cause = None
+            replica.row_seconds = None
+            replica.rejoining = False
+            replica.alive = True
+        self.telemetry.counter(
+            "serving.replica_resurrections", replica=replica.replica_id
+        ).inc()
+
+    def recent_requests(self) -> List[ScoringRequest]:
+        """The mirror of recently admitted requests — the rollout canary's
+        AND the supervisor's rejoin-probe traffic sample."""
+        return list(self._mirror)
 
     # -- canary rollout ------------------------------------------------------
     def _mark_rollout(self, replica_id: str, phase: str) -> None:
@@ -477,12 +700,7 @@ class FleetRouter:
                 futs = [canary.submit(req) for req in probes]
                 for req, fut in zip(probes, futs):
                     got = fut.result(timeout=probe_timeout_s)
-                    want = oracle(req)
-                    # host-sync: rollout probe — host arrays both sides
-                    # (the scorer's fetched response vs the host oracle).
-                    delta = np.abs(np.asarray(got, np.float64)
-                                   - np.asarray(want, np.float64))
-                    worst = float(delta.max()) if len(want) else 0.0
+                    worst = parity_worst(got, oracle(req))
                     if worst > parity_tol:
                         raise RolloutParityError(
                             f"canary {canary.replica_id} parity probe "

@@ -66,6 +66,43 @@ DEFAULT_MAX_BATCH = 256
 DEFAULT_MIN_BUCKET = 8
 
 
+def bucket_ladder(
+    buckets: Optional[Tuple[int, ...]], max_batch: int, min_bucket: int
+) -> Tuple[int, ...]:
+    """The ONE bucket-ladder construction: an explicit ladder is
+    deduped/sorted, a default one is the powers of two from
+    ``min_bucket`` through ``pow2(max_batch)``.  Shared by
+    :class:`GameScorer` and the subprocess replica's parent-side mirror so
+    the two can never pad differently."""
+    if buckets is None:
+        b, ladder = max(1, pow2_at_least(min_bucket)), []
+        max_bucket = pow2_at_least(max_batch)
+        while b < max_bucket:
+            ladder.append(b)
+            b *= 2
+        ladder.append(max_bucket)
+        buckets = tuple(ladder)
+    return tuple(sorted(set(int(b) for b in buckets)))
+
+
+def padded_cost(n: int, buckets: Tuple[int, ...]) -> int:
+    """Device rows an ``n``-row request actually COSTS through the bucket
+    ladder: the smallest holding bucket, with oversize requests chunked
+    into max-bucket slabs first (exactly what ``score_batch`` does).  The
+    admission projection charges queue wait in these padded rows — padding
+    costs compute too, so a raw-rows projection systematically under-
+    estimates the wait and over-admits near saturation."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    max_bucket = buckets[-1]
+    full, rem = divmod(n, max_bucket)
+    cost = full * max_bucket
+    if rem:
+        cost += next(b for b in buckets if rem <= b)
+    return cost
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardSpec:
     """Fixed request layout of one feature shard: serving programs compile
@@ -281,15 +318,7 @@ class GameScorer:
         self.mesh = mesh
         self.telemetry = telemetry or NULL_SESSION
         self.request_spec = request_spec or request_spec_for_model(model)
-        if buckets is None:
-            b, ladder = max(1, pow2_at_least(min_bucket)), []
-            max_bucket = pow2_at_least(max_batch)
-            while b < max_bucket:
-                ladder.append(b)
-                b *= 2
-            ladder.append(max_bucket)
-            buckets = tuple(ladder)
-        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.buckets = bucket_ladder(buckets, max_batch, min_bucket)
         self.max_bucket = self.buckets[-1]
         self.compilations = 0
         self._warm = False
@@ -443,6 +472,11 @@ class GameScorer:
                 return b
         raise ValueError(f"batch of {n} rows exceeds max bucket "
                          f"{self.max_bucket}; chunk it (score_batch does)")
+
+    def padded_rows(self, n: int) -> int:
+        """Padded device rows ``n`` request rows cost through this ladder
+        (the admission projection's cost unit)."""
+        return padded_cost(n, self.buckets)
 
     def warmup(self) -> "GameScorer":
         """AOT-compile every ladder bucket's program.  After this, serving

@@ -87,13 +87,16 @@ class Traffic:
 
 @dataclasses.dataclass
 class Outcome:
-    """What happened to one replayed request."""
+    """What happened to one replayed request.  ``finished_at_s`` is the
+    completion time relative to the replay's start — the windowed-QPS
+    measurements (outage/recovery analysis in the chaos bench) cut on it."""
 
     status: str  # "ok" | "shed" | "error"
     scores: Optional[np.ndarray]
     latency_s: Optional[float]
     item: TimedRequest
     reason: str = ""
+    finished_at_s: Optional[float] = None
 
 
 def _take_request(whole: ScoringRequest, rows: np.ndarray) -> ScoringRequest:
@@ -269,18 +272,23 @@ def replay_open_loop(
         try:
             fut = submit(item.request, deadline_s=item.deadline_s)
         except RequestShedError as e:
-            outcomes[i] = Outcome("shed", None, None, item, e.reason)
+            outcomes[i] = Outcome("shed", None, None, item, e.reason,
+                                  finished_at_s=t0 - start)
             continue
 
         def _collect(fut, i=i, item=item, t0=t0):
-            lat = time.monotonic() - t0
+            now = time.monotonic()
+            lat = now - t0
             try:
-                outcomes[i] = Outcome("ok", fut.result(), lat, item)
+                outcomes[i] = Outcome("ok", fut.result(), lat, item,
+                                      finished_at_s=now - start)
             except RequestShedError as e:
-                outcomes[i] = Outcome("shed", None, lat, item, e.reason)
+                outcomes[i] = Outcome("shed", None, lat, item, e.reason,
+                                      finished_at_s=now - start)
             except BaseException as e:  # noqa: BLE001 — recorded, not raised
                 outcomes[i] = Outcome(
-                    "error", None, lat, item, f"{type(e).__name__}: {e}"
+                    "error", None, lat, item, f"{type(e).__name__}: {e}",
+                    finished_at_s=now - start,
                 )
 
         fut.add_done_callback(_collect)
@@ -307,6 +315,8 @@ def run_closed_loop_outcomes(
     outcomes: List[Optional[Outcome]] = [None] * len(items)
     clients = max(1, min(int(clients), len(items) or 1))
 
+    start = time.monotonic()
+
     def worker(tid: int) -> None:
         fn = score_fn_factory(tid)
         for i in range(tid, len(items), clients):
@@ -315,16 +325,19 @@ def run_closed_loop_outcomes(
             try:
                 scores = fn(item)
                 outcomes[i] = Outcome(
-                    "ok", scores, time.monotonic() - t0, item
+                    "ok", scores, time.monotonic() - t0, item,
+                    finished_at_s=time.monotonic() - start,
                 )
             except RequestShedError as e:
                 outcomes[i] = Outcome(
-                    "shed", None, time.monotonic() - t0, item, e.reason
+                    "shed", None, time.monotonic() - t0, item, e.reason,
+                    finished_at_s=time.monotonic() - start,
                 )
             except BaseException as e:  # noqa: BLE001 — recorded per request
                 outcomes[i] = Outcome(
                     "error", None, time.monotonic() - t0, item,
                     f"{type(e).__name__}: {e}",
+                    finished_at_s=time.monotonic() - start,
                 )
 
     threads = [

@@ -120,11 +120,41 @@ def _unpack(payload: bytes) -> Tuple[dict, List[np.ndarray]]:
     return header, arrays
 
 
+def payload_kind(payload: bytes) -> str:
+    """The frame kind without decoding the array bytes (header-only
+    parse) — the subprocess replica's per-connection dispatch peek."""
+    (hlen,) = struct.unpack("!I", payload[:4])
+    header = json.loads(payload[4: 4 + hlen].decode("utf-8"))
+    return header.get("kind", "")
+
+
+def pack_control(kind: str, **fields) -> bytes:
+    """A small array-less control frame (ping/pong/swap/ok/shutdown — the
+    replica-supervision vocabulary rides the same length-prefixed wire as
+    scoring)."""
+    header = {"v": 1, "kind": kind, "_arrays": []}
+    header.update(fields)
+    return _pack(header)
+
+
+def unpack_control(payload: bytes) -> dict:
+    """Decode a control frame to its header dict; a remote ``error`` frame
+    raises like any other response."""
+    header, _ = _unpack(payload)
+    if header.get("kind") == "error":
+        raise TransportError(f"remote control failed: {header.get('message')}")
+    return header
+
+
 def pack_request(request: ScoringRequest,
-                 deadline_s: Optional[float] = None) -> bytes:
+                 deadline_s: Optional[float] = None,
+                 seq: Optional[int] = None) -> bytes:
     """One scoring request as a wire payload.  Array order is pinned
     (sorted shard names, then sorted id columns, then offset) so the same
-    request always produces the same bytes."""
+    request always produces the same bytes.  ``seq`` tags the frame for
+    the PIPELINED client mode: the server scores tagged requests
+    concurrently and echoes the tag on each response, so one connection
+    can carry open-loop offered load instead of a serial exchange."""
     entries = []
     for shard in sorted(request.features):
         leaf = request.features[shard]
@@ -142,10 +172,16 @@ def pack_request(request: ScoringRequest,
         "deadline_ms": None if deadline_s is None else deadline_s * 1e3,
         "_arrays": entries,
     }
+    if seq is not None:
+        header["seq"] = int(seq)
     return _pack(header)
 
 
-def unpack_request(payload: bytes) -> Tuple[ScoringRequest, Optional[float]]:
+def unpack_request_ex(
+    payload: bytes,
+) -> Tuple[ScoringRequest, Optional[float], Optional[int]]:
+    """Decode a request frame to ``(request, deadline_s, seq)`` —
+    ``seq`` is None for plain serial-exchange clients."""
     header, arrays = _unpack(payload)
     if header.get("kind") != "score":
         raise TransportError(f"unexpected request kind {header.get('kind')!r}")
@@ -174,39 +210,65 @@ def unpack_request(payload: bytes) -> Tuple[ScoringRequest, Optional[float]]:
         ScoringRequest(features=features, entity_ids=entity_ids,
                        offset=offset),
         None if deadline_ms is None else deadline_ms / 1e3,
+        header.get("seq"),
     )
 
 
-def pack_scores(scores: np.ndarray) -> bytes:
-    return _pack(
+def unpack_request(payload: bytes) -> Tuple[ScoringRequest, Optional[float]]:
+    request, deadline_s, _ = unpack_request_ex(payload)
+    return request, deadline_s
+
+
+def _seqed(header: dict, seq: Optional[int]) -> dict:
+    if seq is not None:
+        header["seq"] = int(seq)
+    return header
+
+
+def pack_scores(scores: np.ndarray, seq: Optional[int] = None) -> bytes:
+    return _pack(_seqed(
         {"v": 1, "kind": "scores",
          # host-sync: response egress — wire serialization of the host
          # scores array the scorer already fetched (its ONE d2h).
-         "_arrays": [("scores", "", np.asarray(scores, np.float32))]}
-    )
+         "_arrays": [("scores", "", np.asarray(scores, np.float32))]},
+        seq,
+    ))
 
 
-def pack_shed(reason: str, detail: str = "") -> bytes:
-    return _pack({"v": 1, "kind": "shed", "reason": reason,
-                  "detail": detail, "_arrays": []})
+def pack_shed(reason: str, detail: str = "",
+              seq: Optional[int] = None) -> bytes:
+    return _pack(_seqed({"v": 1, "kind": "shed", "reason": reason,
+                         "detail": detail, "_arrays": []}, seq))
 
 
-def pack_error(message: str) -> bytes:
-    return _pack({"v": 1, "kind": "error", "message": message[:2000],
-                  "_arrays": []})
+def pack_error(message: str, seq: Optional[int] = None) -> bytes:
+    return _pack(_seqed({"v": 1, "kind": "error", "message": message[:2000],
+                         "_arrays": []}, seq))
+
+
+def _decode_response(payload: bytes):
+    """``(seq, scores, exception)`` from a response frame — exactly one of
+    scores/exception is set."""
+    header, arrays = _unpack(payload)
+    kind = header.get("kind")
+    seq = header.get("seq")
+    if kind == "scores":
+        return seq, arrays[0], None
+    if kind == "shed":
+        return seq, None, RequestShedError(header.get("reason", "unknown"),
+                                           header.get("detail", ""))
+    if kind == "error":
+        return seq, None, TransportError(
+            f"remote scoring failed: {header.get('message')}"
+        )
+    return seq, None, TransportError(f"unexpected response kind {kind!r}")
 
 
 def unpack_response(payload: bytes) -> np.ndarray:
-    header, arrays = _unpack(payload)
-    kind = header.get("kind")
-    if kind == "scores":
-        return arrays[0]
-    if kind == "shed":
-        raise RequestShedError(header.get("reason", "unknown"),
-                               header.get("detail", ""))
-    if kind == "error":
-        raise TransportError(f"remote scoring failed: {header.get('message')}")
-    raise TransportError(f"unexpected response kind {kind!r}")
+    _, scores, exc = _decode_response(payload)
+    if exc is not None:
+        raise exc
+    return scores
 
 
 # -- server ------------------------------------------------------------------
@@ -251,6 +313,34 @@ class ScoringServer:
         # + delayed-ACK on a chatty exchange stream adds tens of ms per
         # roundtrip (observed ~30 ms on loopback) — disable batching.
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Bound response SENDS (not reads — idle persistent connections
+        # must keep blocking in read_frame): pipelined responses run on
+        # batcher/router callback threads, and a client that stops
+        # reading (full TCP receive window) would otherwise wedge that
+        # thread — the replica's whole scoring path — inside sendall.
+        # With the send timeout the stalled connection errors and drops,
+        # hurting only its own client.
+        import struct as _struct
+
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                        _struct.pack("ll", 30, 0))
+        # Pipelined (seq-tagged) responses resolve on batcher/router
+        # callback threads while this thread keeps reading: one write lock
+        # per connection keeps frames whole on the wire.
+        write_lock = threading.Lock()
+
+        def send(out: bytes) -> bool:
+            try:
+                with write_lock:
+                    write_frame(sock, out)
+                t.counter("serving.transport_bytes", direction="out").inc(
+                    len(out) + 4
+                )
+                return True
+            except OSError:
+                t.counter("serving.transport_drops").inc()
+                return False
+
         while True:
             try:
                 payload = read_frame(sock)
@@ -262,23 +352,37 @@ class ScoringServer:
             t.counter("serving.transport_bytes", direction="in").inc(
                 len(payload) + 4
             )
+            seq = None
             try:
-                request, deadline_s = unpack_request(payload)
-                scores = self.service.submit(
-                    request, deadline_s=deadline_s
-                ).result()
-                out = pack_scores(scores)
+                request, deadline_s, seq = unpack_request_ex(payload)
+                fut = self.service.submit(request, deadline_s=deadline_s)
+                if seq is None:
+                    # Serial exchange: one request in flight per connection.
+                    out = pack_scores(fut.result())
+                else:
+                    # Pipelined: admission already ran (a synchronous shed
+                    # raised above); the response rides a done-callback so
+                    # the read loop keeps ingesting the offered stream —
+                    # socket backpressure and framing are now INSIDE the
+                    # overload measurement instead of serializing it.
+                    def respond(f, seq=seq):
+                        exc = f.exception()
+                        if exc is None:
+                            send(pack_scores(f.result(), seq=seq))
+                        elif isinstance(exc, RequestShedError):
+                            send(pack_shed(exc.reason, str(exc), seq=seq))
+                        else:
+                            send(pack_error(
+                                f"{type(exc).__name__}: {exc}", seq=seq
+                            ))
+
+                    fut.add_done_callback(respond)
+                    continue
             except RequestShedError as e:
-                out = pack_shed(e.reason, str(e))
+                out = pack_shed(e.reason, str(e), seq=seq)
             except BaseException as e:  # surfaced to the caller, not fatal
-                out = pack_error(f"{type(e).__name__}: {e}")
-            try:
-                write_frame(sock, out)
-                t.counter("serving.transport_bytes", direction="out").inc(
-                    len(out) + 4
-                )
-            except OSError:
-                t.counter("serving.transport_drops").inc()
+                out = pack_error(f"{type(e).__name__}: {e}", seq=seq)
+            if not send(out):
                 return
 
     def close(self) -> None:
@@ -347,6 +451,139 @@ class ScoringClient:
         self._drop()
 
     def __enter__(self) -> "ScoringClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class AsyncScoringClient:
+    """Pipelined multi-connection client: ``submit()`` returns a Future and
+    NEVER blocks on a response — request frames carry a sequence id, ride
+    one of ``connections`` persistent sockets, and the server scores them
+    concurrently, echoing the id on each response frame (scores, shed, or
+    error) so a reader thread can resolve futures out of order.
+
+    This is the open-loop load generator's transport
+    (``traffic.replay_open_loop(client.submit, ...)``): the arrival
+    schedule drives the SOCKET itself, so framing cost and socket
+    backpressure sit inside the overload measurement instead of being
+    bypassed by in-process submission.  Admission sheds come back as typed
+    frames and surface as ``RequestShedError`` through the future.
+
+    No retry/resend: a transport failure fails the connection's in-flight
+    futures with :class:`TransportError` (an open-loop replay records
+    them; resending mid-pipeline would reorder the offered schedule)."""
+
+    @staticmethod
+    def _settle(fut, value=None, exc: Optional[BaseException] = None):
+        """Resolve a future exactly once — three paths can race to fail
+        the same future on a dying connection (the submit-side send
+        failure, the reader's decode, and _fail_pending's sweep); the
+        shared ``resolve_once`` guard makes the loser's write a no-op."""
+        from photon_tpu.serving.batcher import resolve_once
+
+        resolve_once(fut, value, exc)
+
+    def __init__(self, address, connections: int = 2, telemetry=None,
+                 timeout_s: float = 60.0):
+        from photon_tpu.telemetry import NULL_SESSION
+
+        self.address = tuple(address)
+        self.telemetry = telemetry or NULL_SESSION
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        self._conns = []
+        for i in range(max(1, int(connections))):
+            sock = socket.create_connection(self.address, timeout=timeout_s)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = {
+                "sock": sock,
+                "wlock": threading.Lock(),
+                "pending": {},  # seq -> Future (this connection's)
+            }
+            conn["reader"] = threading.Thread(
+                target=self._read_loop, args=(conn,),
+                name=f"async-scoring-client-{i}", daemon=True,
+            )
+            self._conns.append(conn)
+        for conn in self._conns:
+            conn["reader"].start()
+
+    def submit(self, request: ScoringRequest,
+               deadline_s: Optional[float] = None):
+        """Send one request frame; the returned future resolves to the
+        scores, or raises the remote shed/error."""
+        from concurrent.futures import Future
+
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("client is closed")
+            self._seq += 1
+            seq = self._seq
+        conn = self._conns[seq % len(self._conns)]
+        fut = Future()
+        payload = pack_request(request, deadline_s, seq=seq)
+        conn["pending"][seq] = fut
+        try:
+            with conn["wlock"]:
+                write_frame(conn["sock"], payload)
+        except OSError as e:
+            conn["pending"].pop(seq, None)
+            self._settle(fut, exc=TransportError(f"send failed: {e}"))
+            return fut
+        dead = conn.get("dead")
+        if dead is not None:
+            # The reader died around this submit (the first send after a
+            # peer FIN can still succeed into the socket buffer): nothing
+            # will ever match this seq — fail it now, not at timeout.
+            conn["pending"].pop(seq, None)
+            self._settle(fut, exc=TransportError(
+                f"connection lost with request in flight: {dead}"
+            ))
+        return fut
+
+    def _read_loop(self, conn) -> None:
+        while True:
+            try:
+                payload = read_frame(conn["sock"])
+            except (OSError, TransportError) as e:
+                # Mark the connection dead BEFORE sweeping: a submit that
+                # registers its future after the sweep sees the flag and
+                # self-fails instead of waiting forever on a reader that
+                # already exited.
+                conn["dead"] = e
+                self._fail_pending(conn, e)
+                return
+            seq, scores, exc = _decode_response(payload)
+            fut = conn["pending"].pop(seq, None)
+            if fut is None:
+                continue  # unknown tag: a late frame after a local failure
+            self._settle(fut, scores, exc)
+
+    def _fail_pending(self, conn, error: BaseException) -> None:
+        pending, conn["pending"] = conn["pending"], {}
+        if not self._closed and pending:
+            self.telemetry.counter("serving.transport_drops").inc()
+        for fut in pending.values():
+            self._settle(fut, exc=TransportError(
+                f"connection lost with request in flight: {error}"
+            ))
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        for conn in self._conns:
+            try:
+                conn["sock"].close()
+            except OSError:
+                pass
+        for conn in self._conns:
+            conn["reader"].join(timeout=5)
+            self._fail_pending(conn, ConnectionError("client closed"))
+
+    def __enter__(self) -> "AsyncScoringClient":
         return self
 
     def __exit__(self, *exc) -> None:

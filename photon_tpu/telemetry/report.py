@@ -291,7 +291,7 @@ def _render_serving_section(report: dict) -> list:
         h for h in metrics.get("histograms") or []
         if h["name"] in ("serving.request_latency_s", "serving.score_seconds",
                          "serving.batch_rows", "serving.padded_fraction",
-                         "serving.coalesced")
+                         "serving.coalesced", "serving.admission_error_s")
     ]
     if hists:
         lines += ["", "| distribution | count | mean | p50 | p99 | max |",
@@ -382,6 +382,37 @@ def _render_fleet_section(report: dict) -> list:
             f"{rid}:{phase}" for _, rid, phase in sorted(rollout_steps)
         )
         lines.append(f"- **rollout timeline**: {timeline}")
+    # Self-healing supervisor (ISSUE 13): deaths/restarts summary + the
+    # event timeline (died-<cause> / respawn / rejoin-probe / rejoined /
+    # respawn-failed / quarantined), same monotonic-gauge shape as the
+    # rollout timeline.
+    resurrections = by_label(counters, "serving.replica_resurrections",
+                             "replica")
+    quarantined = by_label(counters, "serving.replica_quarantined",
+                           "replica")
+    respawn_failures = total("serving.respawn_failures")
+    supervisor_steps = []
+    for m in gauges:
+        if m["name"] == "serving.supervisor_step":
+            labels = m.get("labels") or {}
+            supervisor_steps.append(
+                (m["value"], labels.get("replica", "?"),
+                 labels.get("phase", "?"))
+            )
+    if resurrections or quarantined or respawn_failures or supervisor_steps:
+        deaths_total = sum(replica_deaths.values())
+        lines.append(
+            f"- **supervisor**: deaths={_fmt(deaths_total)}, "
+            f"resurrections={_fmt(sum(resurrections.values()))}, "
+            f"respawn failures={_fmt(respawn_failures)}, "
+            f"quarantined={_fmt(sum(quarantined.values()))}"
+            + (f" ({', '.join(sorted(quarantined))})" if quarantined else "")
+        )
+    if supervisor_steps:
+        timeline = " → ".join(
+            f"{rid}:{phase}" for _, rid, phase in sorted(supervisor_steps)
+        )
+        lines.append(f"- **supervisor timeline**: {timeline}")
     return lines
 
 

@@ -817,10 +817,16 @@ def test_serve_game_fleet_driver_end_to_end(tmp_path):
         "--deadline-ms", "2000",
         "--max-batch", "32",
         "--max-delay-ms", "1",
+        "--supervise",
         "--output-dir", str(out),
     ]))
     assert summary["requests"] == 30
     assert summary["replicas"] == 2
+    assert summary["replica_backend"] == "thread"
+    assert summary["supervised"] is True
+    # A healthy supervised run: nothing died, nothing resurrected.
+    assert summary["replica_deaths"] == 0
+    assert summary["resurrections"] == 0
     assert summary["transport"] == "tcp"
     assert summary["traffic"] == "powerlaw"
     assert summary["served"] + summary["shed"] == 30

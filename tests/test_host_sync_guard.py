@@ -81,6 +81,19 @@ def test_tile_store_is_guarded():
     assert "photon_tpu/game/tile_store.py" in set(DEFAULT_FILES)
 
 
+def test_self_healing_tier_is_guarded():
+    """The self-healing tier rides the default guard set (ISSUE 13
+    satellite): the supervisor's only sanctioned fetches are its
+    probe-oracle parity comparisons, and the subprocess-replica parent
+    side is frames + numpy with the one sanctioned fetch at artifact
+    publish — an unmarked sync in either must fail CI."""
+    from check_host_sync import DEFAULT_FILES
+
+    guarded = set(DEFAULT_FILES)
+    assert "photon_tpu/serving/supervisor.py" in guarded
+    assert "photon_tpu/serving/replica_proc.py" in guarded
+
+
 def test_checker_ignores_jnp_and_comments(tmp_path):
     f = tmp_path / "f.py"
     f.write_text(

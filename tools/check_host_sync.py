@@ -74,6 +74,13 @@ DEFAULT_FILES = (
     "photon_tpu/serving/router.py",
     "photon_tpu/serving/transport.py",
     "photon_tpu/serving/fleet.py",
+    # The self-healing tier (ISSUE 13): the supervisor is pure host-side
+    # control whose only sanctioned fetches are the probe-oracle parity
+    # comparisons; the subprocess-replica parent side is frames + numpy,
+    # with the one sanctioned fetch at artifact publish (model tables to
+    # host once per published version).
+    "photon_tpu/serving/supervisor.py",
+    "photon_tpu/serving/replica_proc.py",
 )
 
 SYNC_PATTERN = re.compile(
