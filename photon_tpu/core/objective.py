@@ -384,6 +384,61 @@ class GlmObjective:
             return hv
         return jax.jvp(lambda u: self._differentiable_grad(u, batch), (w,), (v,))[1]
 
+    def hvp_operator(self, w: Array, batch: Batch):
+        """Curvature operator at ``w``: precompute the per-row curvature
+        ``D(w) = weight·d2(margins)`` ONCE and return ``v -> Xᵀ(D·(X v)) +
+        λ₂ v`` — the matrix-free Newton-CG inner-loop workhorse (ISSUE 14:
+        two sparse matvecs per CG iteration, never a ``[d, d]`` matrix,
+        and no margin recomputation per product).  Exact for GLMs (margins
+        are linear in ``w``).  Static-layout batches route both matvecs
+        through the selected kernel (the gradient's layout trick);
+        normalized objectives and exotic batch shapes fall back to the
+        per-call jvp-of-gradient, still matrix-free."""
+        if self.normalization is not None:
+            return lambda v: self.hessian_vector(w, v, batch)
+        dim = int(w.shape[0])
+        kernel = self._sparse_kernel(batch, dim)
+        if kernel is not None:
+            z = self._margins_for_kernel(kernel, w, batch)
+            d2w = batch.weight * self.loss.d2(z, batch.label)
+
+            def hv_kernel(v: Array) -> Array:
+                xv = self._xu_product(kernel, v, batch)
+                out = self._segment_grad(kernel, d2w * xv, batch, dim)
+                if not _static_zero(self.l2_weight):
+                    out = out + self.l2_weight * v
+                return out
+
+            return hv_kernel
+        if isinstance(batch, DenseBatch):
+            xu = lambda v: batch.x @ v  # noqa: E731
+            xtu = lambda u: batch.x.T @ u  # noqa: E731
+        elif batch.ids.ndim == 2:
+            xu = lambda v: jnp.sum(  # noqa: E731
+                jnp.take(v, batch.ids, axis=0) * batch.vals, axis=-1
+            )
+            xtu = lambda u: jnp.zeros(dim, w.dtype).at[batch.ids].add(  # noqa: E731
+                u[:, None] * batch.vals
+            )
+        else:
+            return lambda v: self.hessian_vector(w, v, batch)
+        z = self._margins(w, batch)
+        d2w = batch.weight * self.loss.d2(z, batch.label)
+
+        def hv(v: Array) -> Array:
+            out = xtu(d2w * xu(v))
+            if not _static_zero(self.l2_weight):
+                out = out + self.l2_weight * v
+            return out
+
+        return hv
+
+    def hessian_vector_product(self, w: Array, v: Array, batch: Batch) -> Array:
+        """One matrix-free ``H v`` (``Xᵀ(D(w)·(X v)) + λ₂ v``) — the
+        canonical single-product entry; loops over many ``v`` at one ``w``
+        should hold :meth:`hvp_operator` instead (D(w) computed once)."""
+        return self.hvp_operator(w, batch)(v)
+
     def hessian_diagonal(self, w: Array, batch: Batch) -> Array:
         """diag(H) = sum_i weight_i * d2_i * x_ij^2 + l2 (HessianDiagonalAggregator);
         used for per-coefficient variance (VarianceComputationType.SIMPLE)."""

@@ -19,6 +19,7 @@ from photon_tpu.core.optimizers.base import (  # noqa: F401
 )
 from photon_tpu.core.optimizers.lbfgs import lbfgs  # noqa: F401
 from photon_tpu.core.optimizers.newton import newton  # noqa: F401
+from photon_tpu.core.optimizers.newton_cg import newton_cg  # noqa: F401
 from photon_tpu.core.optimizers.owlqn import owlqn  # noqa: F401
 from photon_tpu.core.optimizers.tron import tron  # noqa: F401
 
@@ -31,4 +32,9 @@ def get_optimizer(name: str):
         return owlqn
     if name == "tron":
         return tron
-    raise KeyError(f"unknown optimizer {name!r}; available: lbfgs, owlqn, tron")
+    if name in ("newton_cg", "newton-cg"):
+        return newton_cg
+    raise KeyError(
+        f"unknown optimizer {name!r}; available: lbfgs, owlqn, tron, "
+        "newton_cg"
+    )

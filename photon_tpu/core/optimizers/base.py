@@ -58,8 +58,10 @@ class OptimizerConfig:
     gradient_tolerance: float = 1e-6
     history_length: int = 10
     max_line_search: int = 25
-    # TRON-specific (LIBLINEAR-style constants).
-    cg_max_iterations: int = 0  # 0 -> use problem dimension capped at 100
+    # Inner-CG bounds (TRON and newton_cg).  0 -> a dimension-capped
+    # per-solver default: min(dim, 100) for TRON (LIBLINEAR's constant),
+    # min(dim, 256) for newton_cg (whose dims run past 100 by design).
+    cg_max_iterations: int = 0
     cg_tolerance: float = 0.1
 
     def replace(self, **kw) -> "OptimizerConfig":
@@ -83,6 +85,10 @@ class OptimizerResult(NamedTuple):
     history_value: Array  # [max_iter+1]
     history_grad_norm: Array  # [max_iter+1]
     history_valid: Array  # [max_iter+1] bool
+    # int32 total inner-CG iterations, set only by solvers with a CG inner
+    # loop (newton_cg); None elsewhere — a None leaf is an empty pytree
+    # subtree, so existing jit/vmap programs are unchanged.
+    cg_iterations: Array | None = None
 
 
 class OptimizationStatesTracker:

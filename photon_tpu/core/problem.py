@@ -22,7 +22,14 @@ import jax
 import jax.numpy as jnp
 
 from photon_tpu.core.objective import GlmObjective, RegularizationContext
-from photon_tpu.core.optimizers import OptimizerConfig, get_optimizer, lbfgs, owlqn, tron
+from photon_tpu.core.optimizers import (
+    OptimizerConfig,
+    get_optimizer,
+    lbfgs,
+    newton_cg,
+    owlqn,
+    tron,
+)
 from photon_tpu.data.batch import Batch
 from photon_tpu.models.glm import Coefficients
 
@@ -60,6 +67,19 @@ class ProblemConfig:
         return dataclasses.replace(self, **kw)
 
 
+def hvp_at_for(objective, batch: Batch):
+    """Curvature-operator factory for Newton-CG: ``w -> (v -> H(w)·v)``.
+
+    Plain :class:`GlmObjective`s expose ``hvp_operator`` (per-row curvature
+    precomputed once per outer iteration — each CG step is two matvecs);
+    objectives without it (the distributed/row-split wrappers) fall back
+    to a per-call ``hessian_vector``, which is still matrix-free."""
+    op = getattr(objective, "hvp_operator", None)
+    if op is not None:
+        return lambda w: op(w, batch)
+    return lambda w: (lambda v: objective.hessian_vector(w, v, batch))
+
+
 def _run_fit(objective, batch: Batch, w0: Array, *, optimizer: str,
              cfg: OptimizerConfig, variance: str):
     """One GLM fit, pure in (objective, batch, w0) — the body every cached
@@ -73,6 +93,12 @@ def _run_fit(objective, batch: Batch, w0: Array, *, optimizer: str,
     elif optimizer == "tron":
         result = tron(
             fun, w0, cfg, hvp=lambda w, v: objective.hessian_vector(w, v, batch)
+        )
+    elif optimizer in ("newton_cg", "newton-cg"):
+        result = newton_cg(
+            fun, w0, cfg,
+            hvp_at=hvp_at_for(objective, batch),
+            diag=lambda w: objective.hessian_diagonal(w, batch),
         )
     else:
         result = lbfgs(fun, w0, cfg)

@@ -29,12 +29,23 @@ placement: bins are padded to the mesh multiple and sharded over the mesh
 axis the score tables already use (``parallel.mesh``), composing with
 ``solve_entities_row_split`` under multi-controller row-split configs.
 
+Above the dense-Newton dim cap, smooth bins now route to the MATRIX-FREE
+batched Newton-CG (``core.optimizers.newton_cg`` vmapped over the entity
+axis: Hessian-vector products through ``objective.hvp_operator`` — two
+sparse matvecs per inner iteration, never a ``[B, d, d]`` block — with a
+Jacobi preconditioner from the cheap Hessian diagonal and Eisenstat-Walker
+adaptive inner tolerances), lifting the per-entity solve-dimension ceiling
+from ``PHOTON_NEWTON_MAX_DIM`` (64) to ``PHOTON_NEWTON_CG_MAX_DIM``
+(default 1024) — the ROADMAP "lift the solver ceilings" edge (ISSUE 14).
+
 Knobs (env): ``PHOTON_SOLVE_BINNING`` (``on``/``off``),
 ``PHOTON_SOLVE_MAX_BINS`` (default 4), ``PHOTON_SOLVE_BIN_WASTE`` (default
 2.0 — padded row cells allowed per live row cell before a capacity starts
 its own bin), ``PHOTON_SOLVE_NEWTON`` (``on``/``off``),
 ``PHOTON_NEWTON_MAX_DIM`` (default 64 — above it the dense ``[B, d, d]``
-Hessian stops paying and bins route to the iterative solvers).
+Hessian stops paying and bins route to Newton-CG),
+``PHOTON_SOLVE_NEWTON_CG`` (``on``/``off``), ``PHOTON_NEWTON_CG_MAX_DIM``
+(default 1024 — above it bins route to the vmapped iterative solvers).
 """
 
 from __future__ import annotations
@@ -46,7 +57,8 @@ import jax
 
 from photon_tpu.core.optimizers import OptimizerConfig
 from photon_tpu.core.optimizers.newton import newton
-from photon_tpu.core.problem import ProblemConfig, _compute_variances
+from photon_tpu.core.optimizers.newton_cg import newton_cg
+from photon_tpu.core.problem import ProblemConfig, _compute_variances, hvp_at_for
 from photon_tpu.models.glm import Coefficients
 
 
@@ -74,6 +86,16 @@ def newton_max_dim() -> int:
     return int(os.environ.get("PHOTON_NEWTON_MAX_DIM", "64"))
 
 
+def newton_cg_enabled() -> bool:
+    return os.environ.get("PHOTON_SOLVE_NEWTON_CG", "on").strip().lower() not in (
+        "off", "0", "false",
+    )
+
+
+def newton_cg_max_dim() -> int:
+    return int(os.environ.get("PHOTON_NEWTON_CG_MAX_DIM", "1024"))
+
+
 def bin_layout(buckets: tuple) -> list:
     """Bucket-index groups for the operative bin policy: the planned size
     bins, or one bucket per bin when binning is off (the seed's loop)."""
@@ -88,18 +110,30 @@ def bin_layout(buckets: tuple) -> list:
 def solver_route(problem: ProblemConfig, solve_dim: int,
                  row_split: bool = False) -> str:
     """Which solver a bin runs: ``newton`` (batched Cholesky) for smooth
-    small-dim problems, ``row_split`` under row-split placement, else
-    ``vmapped`` (the existing L-BFGS/OWL-QN/TRON program — L1 bins and
-    large dims keep their iterative solve)."""
+    small-dim problems, ``newton_cg`` (matrix-free Hessian-vector CG) for
+    smooth bins past the dense-Hessian cap up to ``newton_cg_max_dim``,
+    ``row_split`` under row-split placement, else ``vmapped`` (the
+    existing L-BFGS/OWL-QN/TRON program — L1 bins and over-cap dims keep
+    their iterative solve)."""
     if row_split:
         return "row_split"
-    if (
-        newton_enabled()
-        and problem.regularization.l1_weight == 0
+    smooth = (
+        problem.regularization.l1_weight == 0
         and problem.optimizer.lower() not in ("owlqn", "owl-qn")
-        and solve_dim <= newton_max_dim()
-    ):
+    )
+    if problem.optimizer.lower() in ("newton_cg", "newton-cg"):
+        # An explicitly requested Newton-CG problem routes there at ANY
+        # dim — the route label must not silently rename the user's
+        # solver choice.
+        return "newton_cg"
+    if smooth and newton_enabled() and solve_dim <= newton_max_dim():
         return "newton"
+    if (
+        smooth
+        and newton_cg_enabled()
+        and newton_max_dim() < solve_dim <= newton_cg_max_dim()
+    ):
+        return "newton_cg"
     return "vmapped"
 
 
@@ -139,6 +173,46 @@ def _cached_newton_solver(cfg: OptimizerConfig, variance: str):
     return jax.jit(jax.vmap(run, in_axes=(None, 0, 0)))
 
 
+def _run_newton_cg_fit(objective, batch, w0, *, cfg: OptimizerConfig,
+                       variance: str):
+    """One matrix-free Newton-CG GLM fit, pure in (objective, batch, w0) —
+    the body :func:`cached_newton_cg_solver` vmaps and compiles.  The
+    curvature rides ``objective.hvp_operator`` (per-row ``D(w)`` computed
+    once per outer iteration, each CG step two matvecs — never a ``[d, d]``
+    block), the Jacobi preconditioner is the cheap Hessian diagonal, and
+    the variance computation is the SAME ``_compute_variances`` formula as
+    every other route, so means AND variances stay on the existing parity
+    contract."""
+    fun = lambda w: objective.value_and_grad(w, batch)  # noqa: E731
+    result = newton_cg(
+        fun, w0, cfg,
+        hvp_at=hvp_at_for(objective, batch),
+        diag=lambda w: objective.hessian_diagonal(w, batch),
+    )
+    coefficients = Coefficients(
+        means=result.w,
+        variances=_compute_variances(objective, variance, result.w, batch),
+    )
+    return coefficients, result
+
+
+def cached_newton_cg_solver(problem: ProblemConfig):
+    """The jit-compiled batched Newton-CG solver for one static problem
+    configuration — same caching contract as :func:`cached_newton_solver`:
+    ``(objective, batch, w0) -> (Coefficients, OptimizerResult)`` mapped
+    over a leading entity axis, one traced program per static (optimizer
+    config, variance) pair."""
+    return _cached_newton_cg_solver(
+        problem.optimizer_config, problem.variance_computation
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_newton_cg_solver(cfg: OptimizerConfig, variance: str):
+    run = functools.partial(_run_newton_cg_fit, cfg=cfg, variance=variance)
+    return jax.jit(jax.vmap(run, in_axes=(None, 0, 0)))
+
+
 def record_bin_telemetry(telemetry, coordinate: str, bin_stats: list,
                          routes: list) -> None:
     """Export the bin layout's padding economics as gauges — the ISSUE 8
@@ -147,12 +221,19 @@ def record_bin_telemetry(telemetry, coordinate: str, bin_stats: list,
     ``solves.padded_fraction`` (padded fraction of the bin's entity×row
     cells — bin merging pads rows, mesh padding pads entities), so the bin
     policy's waste is observable instead of guessed.  Labels carry the
-    coordinate, bin index, row capacity, and the routed solver."""
+    coordinate, bin index, row capacity, and the routed solver.  The
+    ``solves.routed{route}`` counter (ISSUE 14 satellite) counts the LIVE
+    entities each route received — a silently-downgraded bin (L1,
+    over-cap dim falling back to ``vmapped``) shows up in the run report
+    instead of being inferred from timings."""
     for b, (stats, route) in enumerate(zip(bin_stats, routes)):
         labels = dict(
             coordinate=coordinate, bin=str(b),
             capacity=str(stats["capacity"]), route=route,
         )
+        telemetry.counter(
+            "solves.routed", coordinate=coordinate, route=route
+        ).inc(stats["live_entities"])
         telemetry.gauge("solves.bin_occupancy", **labels).set(
             stats["live_entities"]
         )

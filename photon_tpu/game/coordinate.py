@@ -93,20 +93,31 @@ def _gather_bucket_offsets(offsets: Array, row_index: Array, mask: Array) -> Arr
 @jax.jit
 def _accumulate_solve_stats(
     acc: Array, entity_index: Array, num_entities, converged: Array,
-    iterations: Array, good: Array,
+    iterations: Array, good: Array, cg_iterations: Array | None = None,
 ) -> Array:
-    """Fold one bucket's solve results into the per-coordinate ``[4]``
+    """Fold one bucket's solve results into the per-coordinate ``[6]``
     int32 stats accumulator ``[entities, converged, iterations_max,
-    quarantined]`` — entirely on device, so a coordinate's train() emits NO
-    host sync of its own: the descent loop drains every coordinate's
-    accumulator (plus the score-table guard flags) in ONE ``device_get``
-    per outer iteration.  Padded entities (``entity_index >=
-    num_entities``) — bin-padding and mesh-padding slots alike — are
+    quarantined, cg_iters, cg_entities]`` — entirely on device, so a coordinate's
+    train() emits NO host sync of its own: the descent loop drains every
+    coordinate's accumulator (plus the score-table guard flags) in ONE
+    ``device_get`` per outer iteration.  Padded entities (``entity_index
+    >= num_entities``) — bin-padding and mesh-padding slots alike — are
     masked out of every component, so they can never inflate ``entities``
     or ``converged``; a quarantined (non-finite) entity is not counted
-    converged either — its "solution" was discarded."""
+    converged either — its "solution" was discarded.  ``cg_iterations``
+    (per-entity inner-CG totals, Newton-CG bins only — see
+    ``OptimizerResult.cg_iterations``) sums into the ``cg_iters`` slot,
+    and the SAME bins' real entities into ``cg_entities`` — the correct
+    per-entity-mean denominator when a coordinate mixes CG and non-CG
+    bins (projected buckets can differ in solve_dim); other routes
+    contribute 0 to both."""
     real = entity_index < num_entities
     real_i = real.astype(jnp.int32)
+    if cg_iterations is None:
+        cg = cg_ents = jnp.asarray(0, jnp.int32)
+    else:
+        cg = (cg_iterations.astype(jnp.int32) * real_i).sum()
+        cg_ents = real_i.sum()
     return jnp.stack([
         acc[0] + real_i.sum(),
         acc[1] + ((converged & good).astype(jnp.int32) * real_i).sum(),
@@ -115,6 +126,8 @@ def _accumulate_solve_stats(
             jnp.max(jnp.where(real, iterations.astype(jnp.int32), 0)),
         ),
         acc[3] + ((~good).astype(jnp.int32) * real_i).sum(),
+        acc[4] + cg,
+        acc[5] + cg_ents,
     ])
 
 
@@ -136,7 +149,8 @@ class DeferredSolveStats:
     lazily fetches.  ``extra`` carries static host-side entries (e.g. the
     factored coordinate's ``latent_iterations``)."""
 
-    KEYS = ("entities", "converged", "iterations_max", "quarantined")
+    KEYS = ("entities", "converged", "iterations_max", "quarantined",
+            "cg_iters", "cg_entities")
 
     def __init__(self, device: Array, extra: Optional[dict] = None):
         self.device = device
@@ -144,7 +158,7 @@ class DeferredSolveStats:
         self._resolved: Optional[dict] = None
 
     def resolve(self, host_vec=None) -> dict:
-        """The stats dict; ``host_vec`` is the pre-fetched ``[4]`` vector
+        """The stats dict; ``host_vec`` is the pre-fetched ``[6]`` vector
         from the descent boundary drain (without it, direct callers pay
         their own fetch here — off the descent hot loop)."""
         if self._resolved is None:
@@ -1089,7 +1103,8 @@ class RandomEffectCoordinate:
         )
 
     def _bin_routes(self) -> list:
-        """Per-bin solver route (``newton``/``vmapped``/``row_split``) —
+        """Per-bin solver route (``newton``/``newton_cg``/``vmapped``/
+        ``row_split``) —
         see game.batched_solve.solver_route.  Cached per coordinate (the
         descent loop calls train() every outer iteration; the routes only
         change when onboarding extends the bin layout, which the bin-count
@@ -1114,12 +1129,20 @@ class RandomEffectCoordinate:
     def _solve_bin(self, route: str, batch, w0):
         """Dispatch one bin's batched solve along its resolved route: the
         batched-Cholesky Newton program (small-dim smooth bins), the
-        row-split psum solve, or the vmapped iterative solver (L1 /
-        large-dim bins — every existing problem config still solves)."""
+        matrix-free Newton-CG program (smooth bins past the dense-Hessian
+        cap — no ``[B, d, d]`` materialization), the row-split psum solve,
+        or the vmapped iterative solver (L1 / over-cap bins — every
+        existing problem config still solves)."""
         if route == "newton":
             from photon_tpu.game.batched_solve import cached_newton_solver
 
             return cached_newton_solver(self.config.problem)(
+                self.problem.objective, batch, w0
+            )
+        if route == "newton_cg":
+            from photon_tpu.game.batched_solve import cached_newton_cg_solver
+
+            return cached_newton_cg_solver(self.config.problem)(
                 self.problem.objective, batch, w0
             )
         if route == "row_split":
@@ -1176,11 +1199,12 @@ class RandomEffectCoordinate:
             None if initial_model is None else self._initial_table(initial_model)
         )
         # Per-coordinate device stats accumulator: entities / converged /
-        # iterations_max / quarantined fold in per bucket ON DEVICE, and
-        # train() returns the handle — no host sync here at all.  The
-        # descent loop drains every coordinate's accumulator in its single
-        # per-iteration stats/quarantine sync (descent.host_syncs).
-        acc = jnp.zeros(4, jnp.int32)
+        # iterations_max / quarantined / cg_iters fold in per bucket ON
+        # DEVICE, and train() returns the handle — no host sync here at
+        # all.  The descent loop drains every coordinate's accumulator in
+        # its single per-iteration stats/quarantine sync
+        # (descent.host_syncs).
+        acc = jnp.zeros(6, jnp.int32)
         from photon_tpu.fault.injection import consume_nan_injection
         from photon_tpu.game.projection import (
             IndexMapBucketProjection,
@@ -1273,6 +1297,7 @@ class RandomEffectCoordinate:
             acc = _accumulate_solve_stats(
                 acc, entity_idx, num_entities, result.converged,
                 result.iterations, good,
+                cg_iterations=getattr(result, "cg_iterations", None),
             )
         model = RandomEffectModel(
             table=table[:num_entities],
@@ -1462,10 +1487,10 @@ class FactoredRandomEffectCoordinate:
         # reported counts cover the FINAL z pass, like the dict the seed
         # rebuilt per alternation; drained by the descent loop's one
         # boundary sync.
-        acc = jnp.zeros(4, jnp.int32)
+        acc = jnp.zeros(6, jnp.int32)
         for it in range(self.config.latent_iterations):
             last = it == self.config.latent_iterations - 1
-            acc = jnp.zeros(4, jnp.int32)
+            acc = jnp.zeros(6, jnp.int32)
             for i, bucket in enumerate(self.device_data.buckets):
                 dev = self.device_data.device_buckets[i]
                 offsets_b = self.device_data._place(
@@ -1481,6 +1506,7 @@ class FactoredRandomEffectCoordinate:
                     acc, entity_idx, num_entities, result.converged,
                     result.iterations,
                     jnp.ones_like(result.converged, bool),
+                    cg_iterations=getattr(result, "cg_iterations", None),
                 )
             if not last:
                 z_rows = z_table[entity_of_row]

@@ -77,6 +77,17 @@ def _record_coordinate_info(telemetry, name: str, info) -> None:
         telemetry.gauge("re_solver.iterations_max", coordinate=name).set(
             info.get("iterations_max", 0)
         )
+        cg = info.get("cg_iters", 0)
+        if cg:
+            # Newton-CG bins only (ISSUE 14): mean inner-CG iterations per
+            # CG-ROUTED entity solve this outer iteration — the knob that
+            # tells whether the Eisenstat-Walker tolerance and the Jacobi
+            # preconditioner are doing their jobs.  The denominator is the
+            # CG bins' own entity count, so a coordinate mixing CG and
+            # dense/vmapped bins cannot dilute the mean.
+            telemetry.histogram("solves.cg_iters", coordinate=name).observe(
+                cg / max(info.get("cg_entities", 0), 1)
+            )
 
 
 class CoordinateDescent:

@@ -518,6 +518,16 @@ class StreamedRandomEffectCoordinate:
             return cached_newton_solver(self.config.problem)(
                 self.problem.objective, batch, w0
             )
+        if route == "newton_cg":
+            # Matrix-free large-dim route (ISSUE 14): streamed high-dim
+            # bins get the same Hessian-vector-product CG program as
+            # resident ones — no [B, d, d] block competes with the chunk
+            # window for device memory.
+            from photon_tpu.game.batched_solve import cached_newton_cg_solver
+
+            return cached_newton_cg_solver(self.config.problem)(
+                self.problem.objective, batch, w0
+            )
         return self._solver(batch, w0)
 
     def _initial_table(self, initial_model: RandomEffectModel):
@@ -553,7 +563,7 @@ class StreamedRandomEffectCoordinate:
             None if initial_model is None
             else self._initial_table(initial_model)
         )
-        acc = jnp.zeros(4, jnp.int32)
+        acc = jnp.zeros(6, jnp.int32)
         inject_nan = consume_nan_injection(getattr(self, "fault_name", None))
         routes = self._routes()
         blocks = self._bin_blocks()
@@ -580,6 +590,7 @@ class StreamedRandomEffectCoordinate:
             acc = _accumulate_solve_stats(
                 acc, entity_idx, num_entities, result.converged,
                 result.iterations, good,
+                cg_iterations=getattr(result, "cg_iterations", None),
             )
         model = RandomEffectModel(
             table=table[:num_entities],
@@ -1086,7 +1097,7 @@ class StreamedCoordinateDescent:
                     if isinstance(info, DeferredSolveStats):
                         if checkpointer is not None:
                             # Checkpointed runs resolve each coordinate's
-                            # stats NOW (one [4]-int32 fetch): the mid-epoch
+                            # stats NOW (one [6]-int32 fetch): the mid-epoch
                             # snapshot below must carry this coordinate's
                             # solve-stage quarantine count, or a kill+resume
                             # that skips past it would permanently lose the

@@ -267,13 +267,36 @@ class _ChildService:
     """The replica child's state: one scorer (+ artifact version) behind a
     lock so a ``swap`` and a concurrent ``score`` can never interleave a
     half-published model (the scorer's own one-assignment publication does
-    the real work; the lock only orders version bookkeeping)."""
+    the real work; the lock only orders version bookkeeping).
 
-    def __init__(self, replica_id: str, scorer, version: int):
+    ``telemetry`` is the child's own in-process registry: the scorer's
+    ``serving.*`` counters (host_syncs, batches, cold_entities, ...)
+    accrue HERE, in the child — the ``stats`` control frame is how they
+    reach the parent's run report (ISSUE 14 satellite; ROADMAP fleet
+    edge (e))."""
+
+    def __init__(self, replica_id: str, scorer, version: int,
+                 telemetry=None):
+        from photon_tpu.telemetry import NULL_SESSION
+
         self.replica_id = replica_id
         self.scorer = scorer
         self.version = version
+        self.telemetry = telemetry or NULL_SESSION
         self.lock = threading.Lock()
+
+    def serving_counters(self) -> list:
+        """This child's scorer-level ``serving.*`` counters as JSON-ready
+        ``{name, labels, value}`` rows — the ``stats`` frame payload.
+        Values are CUMULATIVE for the child's lifetime; the parent merges
+        deltas, so repeated pulls never double-count."""
+        snapshot = self.telemetry.registry.snapshot()
+        return [
+            {"name": m["name"], "labels": dict(m.get("labels") or {}),
+             "value": float(m["value"])}
+            for m in snapshot.get("counters", [])
+            if m["name"].startswith("serving.")
+        ]
 
     def maybe_fault(self) -> None:
         """The child-side fault surface: an injected ``replica:crash``
@@ -306,6 +329,15 @@ class _ChildService:
                     out = pack_control(
                         "pong", version=self.version, pid=os.getpid(),
                         compilations=self.scorer.compilations,
+                    )
+                elif kind == "stats":
+                    # Deliberately NOT behind maybe_fault: a stats pull is
+                    # advisory telemetry, not a liveness probe — the
+                    # injected crash/hang sites stay on the frames whose
+                    # failure semantics the supervisor tests pin.
+                    out = pack_control(
+                        "stats", version=self.version,
+                        counters=self.serving_counters(),
                     )
                 elif kind == "swap":
                     header = unpack_control(payload)
@@ -359,6 +391,7 @@ def _child_main(argv=None) -> None:
                      daemon=True).start()
 
     from photon_tpu.serving.scorer import GameScorer
+    from photon_tpu.telemetry import TelemetrySession
 
     model, version = load_model_artifact(args.artifact)
     spec = {
@@ -366,14 +399,20 @@ def _child_main(argv=None) -> None:
                          nnz=int(s.get("nnz", 0)))
         for shard, s in cfg["spec"].items()
     }
+    # The child's own registry: scorer counters accrue in THIS process and
+    # travel to the parent via the stats frame — never written to disk
+    # here (the parent's run report is the one report of the fleet).
+    session = TelemetrySession(f"replica-{cfg['replica_id']}")
     scorer = GameScorer(
         model,
         request_spec=spec,
         buckets=tuple(cfg["buckets"]) if cfg.get("buckets") else None,
         max_batch=int(cfg["max_batch"]),
         min_bucket=int(cfg["min_bucket"]),
+        telemetry=session,
     ).warmup()
-    service = _ChildService(cfg["replica_id"], scorer, version)
+    service = _ChildService(cfg["replica_id"], scorer, version,
+                            telemetry=session)
 
     class _Handler(socketserver.BaseRequestHandler):
         def handle(self):  # noqa: D102 — per-connection loop
@@ -459,6 +498,15 @@ class _RemoteScorer:
         self._store = store
         self._data_lock = threading.Lock()
         self._ctrl_lock = threading.Lock()
+        # Last-seen child counter values per (name, labels) — the delta
+        # base for stats pulls.  Lives on the scorer (fresh per spawned
+        # child), so a respawned child's counters restarting at zero can
+        # never produce negative deltas.  The lock serializes WHOLE pulls
+        # (exchange + read-merge-update): a supervisor-thread pull racing
+        # a direct pull_stats()/close() must not compute two deltas from
+        # one stale base and double-count into the parent registry.
+        self._stats_seen: Dict[tuple, float] = {}
+        self._stats_lock = threading.Lock()
         self._data = self._connect(port, timeout_s)
         self._ctrl = self._connect(port, timeout_s)
 
@@ -528,6 +576,23 @@ class _RemoteScorer:
         return call_with_timeout(
             exchange, deadline_s, site=f"replica:{self.replica_id}:ping"
         )
+
+    def stats(self, deadline_s: float = 5.0) -> list:
+        """Pull the child's cumulative ``serving.*`` counters over the
+        control connection (the ``stats`` frame — ISSUE 14 satellite).
+        Deadline-bounded like the ping: a wedged child must not hang the
+        supervisor's stats pass."""
+        from photon_tpu.fault.watchdog import call_with_timeout
+
+        def exchange():
+            with self._ctrl_lock:
+                write_frame(self._ctrl, pack_control("stats"))
+                return unpack_control(read_frame(self._ctrl))
+
+        header = call_with_timeout(
+            exchange, deadline_s, site=f"replica:{self.replica_id}:stats"
+        )
+        return header.get("counters", [])
 
     def shutdown(self, deadline_s: float = 5.0) -> None:
         from photon_tpu.fault.watchdog import call_with_timeout
@@ -693,6 +758,41 @@ class SubprocessReplica(ScorerReplica):
     def ping(self, deadline_s: float) -> dict:
         return self.scorer.ping(deadline_s)
 
+    def pull_stats(self, deadline_s: float = 5.0) -> dict:
+        """Pull the child's scorer-level ``serving.*`` counters and merge
+        the DELTA since the last pull into the parent's telemetry registry
+        under the same metric names plus a ``replica`` label (ISSUE 14
+        satellite / ROADMAP fleet edge (e)) — so a subprocess fleet's
+        host_syncs/batches/cold_entities land in the parent's run report
+        exactly like a thread replica's do.  Idempotent across repeated
+        pulls (cumulative child values, delta merge); the seen-state lives
+        on the per-child scorer, so a respawned child restarts the base at
+        zero.  Returns the merged deltas keyed by (name, labels)."""
+        scorer = self.scorer
+        seen = getattr(scorer, "_stats_seen", None)
+        stats = getattr(scorer, "stats", None)
+        lock = getattr(scorer, "_stats_lock", None)
+        if seen is None or stats is None or lock is None:
+            return {}
+        with lock:
+            merged = {}
+            for m in stats(deadline_s):
+                name = m.get("name")
+                labels = {
+                    str(k): str(v) for k, v in (m.get("labels") or {}).items()
+                }
+                value = float(m.get("value", 0.0))
+                key = (name, tuple(sorted(labels.items())))
+                delta = value - seen.get(key, 0.0)
+                if delta <= 0.0:
+                    continue
+                seen[key] = value
+                self.telemetry.counter(
+                    name, replica=self.replica_id, **labels
+                ).inc(delta)
+                merged[key] = delta
+            return merged
+
     def close(self) -> None:
         # Drain FIRST: close()'s contract (queued requests still get
         # scored) needs the child alive while the batcher empties; tearing
@@ -701,6 +801,13 @@ class SubprocessReplica(ScorerReplica):
         # (socket errors) inside the batcher's bounded join.
         super().close()
         if self._proc is not None and self._proc.poll() is None:
+            # Final stats pull AFTER the drain (so the drained batches are
+            # counted) and BEFORE teardown — a fleet that never ran a
+            # supervisor still gets its children's counters in the report.
+            try:
+                self.pull_stats(deadline_s=5.0)
+            except Exception:  # noqa: BLE001 — stats are advisory
+                pass
             try:
                 self.scorer.shutdown()
             except (OSError, TransportError):

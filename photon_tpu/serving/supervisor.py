@@ -217,10 +217,27 @@ class ReplicaSupervisor:
                 continue
             if replica.alive:
                 self._health_check(replica)
+            if replica.alive:
+                self._pull_stats(replica)
             if not replica.alive and not replica.quarantined:
                 self._note_death(replica)
                 if self.policy.resurrect and not replica.quarantined:
                     self._maybe_resurrect(replica)
+
+    def _pull_stats(self, replica) -> None:
+        """Child-telemetry aggregation (ISSUE 14 satellite / ROADMAP fleet
+        edge (e)): each healthy pass also pulls a subprocess replica's
+        scorer-level ``serving.*`` counters into the parent registry
+        (``SubprocessReplica.pull_stats`` — delta merge, idempotent).
+        Advisory only: a failed pull never declares a replica — liveness
+        verdicts belong to the probes above."""
+        pull = getattr(replica, "pull_stats", None)
+        if pull is None:
+            return
+        try:
+            pull(self.policy.probe_deadline_s)
+        except Exception:  # noqa: BLE001 — stats must never fail a pass
+            pass
 
     # -- detection ------------------------------------------------------------
     def _health_check(self, replica) -> None:

@@ -413,6 +413,22 @@ def _render_fleet_section(report: dict) -> list:
             f"{rid}:{phase}" for _, rid, phase in sorted(supervisor_steps)
         )
         lines.append(f"- **supervisor timeline**: {timeline}")
+    # Child telemetry aggregation (ISSUE 14 satellite): subprocess
+    # replicas' scorer counters arrive via the stats control frame merged
+    # under the same names + a replica label — thread replicas' own
+    # counters carry no replica label and are excluded here (key "?").
+    child_syncs = by_label(counters, "serving.host_syncs", "replica")
+    child_syncs.pop("?", None)
+    if child_syncs:
+        child_batches = by_label(counters, "serving.batches", "replica")
+        child_cold = by_label(counters, "serving.cold_entities", "replica")
+        parts = [
+            f"{rid}: host_syncs={_fmt(child_syncs[rid])}, "
+            f"batches={_fmt(child_batches.get(rid, 0))}, "
+            f"cold_entities={_fmt(child_cold.get(rid, 0))}"
+            for rid in sorted(child_syncs)
+        ]
+        lines.append("- **child scorers**: " + "; ".join(parts))
     return lines
 
 

@@ -361,7 +361,7 @@ def test_nan_quarantine_stays_per_entity_in_batched_solve():
 def test_accumulate_stats_masks_padded_and_quarantined():
     import jax.numpy as jnp
 
-    acc = jnp.zeros(4, jnp.int32)
+    acc = jnp.zeros(6, jnp.int32)
     # 3 real entities + 2 bin-padding slots (index == num_entities == 3).
     entity_index = jnp.asarray([0, 1, 2, 3, 3])
     converged = jnp.asarray([True, True, False, True, True])
@@ -371,8 +371,21 @@ def test_accumulate_stats_masks_padded_and_quarantined():
         _accumulate_solve_stats(acc, entity_index, 3, converged, iterations, good)
     )
     # entities: only real; converged: real AND good AND converged;
-    # iterations_max: padded slots' 99 masked out; quarantined: real ~good.
-    assert out.tolist() == [3, 1, 9, 1]
+    # iterations_max: padded slots' 99 masked out; quarantined: real ~good;
+    # cg_iters/cg_entities: no per-entity CG counts supplied -> 0.
+    assert out.tolist() == [3, 1, 9, 1, 0, 0]
+    # Newton-CG bins supply per-entity inner-iteration totals: summed over
+    # REAL entities only (padded slots' counts masked out), and the same
+    # bins' real entities land in cg_entities (the per-entity-mean
+    # denominator for mixed-route coordinates).
+    cg = jnp.asarray([7, 11, 2, 50, 50])
+    out = np.asarray(
+        _accumulate_solve_stats(
+            acc, entity_index, 3, converged, iterations, good,
+            cg_iterations=cg,
+        )
+    )
+    assert out.tolist() == [3, 1, 9, 1, 20, 3]
 
 
 # ---------------------------------------------------------------------------
@@ -685,7 +698,8 @@ def test_bench_entities_smoke(capsys):
 
     bench._bench_entities(max_entities=3000)
     out = capsys.readouterr().out
-    line = [ln for ln in out.splitlines() if "game_entity_solves_per_sec" in ln]
+    line = [ln for ln in out.splitlines()
+            if '"game_entity_solves_per_sec"' in ln]
     assert line, out
     import json
 
@@ -693,6 +707,15 @@ def test_bench_entities_smoke(capsys):
     detail = payload["detail"]
     assert detail["descent_parity"]["host_syncs_per_iteration"] == 1.0
     assert all(p["max_same_solver_diff"] <= 1e-5 for p in detail["curve"])
+    # The high-dim Newton-CG leg (ISSUE 14) rides the same mode: its
+    # ≥1×-the-L-BFGS-rate bar at d=256 is asserted inside the bench.
+    hidim = [ln for ln in out.splitlines()
+             if "game_entity_solves_per_sec_hidim" in ln]
+    assert hidim, out
+    hdetail = json.loads(hidim[-1])["detail"]
+    assert hdetail["dim"] == 256
+    assert hdetail["speedup_vs_vmapped_lbfgs"] >= 1.0
+    assert [p["dim"] for p in hdetail["curve"]] == [64, 256, 1024]
 
 
 @pytest.mark.slow

@@ -94,6 +94,16 @@ def test_self_healing_tier_is_guarded():
     assert "photon_tpu/serving/replica_proc.py" in guarded
 
 
+def test_newton_cg_solver_is_guarded():
+    """The matrix-free Newton-CG solver rides the default guard set
+    (ISSUE 14 satellite): it runs inside the bin loop of every large-dim
+    random-effect train, where an unmarked host fetch would repeal the
+    one-sync-per-iteration contract."""
+    from check_host_sync import DEFAULT_FILES
+
+    assert "photon_tpu/core/optimizers/newton_cg.py" in set(DEFAULT_FILES)
+
+
 def test_checker_ignores_jnp_and_comments(tmp_path):
     f = tmp_path / "f.py"
     f.write_text(

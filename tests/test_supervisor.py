@@ -669,6 +669,55 @@ def test_subprocess_replicas_end_to_end():
     ) == 1
 
 
+def test_child_stats_frame_merges_into_parent_report():
+    """ISSUE 14 satellite (ROADMAP fleet edge (e)): a subprocess replica's
+    scorer-level ``serving.*`` counters accrue in the CHILD process; the
+    ``stats`` control frame pulls them and merges deltas into the parent's
+    registry under the same names + a replica label — idempotent across
+    repeated pulls — and the fleet report renders the child-scorer row."""
+    from photon_tpu.telemetry.report import render_markdown
+
+    model, data = _fixture(seed=51)
+    session = TelemetrySession("test-child-stats")
+    spec = request_spec_for_dataset(model, data)
+    fleet = ServingFleet(
+        model, replicas=1, backend="subprocess", request_spec=spec,
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+    ).warmup()
+    try:
+        requests = build_requests(data, model, [4, 9, 2])
+        for req in requests:
+            fleet.score(req)
+        r0 = fleet.replicas[0]
+        merged = r0.pull_stats()
+        assert merged  # counters crossed the wire
+        # Delta merge: a second pull with no new traffic adds nothing.
+        assert r0.pull_stats() == {}
+        syncs_after_first = _counter_total(
+            session, "serving.host_syncs", replica="r0"
+        )
+        assert syncs_after_first == len(requests)  # 1 host sync per batch
+        # The supervisor's health pass pulls too (new traffic arrives, the
+        # next check_once folds it in — plus its own probe batch).
+        for req in requests:
+            fleet.score(req)
+        sup = fleet.supervise(
+            SupervisorPolicy(probe_interval_s=10.0, probe_deadline_s=30.0),
+            start=False,
+        )
+        sup.check_once()
+        syncs = _counter_total(session, "serving.host_syncs", replica="r0")
+        batches = _counter_total(session, "serving.batches", replica="r0")
+        assert syncs >= 2 * len(requests)
+        assert syncs == batches  # the child's one-sync-per-batch contract
+    finally:
+        fleet.close()
+    report = session.build_report()
+    text = render_markdown(report)
+    assert "child scorers" in text
+    assert "r0: host_syncs=" in text
+
+
 # -- report renderer -----------------------------------------------------------
 
 def test_report_renders_supervisor_timeline():

@@ -41,6 +41,11 @@ DEFAULT_FILES = (
     # every RandomEffectCoordinate.train: a host fetch here would repeal
     # the one-sync-per-iteration contract for every random coordinate.
     "photon_tpu/game/batched_solve.py",
+    # The matrix-free Newton-CG solver (ISSUE 14) is pure traced JAX — a
+    # host fetch inside its outer/inner loops would not just break the
+    # sync contract, it would break tracing; guarding it keeps a future
+    # "quick debug print" from landing.
+    "photon_tpu/core/optimizers/newton_cg.py",
     # The streamed (out-of-core) descent: score data moves host<->device
     # per CHUNK by design (that is the tier the data lives at), but every
     # such edge is a bulk streaming transfer carrying a marker — the only
