@@ -1842,6 +1842,171 @@ def _bench_fleet() -> None:
     })
 
 
+def _bench_online() -> None:
+    """Online-learning refresh micro-bench (``--mode online`` — ISSUE 15).
+
+    Builds a synthetic GAME fixture, fits + serves it on a 2-replica
+    fleet, then drives TWO online refresh rounds through the
+    :class:`~photon_tpu.online.service.OnlineLearningService` — each
+    appending rows for BOTH existing and new entities — measuring the
+    append→published refresh latency (``game_online_refresh_secs``, lower
+    is better; the second round is the steady-state number: the first pays
+    the grown-shape fixed-effect compile).
+
+    Asserts IN-BENCH:
+    - refreshed model ≡ a full offline retrain on the merged dataset
+      (rebuilt-from-scratch layouts, same warm start/iterations) to ≤1e-4
+      on scores — the in-place-growth data path changes NOTHING;
+    - zero full random-effect layout rebuilds
+      (``estimator.device_data_rebuilds{kind=random}`` == 0) and >0 rows
+      grown in place;
+    - zero serving-side compile events across both publishes
+      (``fleet.compilations`` unchanged after warmup).
+    """
+    import numpy as np
+
+    from photon_tpu.data.synthetic import make_game_data
+    from photon_tpu.game.data import DenseShard, GameDataset
+    from photon_tpu.game.estimator import GameEstimator
+    from photon_tpu.game.model import GameModel
+    from photon_tpu.online import (
+        OnlineLearningService,
+        QueueFeed,
+        RefreshPolicy,
+    )
+    from photon_tpu.serving.fleet import ServingFleet
+    from photon_tpu.serving.scorer import request_spec_for_dataset
+    from photon_tpu.telemetry import TelemetrySession
+
+    platform, _sizes, _data, config = _game_bench_fixture(
+        n_random_coords=2, descent_iterations=3
+    )
+    task = "linear_regression"
+
+    def cut(n_ent, seed, keep=None):
+        raw = make_game_data(n_ent, 6, 32, 8, seed=seed, n_random_coords=2)
+        sel = (
+            slice(None) if keep is None
+            else keep(raw["entity_ids"]["re0"])
+        )
+        return GameDataset.create(
+            raw["label"][sel],
+            {
+                "global": DenseShard(raw["x_fixed"][sel]),
+                "re0": DenseShard(raw["x_random"]["re0"][sel]),
+                "re1": DenseShard(raw["x_random"]["re1"][sel]),
+            },
+            id_columns={
+                "re0": raw["entity_ids"]["re0"][sel],
+                "re1": raw["entity_ids"]["re1"][sel],
+            },
+        )
+
+    n_entities = 2000
+    base = cut(n_entities, 0)
+    session = TelemetrySession("bench-online")
+    estimator = GameEstimator(task, base, telemetry=session)
+    model0 = estimator.fit([config])[0].model
+    fleet = ServingFleet(
+        model0, replicas=2,
+        request_spec=request_spec_for_dataset(model0, base),
+        telemetry=session, table_capacity_factor=2,
+    ).warmup()
+    compiles0 = fleet.compilations
+    feed = QueueFeed()
+    service = OnlineLearningService(
+        estimator, config, feed, model=model0, fleet=fleet,
+        policy=RefreshPolicy(refresh_iterations=3), telemetry=session,
+    )
+
+    latencies = []
+    grow = int(n_entities * 1.05)
+    try:
+        # Round 1: parity round — its merged dataset and refreshed model
+        # feed the full-retrain oracle below.
+        feed.append(cut(
+            grow, 1,
+            keep=lambda ids: (ids < n_entities // 10)
+            | (ids >= n_entities),
+        ))
+        result1 = service.refresh_once()
+        assert result1 is not None and result1.published
+        latencies.append(result1.latency_s)
+        merged1 = estimator.training_data
+        # Round 2: steady-state latency (round 1 pays the grown-shape
+        # fixed-effect compile; the bins themselves never recompile).
+        feed.append(cut(
+            grow, 2,
+            keep=lambda ids: (ids < n_entities // 10)
+            | (ids >= n_entities),
+        ))
+        result2 = service.refresh_once()
+        assert result2 is not None and result2.published
+        latencies.append(result2.latency_s)
+        assert fleet.compilations == compiles0, (
+            f"serving-side compiles during online publish: "
+            f"{fleet.compilations - compiles0}"
+        )
+    finally:
+        fleet.close()
+
+    # Full-retrain oracle for round 1: rebuilt-from-scratch layouts over
+    # the SAME merged dataset, warm-started from the same grown serving
+    # model, same iteration budget, no locks — the in-place-growth data
+    # path must change nothing.
+    fresh = GameEstimator(task, merged1)
+    warm_coords = {}
+    for name, m in model0.coordinates.items():
+        cc = config.coordinates[name]
+        if hasattr(m, "with_entities"):
+            warm_coords[name] = m.with_entities(
+                fresh.device_layout(cc).dataset.keys
+            )
+        else:
+            warm_coords[name] = m
+    full_model = fresh.fit(
+        [config], initial_model=GameModel(warm_coords, task)
+    )[0].model
+    parity = float(np.abs(
+        result1.model.score(merged1) - full_model.score(merged1)
+    ).max())
+    assert parity <= 1e-4, (
+        f"online refresh diverged from the full offline retrain: {parity}"
+    )
+
+    def counter_total(name, **labels):
+        return sum(
+            m["value"] for m in session.registry.snapshot()["counters"]
+            if m["name"] == name
+            and all((m.get("labels") or {}).get(k) == v
+                    for k, v in labels.items())
+        )
+
+    random_rebuilds = counter_total(
+        "estimator.device_data_rebuilds", kind="random"
+    )
+    rows_in_place = counter_total("onboard.rows_in_place")
+    assert random_rebuilds == 0, random_rebuilds
+    assert rows_in_place > 0
+
+    _emit("game_online_refresh_secs", latencies[-1], "s", {
+        "rows_base": base.num_examples,
+        "rows_ingested": int(counter_total("online.rows_ingested")),
+        "entities": n_entities,
+        "rounds": 2,
+        "first_round_secs": round(latencies[0], 4),
+        "steady_round_secs": round(latencies[-1], 4),
+        "refresh_iterations": 3,
+        "parity_vs_full_retrain": parity,
+        "rows_grown_in_place": int(rows_in_place),
+        "rows_migrated": int(counter_total("onboard.rows_migrated")),
+        "entities_new": int(counter_total("onboard.entities_new")),
+        "random_layout_rebuilds": int(random_rebuilds),
+        "serving_compiles_during_publish": fleet.compilations - compiles0,
+        "platform": platform,
+    })
+
+
 def _bench_recovery() -> None:
     """Checkpoint write/restore overhead micro-bench (``--mode recovery``).
 
@@ -2367,6 +2532,7 @@ def main() -> None:
             "serving": _bench_serving,
             "fleet": _bench_fleet,
             "ooc": _bench_ooc,
+            "online": _bench_online,
         }
         if mode == "ooc" and "--spill" in sys.argv[3:]:
             # ``--mode ooc --spill``: add the disk-tier leg (ISSUE 11) —
@@ -2425,6 +2591,10 @@ def main() -> None:
                           # over the TCP ingest, traffic replay, admission
                           # control — the serving number going forward.
                           ("game_fleet", _bench_fleet),
+                          # Online learning (ISSUE 15): append->serving
+                          # refresh latency + refreshed-vs-full-retrain
+                          # parity on the CPU fixture.
+                          ("game_online", _bench_online),
                           # spill=True: game_ooc_disk_rows_per_sec + the
                           # per-tier stall fractions ride the default run
                           # (ISSUE 11).

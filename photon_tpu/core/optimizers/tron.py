@@ -137,15 +137,31 @@ def tron(
     w0: Array,
     config: OptimizerConfig = OptimizerConfig(),
     hvp: Callable[[Array, Array], Array] | None = None,
+    hvp_at: Callable[[Array], Callable[[Array], Array]] | None = None,
 ) -> OptimizerResult:
     """Minimize ``fun`` (value, grad) with Hessian-vector products.
 
-    ``hvp(w, v) -> H(w) v``; if None it is derived from ``fun`` by jvp of the
-    gradient component (exact, one extra forward-over-reverse pass).
+    ``hvp_at(w) -> (v -> H(w) v)`` is the preferred form (ISSUE 15
+    satellite / ROADMAP solver edge (e)): the operator is built ONCE per
+    outer trust-region iteration, so a curvature-closure operator
+    (``GlmObjective.hvp_operator`` — per-row curvature ``D(w)`` precomputed
+    from the margins) pays the margin pass once and each inner CG iteration
+    costs two matvecs, instead of recomputing margins per product as the
+    per-call form does.  ``hvp(w, v) -> H(w) v`` is the legacy per-call
+    form (wrapped); with neither, the product derives from ``fun`` by jvp
+    of the gradient component (exact, one extra forward-over-reverse pass
+    per product — unchanged math, since jvp re-linearizes at the same
+    ``w`` every call).
     """
-    if hvp is None:
-        def hvp(w, v):  # noqa: ANN001
-            return jax.jvp(lambda u: fun(u)[1], (w,), (v,))[1]
+    if hvp_at is None:
+        if hvp is not None:
+            def hvp_at(w):  # noqa: ANN001 — legacy per-call wrapper
+                return lambda v: hvp(w, v)
+        else:
+            def hvp_at(w):  # noqa: ANN001 — jvp-of-grad fallback
+                return lambda v: jax.jvp(
+                    lambda u: fun(u)[1], (w,), (v,)
+                )[1]
 
     d = w0.shape[0]
     max_cg = config.cg_max_iterations or min(d, 100)
@@ -171,8 +187,10 @@ def tron(
         return s.active
 
     def body(s: _State):
+        # ONE curvature operator per outer iteration: the precomputed-
+        # curvature closure's margin pass runs here, not per CG product.
         step, resid, _ = _trcg(
-            lambda v: hvp(s.w, v), s.g, s.delta, max_cg, s.active,
+            hvp_at(s.w), s.g, s.delta, max_cg, s.active,
             cg_tolerance=config.cg_tolerance,
         )
         w_new = s.w + step

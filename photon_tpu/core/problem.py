@@ -91,9 +91,12 @@ def _run_fit(objective, batch: Batch, w0: Array, *, optimizer: str,
     if optimizer in ("owlqn", "owl-qn"):
         result = owlqn(fun, w0, cfg, l1_weight=objective.l1_weight)
     elif optimizer == "tron":
-        result = tron(
-            fun, w0, cfg, hvp=lambda w, v: objective.hessian_vector(w, v, batch)
-        )
+        # The precomputed-curvature operator (hvp_operator): margins/D(w)
+        # once per trust-region iteration, two matvecs per CG product —
+        # TRON stops recomputing margins per product (ROADMAP solver
+        # edge (e); objectives without hvp_operator fall back to per-call
+        # hessian_vector inside hvp_at_for, still matrix-free).
+        result = tron(fun, w0, cfg, hvp_at=hvp_at_for(objective, batch))
     elif optimizer in ("newton_cg", "newton-cg"):
         result = newton_cg(
             fun, w0, cfg,

@@ -1,0 +1,254 @@
+"""Online GAME learning driver: continual training + zero-downtime refresh.
+
+The data-in → model-out loop as a CLI (ISSUE 15): fit an initial GAME
+model on ``--input``, stand up a serving fleet on it, then watch
+``--append-dir`` for appended part files — each poll drains the backlog
+through the online-learning service (in-place device-data growth,
+warm-started partial refresh with untouched coordinates locked, canary
+``rollout`` publish) and records the append→serving refresh latency.
+
+    python -m photon_tpu.drivers.online_game \\
+        --input train.avro --append-dir appends/ \\
+        --feature-bags global=features,per_user=userFeatures \\
+        --id-columns userId \\
+        --coordinate fixed:type=fixed,shard=global \\
+        --coordinate per_user:type=random,shard=per_user,entity=userId \\
+        --task logistic_regression --replicas 2 \\
+        --checkpoint-dir ckpt --output-dir out
+
+The refresh loop is preemption-safe end to end with ``--checkpoint-dir``:
+a killed refresh resumes exactly (``descent:kill`` → restart → the same
+pending parts re-ingest, the round's descent checkpoint restores), and a
+kill between train and publish (``online:refresh:kill``) republishes the
+completed fit without retraining.  The final model and an
+``online_summary.json`` (rounds, rows, latency distribution) land in
+``--output-dir``; the telemetry run report carries the full
+``## Online learning`` section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from photon_tpu.drivers import common
+from photon_tpu.drivers.train_game import (
+    _build_sweep,
+    _coordinate_specs,
+    _load_game_data,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "photon_tpu.drivers.online_game", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    common.add_common_args(p)
+    p.add_argument("--input", required=True,
+                   help="initial training data: Avro file/dir/glob or "
+                   "synthetic-game spec (see train_game)")
+    p.add_argument("--append-dir", required=True,
+                   help="directory of appended part files (Avro), watched "
+                   "by the online feed; the consumed cursor lives here")
+    p.add_argument("--feature-bags", default=None)
+    p.add_argument("--id-columns", default=None)
+    p.add_argument("--task", default="logistic_regression")
+    p.add_argument("--coordinate", action="append", required=True,
+                   dest="coordinates", metavar="NAME:key=value,...",
+                   help="coordinate spec (train_game grammar); exactly one "
+                   "configuration — online refresh is not a sweep")
+    p.add_argument("--initial-iterations", type=int, default=2,
+                   help="outer descent iterations of the initial fit")
+    p.add_argument("--refresh-iterations", type=int, default=2,
+                   help="outer descent iterations per online refresh "
+                   "(warm-started)")
+    p.add_argument("--max-rounds", type=int, default=0,
+                   help="stop after this many refresh rounds (0 = drain "
+                   "the append directory once)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serving replicas behind the fleet router")
+    p.add_argument("--table-capacity-factor", type=int, default=2,
+                   help="pre-provisioned serving-table headroom factor: "
+                   "vocabulary growth hot-swaps in place until it outgrows "
+                   "factor x the initial entity count (then pow2)")
+    p.add_argument("--no-lock-untouched", action="store_true",
+                   help="retrain every coordinate each refresh instead of "
+                   "locking the ones the appended rows do not touch")
+    p.add_argument("--rollout-parity-tol", type=float, default=1e-3,
+                   help="canary parity gate of each publish")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="per-round descent checkpoints + the durable round "
+                   "counter (preemption-safe refresh)")
+    p.add_argument("--max-quarantined", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    common.select_backend(args.backend)
+    from photon_tpu.utils import PhotonLogger
+
+    logger = PhotonLogger("photon_tpu.online_game", args.log_file)
+    with common.telemetry_run(args, "online_game", logger) as session:
+        return _run(args, logger, session)
+
+
+def _run(args: argparse.Namespace, logger, session) -> dict:
+    from photon_tpu.game.estimator import GameEstimator
+    from photon_tpu.game.model_io import save_game_model
+    from photon_tpu.online import (
+        DirectoryFeed,
+        OnlineLearningService,
+        RefreshPolicy,
+    )
+    from photon_tpu.serving.fleet import ServingFleet
+    from photon_tpu.serving.scorer import request_spec_for_dataset
+
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    specs = _coordinate_specs(args)
+    configurations = _build_sweep(specs, args.task)
+    if len(configurations) != 1:
+        raise ValueError(
+            "online refresh takes exactly ONE configuration (no "
+            "reg-weight sweeps); got "
+            f"{len(configurations)} combinations"
+        )
+    _label, coords, _combo = configurations[0]
+    from photon_tpu.game.estimator import GameOptimizationConfiguration
+
+    config = GameOptimizationConfiguration(
+        coordinates=coords,
+        descent_iterations=args.initial_iterations,
+        name="online",
+    )
+
+    with logger.timed("load-data"):
+        data, index_maps = _load_game_data(
+            args.input, args, telemetry=session
+        )
+        logger.info("initial training data: %d rows", data.num_examples)
+
+    def load_part(path):
+        return _load_game_data(
+            path, args, index_maps=index_maps, telemetry=session
+        )[0]
+
+    feed = DirectoryFeed(
+        args.append_dir, loader=load_part,
+        telemetry=session, logger=logger,
+    )
+    # RESTART: parts already published by a previous run are skipped by
+    # the feed's consumed cursor, but the merged training data itself is
+    # not durable — re-merge them (sorted order, the original ingest
+    # order) so the reconstructed dataset equals the killed run's.
+    consumed = feed.consumed_sources()
+    if consumed:
+        from photon_tpu.online import merge_append
+
+        n_before = data.num_examples
+        column_filled = False
+        with logger.timed("replay-consumed-parts"):
+            for name in consumed:
+                part = load_part(os.path.join(args.append_dir, name))
+                data, absent = merge_append(data, part)
+                column_filled = column_filled or any(
+                    mask.any() for mask in absent.values()
+                )
+        logger.info(
+            "restart: re-merged %d published part(s) (%d rows) into the "
+            "training data", len(consumed), data.num_examples - n_before,
+        )
+        if column_filled:
+            logger.warning(
+                "restart: a published part omitted an id column; its "
+                "missing-marker rows will form a marker entity in the "
+                "rebuilt layouts (cold rebuilds have no absent-row mask)"
+            )
+
+    estimator = GameEstimator(
+        args.task, data, telemetry=session, logger=logger
+    )
+    with logger.timed("initial-fit"):
+        model = estimator.fit(
+            [config], max_quarantined=args.max_quarantined
+        )[0].model
+
+    with logger.timed("build-fleet"):
+        fleet = ServingFleet(
+            model,
+            replicas=args.replicas,
+            request_spec=request_spec_for_dataset(model, data),
+            telemetry=session,
+            table_capacity_factor=args.table_capacity_factor,
+        ).warmup()
+        logger.info("fleet warm: %d replicas, %d programs",
+                    args.replicas, fleet.compilations)
+
+    service = OnlineLearningService(
+        estimator, config, feed, model=model, fleet=fleet,
+        checkpoint_dir=args.checkpoint_dir,
+        policy=RefreshPolicy(
+            refresh_iterations=args.refresh_iterations,
+            lock_untouched=not args.no_lock_untouched,
+            max_quarantined=args.max_quarantined,
+            rollout_parity_tol=args.rollout_parity_tol,
+        ),
+        telemetry=session,
+        logger=logger,
+    )
+
+    rounds = []
+    try:
+        with logger.timed("online-refresh"):
+            while True:
+                result = service.refresh_once()
+                if result is None:
+                    break
+                rounds.append(result)
+                if args.max_rounds and len(rounds) >= args.max_rounds:
+                    break
+    finally:
+        fleet.close()
+
+    model_dir = os.path.join(args.output_dir, "model")
+    with logger.timed("save-model"):
+        save_game_model(
+            model_dir, service.model, index_maps or {}, telemetry=session
+        )
+
+    latencies = [r.latency_s for r in rounds]
+    summary = {
+        "rounds": len(rounds),
+        "rows_ingested": int(sum(r.rows for r in rounds)),
+        "coordinates": list(config.coordinates),
+        "locked_per_round": [r.locked for r in rounds],
+        "published": sum(1 for r in rounds if r.published),
+        "replicas": args.replicas,
+        "refresh_latency_s": {
+            "mean": round(float(np.mean(latencies)), 4) if latencies else 0.0,
+            "max": round(float(np.max(latencies)), 4) if latencies else 0.0,
+        },
+        "compiled_programs": fleet.compilations,
+        "model_dir": model_dir,
+    }
+    with open(os.path.join(args.output_dir, "online_summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    logger.info(
+        "online loop done: %d round(s), %d rows, mean refresh %.3fs",
+        summary["rounds"], summary["rows_ingested"],
+        summary["refresh_latency_s"]["mean"],
+    )
+    return summary
+
+
+def main(argv=None) -> None:
+    common.run_cli(run, build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

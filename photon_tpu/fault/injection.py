@@ -122,6 +122,13 @@ KNOWN_FAULT_SITES = {
     "replica:spawn": "transient failure spawning/respawning a serving "
                      "replica (retriable: the supervisor retries with "
                      "capped exponential backoff)",
+    "online:ingest": "transient IOError reading an append-feed part file "
+                     "(online-learning ingest; retriable — the part stays "
+                     "pending and re-reads with backoff)",
+    "online:refresh:kill": "kill an online refresh between train and "
+                           "publish: the restarted service resumes the "
+                           "COMPLETED fit from its round checkpoint and "
+                           "publishes without retraining",
 }
 
 
@@ -197,13 +204,22 @@ class FaultPlan:
                     f"bad fault rule {raw!r}: want scope:action[:k=v...] "
                     "or scope:k=v[...]"
                 )
-            if "=" in tokens[1]:
-                # Single-token site (e.g. ``preempt:iter=2``): the second
-                # token is already a parameter, not an action.
-                site, param_tokens = tokens[0].strip(), tokens[1:]
-            else:
-                site = f"{tokens[0].strip()}:{tokens[1].strip()}"
-                param_tokens = tokens[2:]
+            # The site name is every leading token that is not a ``k=v``
+            # parameter: one token (``preempt:iter=2``), the common two
+            # (``io:read:p=0.3``), or three (``online:refresh:kill:iter=0``).
+            # A 3+-token site must be REGISTERED — otherwise a mistyped
+            # parameter (``io:read:oops``) would silently become part of a
+            # site name nothing ever consumes.
+            end = 1
+            while end < len(tokens) and "=" not in tokens[end]:
+                end += 1
+            site = ":".join(t.strip() for t in tokens[:end])
+            if end > 2 and site not in KNOWN_FAULT_SITES:
+                raise ValueError(
+                    f"bad fault param {tokens[2]!r} in rule {raw!r} "
+                    "(want k=v)"
+                )
+            param_tokens = tokens[end:]
             params = {}
             for tok in param_tokens:
                 k, sep, v = tok.partition("=")

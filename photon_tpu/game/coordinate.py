@@ -44,6 +44,8 @@ from photon_tpu.core.problem import GlmOptimizationProblem, ProblemConfig
 from photon_tpu.data.batch import DenseBatch, SparseBatch, with_offset
 from photon_tpu.game.data import (
     DenseShard,
+    EntityBucket,
+    Float,
     GameDataset,
     RandomEffectDataset,
     _gather_shard_rows,
@@ -700,6 +702,10 @@ class RandomEffectDeviceData:
         self.buckets: list = []
         self.device_buckets: list = []
         self.bin_stats: list = []
+        # Per-entity placement index (bin / slot / used rows), built lazily
+        # by _entity_locator for the in-place growth path and invalidated
+        # whenever the layout changes.
+        self._locator = None
         self._append_bins(self.dataset.buckets)
 
     def _append_bins(self, raw_buckets) -> None:
@@ -848,12 +854,18 @@ class RandomEffectDeviceData:
             dev["feats"][0], dev["feats"][1], dev["label"], offsets_b, dev["weight"]
         )
 
-    def check_onboard(self, data: GameDataset) -> None:
+    def check_onboard(self, data: GameDataset, absent_tail=None) -> None:
         """Validate :meth:`onboard`'s preconditions WITHOUT mutating — so a
         caller onboarding several layouts (the estimator's device-data
         cache) can reject the whole batch up front instead of leaving some
         layouts grown and others not (a half-onboarded cache would mix
-        grown bucket row indices with old-length offset vectors)."""
+        grown bucket row indices with old-length offset vectors).
+
+        Appended rows may reference BOTH new and existing entities (ISSUE
+        15 blocker fix — existing-entity rows grow the layout in place).
+        ``absent_tail`` is an optional bool mask over the appended rows
+        marking rows that carry NO id for this coordinate (the online
+        ingest's missing-column fill): they are skipped, not bucketed."""
         old = self.dataset
         n_old = len(old.entity_idx_per_row)
         if data.num_examples < n_old:
@@ -861,99 +873,467 @@ class RandomEffectDeviceData:
                 f"onboard() needs the GROWN dataset: got {data.num_examples} "
                 f"rows, the layout was built from {n_old}"
             )
-        raw_tail = data.id_columns[self.config.entity_column][n_old:]
-        if len(raw_tail) and (entity_index_for(raw_tail, old.keys) >= 0).any():
+        if self.config.entity_column not in data.id_columns:
+            raise KeyError(
+                f"grown dataset lacks id column {self.config.entity_column!r}"
+            )
+        shard = data.shard(self.config.shard_name)  # raises on a missing shard
+        if shard.dim != self.dim:
             raise ValueError(
-                "appended rows reference EXISTING entities; incremental "
-                "onboarding only appends new entities — rebuild the device "
-                "data to retrain existing entities on new rows"
+                f"appended shard {self.config.shard_name!r} has dim "
+                f"{shard.dim}; the layout was built at dim {self.dim}"
+            )
+        if self.buckets:
+            built_dense = isinstance(self.buckets[0].features, DenseShard)
+            if isinstance(shard, DenseShard) != built_dense:
+                raise ValueError(
+                    f"grown shard {self.config.shard_name!r} is "
+                    f"{'dense' if not built_dense else 'sparse'} but the "
+                    f"layout was built "
+                    f"{'dense' if built_dense else 'sparse'}; coerce the "
+                    "appended rows to the layout's storage (the online "
+                    "merge does) or rebuild"
+                )
+        n_tail = data.num_examples - n_old
+        if absent_tail is not None and len(absent_tail) != n_tail:
+            raise ValueError(
+                f"absent_tail mask covers {len(absent_tail)} rows, the "
+                f"appended tail has {n_tail}"
             )
 
-    def onboard(self, data: GameDataset) -> None:
-        """Incremental entity onboarding: extend this device layout with NEW
-        entities whose rows were APPENDED to the training data, without a
-        full rebuild.
+    def _entity_locator(self):
+        """``[bin_of, slot_of, used]`` per entity over the CURRENT layout —
+        which bin block holds the entity, at which slot, with how many live
+        (weight > 0) rows.  The in-place growth path's placement index;
+        built lazily, invalidated by :meth:`onboard`."""
+        if self._locator is None:
+            n_entities = self.dataset.num_entities
+            bin_of = np.full(n_entities, -1, np.int32)
+            slot_of = np.zeros(n_entities, np.int32)
+            used = np.zeros(n_entities, np.int32)
+            for i, bucket in enumerate(self.buckets):
+                idx = bucket.entity_index
+                live = idx < n_entities  # skip dummy/padded/migrated-away
+                if not live.any():
+                    continue
+                slots = np.nonzero(live)[0].astype(np.int32)
+                bin_of[idx[live]] = i
+                slot_of[idx[live]] = slots
+                used[idx[live]] = (
+                    bucket.row_weight[slots] > 0
+                ).sum(axis=1).astype(np.int32)
+            self._locator = [bin_of, slot_of, used]
+        return self._locator
 
-        ``data`` is the grown dataset — its first ``n_old`` rows must be the
-        rows this layout was built from (append-only; existing entities'
-        data cannot change through this path, and appended rows referencing
-        an existing entity are rejected).  Work done here is proportional to
-        the NEW entities: their rows are bucketed, binned, and uploaded as
-        appended bins; the resident feature blocks of existing bins are
-        untouched — only their tiny ``entity_index`` vectors are remapped
-        (one device gather each) onto the merged vocabulary, whose sort
-        order interleaves the new keys.  Scoring-side caches (features /
-        per-row entity index) are dropped and lazily rebuilt at the grown
-        row count on next use."""
-        from photon_tpu.game.data import take_rows
+    def _plan_append_buckets(self, data, entities, rows_by_entity,
+                             corrections):
+        """Host ``EntityBucket``s for appended entities (new arrivals and
+        migrations alike): ``entities`` are MERGED-vocabulary indices,
+        ``rows_by_entity[i]`` the kept global row ids, ``corrections[i]``
+        the active-cap weight correction.  Row capacities are the next
+        power of two past each entity's kept count — the same amortized-
+        doubling headroom the original bucketing gives, so a steadily
+        growing entity migrates O(log rows) times."""
+        from photon_tpu.utils import pow2_at_least
 
-        self.check_onboard(data)
+        if not entities:
+            return []
+        shard = data.shard(self.config.shard_name)
+        # host-sync: append-bucket planning — pure host numpy over the
+        # delta's row lists, no device data involved.
+        counts = np.asarray([len(r) for r in rows_by_entity], np.int64)
+        caps = np.asarray([pow2_at_least(int(c)) for c in counts], np.int64)
+        buckets = []
+        for capacity in np.unique(caps):
+            members = np.nonzero(caps == capacity)[0]
+            n_e = len(members)
+            row_index = np.zeros((n_e, capacity), np.int64)
+            mask = np.zeros((n_e, capacity), np.float32)
+            corr = np.ones(n_e, np.float32)
+            for k, m in enumerate(members):
+                rr = rows_by_entity[m]
+                row_index[k, : len(rr)] = rr
+                mask[k, : len(rr)] = 1.0
+                corr[k] = corrections[m]
+            row_weight = (
+                data.weight[row_index] * mask * corr[:, None]
+            ).astype(Float)
+            buckets.append(
+                EntityBucket(
+                    row_capacity=int(capacity),
+                    # host-sync: host bucket assembly (merged entity ids).
+                    entity_index=np.asarray(
+                        [entities[m] for m in members], np.int32
+                    ),
+                    row_index=row_index,
+                    row_weight=row_weight,
+                    label=(data.label[row_index] * mask).astype(Float),
+                    features=_gather_shard_rows(shard, row_index),
+                )
+            )
+        return buckets
+
+    def _grow_bin_in_place(self, i: int, slots, pos, rows, data) -> None:
+        """Scatter appended rows into bin ``i``'s row-capacity headroom —
+        host arrays and the resident device blocks both.  No shape changes,
+        so every compiled solve program over this bin stays valid (the
+        serving-table capacity trick applied to training bins)."""
+        bucket = self.buckets[i]
+        shard = data.shard(self.config.shard_name)
+        w = data.weight[rows].astype(Float)
+        lab = data.label[rows].astype(Float)
+        bucket.row_index[slots, pos] = rows
+        bucket.row_weight[slots, pos] = w
+        bucket.label[slots, pos] = lab
+        feats = bucket.features
+        if isinstance(feats, DenseShard):
+            new_ids = new_vals = None
+            feats.x[slots, pos] = shard.x[rows]
+        else:
+            # The plan phase routed wider-than-block rows to migration;
+            # narrower rows pad up to the block's nonzero width (zero
+            # ids/vals are inert, the padded-COO convention).
+            k_block = feats.ids.shape[-1]
+            k_shard = shard.ids.shape[1]
+            new_ids, new_vals = shard.ids[rows], shard.vals[rows]
+            if k_shard < k_block:
+                widths = [(0, 0), (0, k_block - k_shard)]
+                new_ids = np.pad(new_ids, widths)
+                new_vals = np.pad(new_vals, widths)
+            feats.ids[slots, pos] = new_ids
+            feats.vals[slots, pos] = new_vals
+        dev = self.device_buckets[i]
+        sl, po = jnp.asarray(slots), jnp.asarray(pos)
+        dev["label"] = self._place(
+            dev["label"].at[sl, po].set(jnp.asarray(lab))
+        )
+        dev["weight"] = self._place(
+            dev["weight"].at[sl, po].set(jnp.asarray(w))
+        )
+        if dev["dense"]:
+            dev["feats"] = (
+                self._place(
+                    dev["feats"][0].at[sl, po].set(jnp.asarray(shard.x[rows]))
+                ),
+            )
+        else:
+            dev["feats"] = (
+                self._place(
+                    dev["feats"][0].at[sl, po].set(jnp.asarray(new_ids))
+                ),
+                self._place(
+                    dev["feats"][1].at[sl, po].set(jnp.asarray(new_vals))
+                ),
+            )
+        if "row_index" in dev:
+            # The residual engine's cached gather buffers follow the bin.
+            dev["row_index"] = self._place(
+                dev["row_index"].at[sl, po].set(jnp.asarray(rows))
+            )
+            dev["row_mask"] = self._place(dev["row_mask"].at[sl, po].set(1.0))
+        self.bin_stats[i]["live_rows"] += int(len(rows))
+
+    def _neutralize_slot(self, i: int, slot: int, dummy: int,
+                         used: int) -> None:
+        """Retire a migrated-away entity's old slot: dummy entity index (its
+        scatter lands on the coefficient table's absorbing row, masked out
+        of the solve stats) and zero row weights (invisible to the
+        objective).  The slot's feature block stays resident — dead padding,
+        exactly like a bucket's built-in pad rows."""
+        bucket = self.buckets[i]
+        bucket.entity_index[slot] = dummy
+        bucket.row_weight[slot, :] = 0.0
+        dev = self.device_buckets[i]
+        dev["entity_index"] = dev["entity_index"].at[slot].set(dummy)
+        dev["weight"] = self._place(dev["weight"].at[slot].set(0.0))
+        if "row_mask" in dev:
+            dev["row_mask"] = self._place(dev["row_mask"].at[slot].set(0.0))
+        self.bin_stats[i]["live_rows"] -= int(used)
+        self.bin_stats[i]["live_entities"] -= 1
+
+    def _record_headroom(self, telemetry) -> None:
+        """Capacity-headroom accounting (ISSUE 15 satellite): per-bin padded
+        row cells vs live rows — the room the next append lands in without
+        a migration."""
+        col = self.config.entity_column
+        for i, st in enumerate(self.bin_stats):
+            cells = st["capacity"] * st["total_entities"]
+            telemetry.gauge(
+                "onboard.bin_row_capacity", column=col, bin=i
+            ).set(cells)
+            telemetry.gauge(
+                "onboard.bin_rows_live", column=col, bin=i
+            ).set(st["live_rows"])
+            telemetry.gauge(
+                "onboard.bin_row_headroom", column=col, bin=i
+            ).set(cells - st["live_rows"])
+
+    def onboard(self, data: GameDataset, telemetry=None,
+                absent_tail=None) -> None:
+        """Incremental onboarding: extend this device layout with rows
+        APPENDED to the training data — for BOTH new and existing entities
+        — without a full rebuild (ISSUE 15: the continual-training blocker
+        fix).
+
+        ``data`` is the grown dataset — its first ``n_old`` rows must be
+        the rows this layout was built from (append-only).  Work done here
+        is proportional to the APPENDED rows:
+
+        - Rows for NEW entities are bucketed, binned, and uploaded as
+          appended bins; existing bins' tiny ``entity_index`` vectors are
+          remapped (one device gather each) onto the merged vocabulary.
+        - Rows for EXISTING entities land IN PLACE: each power-of-two bin
+          block carries row-capacity headroom, and the new rows scatter
+          into the owning entity's free padded slots on host AND device —
+          no shapes change, no recompiles, resident feature blocks
+          untouched.
+        - An entity whose headroom is exhausted — or that crosses the
+          active-row cap, or lives under a per-bin projection (whose
+          feature transform its new rows would invalidate) — MIGRATES: its
+          old slot is neutralized (dummy index, zero weights) and its full
+          row set re-buckets into an appended bin at the next power-of-two
+          capacity (amortized doubling).  An entity pushed past
+          ``active_row_cap`` re-subsamples with a per-entity seeded draw
+          (unbiased weight correction; the draw is per-entity stable, not
+          byte-identical to a cold rebuild's shared-stream draws).
+
+        ``absent_tail`` (bool mask over the appended rows) marks rows that
+        carry no id for this coordinate (the online ingest's missing-
+        column fill): they keep per-row entity index -1 — zero margin from
+        this coordinate, no bin membership.
+
+        A batch failing validation mutates NOTHING: every rejection happens
+        in the plan phase, before the first host/device write.  Scoring-
+        side caches are dropped and lazily rebuilt at the grown row count.
+        """
+        from photon_tpu.telemetry import NULL_SESSION
+
+        telemetry = telemetry or NULL_SESSION
+        self.check_onboard(data, absent_tail=absent_tail)
         old = self.dataset
         n_old = len(old.entity_idx_per_row)
-        raw_tail = data.id_columns[self.config.entity_column][n_old:]
-        if len(raw_tail) == 0:
+        n_tail = data.num_examples - n_old
+        if n_tail == 0:
             return
-        merged_keys = np.unique(np.concatenate([old.keys, np.unique(raw_tail)]))
-        # Old index -> merged index, with the dummy padding slot
-        # (old num_entities) mapped to the NEW dummy slot.
-        remap = entity_index_for(old.keys, merged_keys)
-        remap_full = np.concatenate(
-            [remap, [len(merged_keys)]]
-        ).astype(np.int32)
-        remap_dev = jnp.asarray(remap_full)
-        for i, bucket in enumerate(self.buckets):
-            self.buckets[i] = dataclasses.replace(
-                bucket, entity_index=remap_full[bucket.entity_index]
-            )
-            dev = self.device_buckets[i]
-            dev["entity_index"] = remap_dev[dev["entity_index"]]
-        old_per_row = np.where(
-            old.entity_idx_per_row >= 0,
-            remap_full[np.maximum(old.entity_idx_per_row, 0)],
-            -1,
-        ).astype(np.int32)
+        col = self.config.entity_column
+        raw_tail = data.id_columns[col][n_old:]
+        present = np.ones(n_tail, bool)
+        if absent_tail is not None:
+            present &= ~absent_tail.astype(bool)
+        sel = np.nonzero(present)[0]
+        raw_present = raw_tail[sel]
 
-        # Bucket ONLY the appended rows (local entity space), then lift the
-        # bucket indices into the merged vocabulary / global row space.
-        tail = take_rows(data, np.arange(n_old, data.num_examples))
-        new_ds = build_random_effect_dataset(
-            tail,
-            entity_column=self.config.entity_column,
-            shard_name=self.config.shard_name,
-            active_row_cap=self.config.active_row_cap,
-            seed=self.config.seed,
+        # ---- plan phase: NO mutation until every input is validated ----
+        old_idx = (
+            entity_index_for(raw_present, old.keys)
+            if len(raw_present) else np.zeros(0, np.int32)
         )
-        new_to_merged = np.concatenate([
-            entity_index_for(new_ds.keys, merged_keys),
-            [len(merged_keys)],  # new-bucket dummy slot -> merged dummy
-        ]).astype(np.int32)
+        new_mask = old_idx < 0
+        new_raw = raw_present[new_mask]
+        if len(new_raw):
+            merged_keys = np.unique(
+                np.concatenate([old.keys, np.unique(new_raw)])
+            )
+        else:
+            merged_keys = old.keys
+        grew = len(merged_keys) != len(old.keys)
+        dummy = len(merged_keys)
+        if grew:
+            remap = entity_index_for(old.keys, merged_keys)
+            # Old index -> merged index, with the dummy padding slot
+            # (old num_entities) mapped to the NEW dummy slot.
+            remap_full = np.concatenate(
+                [remap, [dummy]]
+            ).astype(np.int32)
+        else:
+            remap_full = None
+        # Per-row map of the appended tail in MERGED space (-1 = absent).
+        tail_idx = np.full(n_tail, -1, np.int32)
+        if len(raw_present):
+            tail_idx[sel] = entity_index_for(raw_present, merged_keys)
+        tail_global = n_old + sel
+
+        bin_of, slot_of, used_of = self._entity_locator()  # OLD index space
+        cap = self.config.active_row_cap
+        shard = data.shard(self.config.shard_name)
+        # Sparse shards: an in-place write must fit the bin block's
+        # padded-COO nonzero width (a merged append can WIDEN the shard —
+        # wider rows migrate instead, into blocks built at the new width;
+        # narrower rows pad up in _grow_bin_in_place).
+        shard_k = (
+            None if isinstance(shard, DenseShard) else shard.ids.shape[1]
+        )
+
+        def width_fits(i: int) -> bool:
+            if shard_k is None:
+                return True
+            feats = self.buckets[i].features
+            return shard_k <= feats.ids.shape[-1]
+        append_entities: list = []  # merged entity index per appended entity
+        append_rows: list = []      # kept global row ids per appended entity
+        append_corr: list = []      # active-cap weight correction
+        in_place: dict = {}         # bin -> [(slot, used, rows)]
+        neutralize: list = []       # (bin, slot, used) of migrated entities
+        in_place_rows = 0
+        migrated_rows = 0
+        n_migrated = 0
+
+        exist_pos = np.nonzero(~new_mask)[0]
+        if len(exist_pos):
+            ents_old = old_idx[exist_pos]
+            order = np.argsort(ents_old, kind="stable")
+            ents_sorted = ents_old[order]
+            rows_sorted = tail_global[exist_pos[order]]
+            uniq, starts = np.unique(ents_sorted, return_index=True)
+            bounds = np.append(starts, len(ents_sorted))
+            # True per-entity base row counts (the active-cap accounting):
+            # the per-row map covers every base row, including rows a
+            # previous subsample dropped from the bin.
+            full_counts = np.bincount(
+                old.entity_idx_per_row[old.entity_idx_per_row >= 0],
+                minlength=len(old.keys),
+            )
+            migrating: list = []
+            for j, e_old in enumerate(uniq):
+                rr = rows_sorted[bounds[j]: bounds[j + 1]]
+                i = int(bin_of[e_old])
+                u = int(used_of[e_old])
+                total = int(full_counts[e_old]) + len(rr)
+                subsampled = int(full_counts[e_old]) > u
+                fits = (
+                    i >= 0
+                    and not subsampled
+                    and (cap is None or total <= cap)
+                    and u + len(rr) <= self.buckets[i].row_capacity
+                    and self.config.projection == "none"
+                    and width_fits(i)
+                )
+                if fits:
+                    in_place.setdefault(i, []).append(
+                        (int(slot_of[e_old]), u, rr)
+                    )
+                    in_place_rows += len(rr)
+                else:
+                    migrating.append((int(e_old), rr, i, int(slot_of[e_old]),
+                                      u))
+            n_migrated = len(migrating)
+            for e_old, rr, i, s, u in migrating:
+                # The entity's true base row universe, from the per-row
+                # map (the bin may hold only a subsample of it).
+                base_rows = np.nonzero(old.entity_idx_per_row == e_old)[0]
+                all_rows = np.concatenate([base_rows, rr])
+                corr = 1.0
+                if cap is not None and len(all_rows) > cap:
+                    rng = np.random.default_rng(
+                        (self.config.seed, 0x6F6E6C, int(e_old))
+                    )
+                    keep = rng.choice(len(all_rows), size=cap, replace=False)
+                    keep.sort()
+                    corr = len(all_rows) / cap
+                    all_rows = all_rows[keep]
+                append_entities.append(
+                    int(remap_full[e_old]) if grew else int(e_old)
+                )
+                append_rows.append(all_rows)
+                append_corr.append(corr)
+                migrated_rows += len(rr)
+                if i >= 0:
+                    neutralize.append((i, s, u))
+
+        n_new_entities = 0
+        if new_mask.any():
+            ents_new = tail_idx[sel[new_mask]]  # merged index
+            rows_new = tail_global[new_mask]
+            order = np.argsort(ents_new, kind="stable")
+            es, rs = ents_new[order], rows_new[order]
+            uniq, starts = np.unique(es, return_index=True)
+            bounds = np.append(starts, len(es))
+            n_new_entities = len(uniq)
+            for j, e in enumerate(uniq):
+                rr = rs[bounds[j]: bounds[j + 1]]
+                corr = 1.0
+                if cap is not None and len(rr) > cap:
+                    rng = np.random.default_rng(
+                        (self.config.seed, 0x6F6E6C, int(e))
+                    )
+                    keep = rng.choice(len(rr), size=cap, replace=False)
+                    keep.sort()
+                    corr = len(rr) / cap
+                    rr = rr[keep]
+                append_entities.append(int(e))
+                append_rows.append(rr)
+                append_corr.append(corr)
+        append_buckets = self._plan_append_buckets(
+            data, append_entities, append_rows, append_corr
+        )
+
+        # ---- apply phase: mutations only, nothing below rejects input ----
+        if grew:
+            remap_dev = jnp.asarray(remap_full)
+            for i, bucket in enumerate(self.buckets):
+                self.buckets[i] = dataclasses.replace(
+                    bucket, entity_index=remap_full[bucket.entity_index]
+                )
+                dev = self.device_buckets[i]
+                dev["entity_index"] = remap_dev[dev["entity_index"]]
+            old_per_row = np.where(
+                old.entity_idx_per_row >= 0,
+                remap_full[np.maximum(old.entity_idx_per_row, 0)],
+                -1,
+            ).astype(np.int32)
+        else:
+            old_per_row = old.entity_idx_per_row
+        for i, writes in sorted(in_place.items()):
+            slots = np.concatenate(
+                [np.full(len(rr), s, np.int32) for s, _, rr in writes]
+            )
+            pos = np.concatenate(
+                [u + np.arange(len(rr), dtype=np.int32)
+                 for _, u, rr in writes]
+            )
+            rows = np.concatenate([rr for _, _, rr in writes])
+            self._grow_bin_in_place(i, slots, pos, rows, data)
+        for i, s, u in neutralize:
+            self._neutralize_slot(i, s, dummy, u)
         self.dataset = dataclasses.replace(
             old,
             keys=merged_keys,
             buckets=tuple(self.buckets),
-            entity_idx_per_row=np.concatenate([
-                old_per_row,
-                new_to_merged[new_ds.entity_idx_per_row],
-            ]),
+            entity_idx_per_row=np.concatenate([old_per_row, tail_idx]),
         )
-        lifted = [
-            dataclasses.replace(
-                b,
-                entity_index=new_to_merged[b.entity_index],
-                row_index=b.row_index + n_old,
+        if append_buckets:
+            self._append_bins(append_buckets)
+            self.dataset = dataclasses.replace(
+                self.dataset, buckets=tuple(self.buckets)
             )
-            for b in new_ds.buckets
-        ]
-        self._append_bins(lifted)
-        self.dataset = dataclasses.replace(
-            self.dataset, buckets=tuple(self.buckets)
-        )
-        # Row count and vocabulary changed: the scoring caches and the
-        # warm-start join cache are stale — drop them (rebuilt lazily).
+        # Row count and vocabulary changed: the scoring caches, the
+        # warm-start join cache, and the placement index are stale — drop
+        # them (rebuilt lazily).
         self._score_feats = None
         self._score_entity_idx = None
         self._score_cache_bytes = 0
         self._warm_join_cache.clear()
+        self._locator = None
+        if in_place_rows:
+            telemetry.counter("onboard.rows_in_place", column=col).inc(
+                in_place_rows
+            )
+        if migrated_rows:
+            telemetry.counter("onboard.rows_migrated", column=col).inc(
+                migrated_rows
+            )
+        if n_migrated:
+            telemetry.counter("onboard.entities_migrated", column=col).inc(
+                n_migrated
+            )
+        if n_new_entities:
+            telemetry.counter("onboard.entities_new", column=col).inc(
+                n_new_entities
+            )
+        skipped = n_tail - len(sel)
+        if skipped:
+            telemetry.counter("onboard.rows_absent", column=col).inc(skipped)
+        self._record_headroom(telemetry)
 
 
 # ---------------------------------------------------------------------------

@@ -223,6 +223,26 @@ class GameEstimator:
                 )
         return self._device_data_cache[key]
 
+    def device_layout(self, coord_config):
+        """The cached device-resident layout for one coordinate config
+        (``FixedEffectDeviceData`` / ``RandomEffectDeviceData``), built on
+        first use — the PUBLIC handle the online-learning loop grows
+        vocabularies/warm starts against (reaching into the private cache
+        would couple callers to its key structure)."""
+        return self._device_data(coord_config)
+
+    def entity_vocabularies(self) -> Dict[str, object]:
+        """Current entity vocabulary per id column, from the LIVE
+        random-effect device layouts (the onboarded state, which may be
+        ahead of any saved model's keys)."""
+        from photon_tpu.game.coordinate import RandomEffectDeviceData
+
+        return {
+            dd.config.entity_column: dd.dataset.keys
+            for dd in self._device_data_cache.values()
+            if isinstance(dd, RandomEffectDeviceData)
+        }
+
     def _build_coordinates(self, config: GameOptimizationConfiguration):
         coords = {
             name: build_coordinate(
@@ -347,18 +367,31 @@ class GameEstimator:
             coord.telemetry = self.telemetry
         return coords
 
-    def onboard_training_data(self, data: GameDataset) -> None:
-        """Incremental entity onboarding between fits: swap in a GROWN
-        training dataset whose appended rows belong to NEW random-effect
-        entities.
+    def onboard_training_data(self, data: GameDataset,
+                              absent_tail=None) -> None:
+        """Incremental onboarding between fits: swap in a GROWN training
+        dataset whose appended rows may reference BOTH new and existing
+        random-effect entities (ISSUE 15: the continual-training loop's
+        data-growth edge).
 
         The cached random-effect device layouts extend in place
         (:meth:`~photon_tpu.game.coordinate.RandomEffectDeviceData.onboard`
-        — appended bins, remapped indices, resident feature blocks
-        untouched); fixed-effect device data is whole-dataset and is
-        dropped for a lazy rebuild on the next fit.  Warm-start models from
-        the previous fit can be grown to the merged vocabulary on device
-        with :meth:`~photon_tpu.game.model.RandomEffectModel.with_entities`.
+        — new entities as appended bins, existing entities' rows scattered
+        into per-bin row-capacity headroom, migration past exhausted
+        capacity; resident feature blocks untouched, ZERO full layout
+        rebuilds — the contract the online service asserts via the
+        ``estimator.device_data_rebuilds{kind}`` counter).  Fixed-effect
+        device data is whole-dataset (its batch shape IS the row count) and
+        is dropped for a lazy rebuild on the next fit, counted as
+        ``kind="fixed"``; the ``kind="random"`` count stays 0 by
+        construction.  Warm-start models from the previous fit can be grown
+        to the merged vocabulary on device with
+        :meth:`~photon_tpu.game.model.RandomEffectModel.with_entities`.
+
+        ``absent_tail`` maps an id column to a bool mask over the appended
+        rows marking rows that carry no id for that column (the online
+        ingest's missing-column fill — those rows join no entity of the
+        column's coordinates).
         """
         from photon_tpu.game.coordinate import RandomEffectDeviceData
 
@@ -367,6 +400,7 @@ class GameEstimator:
                 "onboard_training_data() needs the grown dataset (rows are "
                 "append-only)"
             )
+        absent_tail = absent_tail or {}
         with self.telemetry.span(
             "estimator.onboard", rows=data.num_examples
         ):
@@ -376,16 +410,25 @@ class GameEstimator:
             # offsets vector).
             for dd in self._device_data_cache.values():
                 if isinstance(dd, RandomEffectDeviceData):
-                    dd.check_onboard(data)
+                    dd.check_onboard(
+                        data,
+                        absent_tail=absent_tail.get(dd.config.entity_column),
+                    )
             for key, dd in list(self._device_data_cache.items()):
                 if isinstance(dd, RandomEffectDeviceData):
                     before = dd.dataset.num_entities
-                    dd.onboard(data)
+                    dd.onboard(
+                        data, telemetry=self.telemetry,
+                        absent_tail=absent_tail.get(dd.config.entity_column),
+                    )
                     self.telemetry.counter("estimator.entities_onboarded").inc(
                         dd.dataset.num_entities - before
                     )
                 else:
                     del self._device_data_cache[key]
+                    self.telemetry.counter(
+                        "estimator.device_data_rebuilds", kind="fixed"
+                    ).inc()
         # Streamed host layouts have no incremental-onboard path (they are
         # cheap host structures): drop them for a lazy rebuild at the
         # grown row count.  The spill context follows — the grown dataset

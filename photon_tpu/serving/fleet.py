@@ -103,6 +103,7 @@ class ServingFleet:
         workdir: Optional[str] = None,
         child_env: Optional[Dict[str, str]] = None,
         spawn_timeout_s: float = 120.0,
+        table_capacity_factor: int = 1,
     ):
         from photon_tpu.telemetry import NULL_SESSION
 
@@ -115,8 +116,13 @@ class ServingFleet:
         self.backend = backend
         self.telemetry = telemetry or NULL_SESSION
         self._model_lock = threading.Lock()
+        # Serializes whole PUBLISH operations (rollout, fleet rollback):
+        # two concurrent publishes interleaving their per-replica swaps
+        # would leave the fleet split across models.
+        self._publish_lock = threading.Lock()
         self._model_version = 0
         self._rolling = 0
+        self._previous_model = None
         self._supervisor = None
         self._store = None
         self._workdir_owned = False
@@ -148,6 +154,7 @@ class ServingFleet:
                             max_delay_s=max_delay_s,
                             telemetry=self.telemetry,
                             child_env=env, spawn_timeout_s=spawn_timeout_s,
+                            table_capacity_factor=table_capacity_factor,
                         )
                     )
             except BaseException:
@@ -175,6 +182,7 @@ class ServingFleet:
                     max_batch=max_batch,
                     min_bucket=min_bucket,
                     telemetry=self.telemetry,
+                    table_capacity_factor=table_capacity_factor,
                 )
                 self.replicas.append(
                     ScorerReplica(
@@ -243,26 +251,86 @@ class ServingFleet:
         fleet split until the next parity probe killed it again.  (If the
         rollout aborts, a replica resurrected against the new model fails
         its next known-answer probe and is re-resurrected on the restored
-        one — the rare-path analog of the same self-healing loop.)"""
-        with self._model_lock:
-            previous_model = self.model
-            self.model = model
-            self._model_version += 1
-            self._rolling += 1
-        try:
-            self.router.rollout(model, **kwargs)
-        except BaseException:
+        one — the rare-path analog of the same self-healing loop.)
+
+        Whole publishes serialize on ``_publish_lock``: a rollout and the
+        supervisor's fleet rollback interleaving their per-replica swaps
+        would split the fleet across models."""
+        with self._publish_lock:
             with self._model_lock:
-                self.model = previous_model
-                # The version stays MONOTONIC: the rollback is itself a
-                # new published state.  Restoring the old number would
-                # let a later rollout reuse it and defeat the
-                # supervisor's stale-oracle version check.
+                previous_model = self.model
+                self.model = model
                 self._model_version += 1
-            raise
-        finally:
+                self._rolling += 1
+            try:
+                self.router.rollout(model, **kwargs)
+            except BaseException:
+                with self._model_lock:
+                    self.model = previous_model
+                    # The version stays MONOTONIC: the rollback is itself
+                    # a new published state.  Restoring the old number
+                    # would let a later rollout reuse it and defeat the
+                    # supervisor's stale-oracle version check.
+                    self._model_version += 1
+                raise
+            finally:
+                with self._model_lock:
+                    self._rolling -= 1
             with self._model_lock:
-                self._rolling -= 1
+                # Promoted fleet-wide: keep the PREDECESSOR artifact as
+                # the supervisor's fleet-rollback target (a post-swap
+                # fleet-wide known-answer parity regression rolls back to
+                # it instead of quarantining every replica — ROADMAP
+                # fleet edge (d)).
+                self._previous_model = previous_model
+
+    def rollback_to_previous(self, expected_version=None) -> bool:
+        """Fleet-wide rollback to the predecessor artifact — the
+        supervisor's answer to EVERY replica failing its known-answer
+        probe right after a swap (a fleet-wide regression is a model/
+        artifact fault, not N replica faults; N quarantines would scrap a
+        healthy fleet).
+
+        The predecessor is a model that already served and passed its own
+        canary, so it republishes WITHOUT a canary stagger: the version
+        bumps (monotonic — resurrected replicas re-sync against it), every
+        live replica swaps back in place (zero recompiles: same capacity
+        plan), and the predecessor slot clears so one regression cannot
+        ping-pong.  Returns False when there is nothing to roll back to
+        (no completed rollout yet, or a rollout is mid-flight) — the
+        caller falls back to per-replica declarations.  Serialized with
+        ``rollout`` on ``_publish_lock`` — the swaps of two publishes must
+        never interleave — and version-guarded: ``expected_version`` is the
+        model version the caller's probe evidence was collected against;
+        if another publish landed while this call waited for the lock, the
+        evidence is STALE (the probes never saw the new model) and the
+        rollback refuses instead of reverting a fresh publish."""
+        with self._publish_lock:
+            return self._rollback_locked(expected_version)
+
+    def _rollback_locked(self, expected_version) -> bool:
+        with self._model_lock:
+            if self._previous_model is None or self._rolling:
+                return False
+            if (expected_version is not None
+                    and self._model_version != expected_version):
+                return False
+            target = self._previous_model
+            self._previous_model = None
+            self.model = target
+            self._model_version += 1
+        for replica in self.replicas:
+            if not replica.alive:
+                continue
+            try:
+                replica.scorer.swap_model(target)
+            except Exception as e:  # noqa: BLE001 — a replica that cannot
+                # take the restored model must not keep serving the bad one.
+                self.router.mark_unhealthy(
+                    replica, "swap", f"rollback swap failed: {e}"
+                )
+        self.telemetry.counter("serving.rollout_rollbacks").inc()
+        return True
 
     def rollout_in_progress(self) -> bool:
         """True while a staggered rollout is mid-flight — the window in

@@ -410,6 +410,7 @@ def _child_main(argv=None) -> None:
         max_batch=int(cfg["max_batch"]),
         min_bucket=int(cfg["min_bucket"]),
         telemetry=session,
+        table_capacity_factor=int(cfg.get("table_capacity_factor", 1)),
     ).warmup()
     service = _ChildService(cfg["replica_id"], scorer, version,
                             telemetry=session)
@@ -637,11 +638,13 @@ class SubprocessReplica(ScorerReplica):
         telemetry=None,
         child_env: Optional[Dict[str, str]] = None,
         spawn_timeout_s: float = 120.0,
+        table_capacity_factor: int = 1,
     ):
         self._store = store
         self._request_spec = dict(request_spec)
         self._buckets = buckets
         self._min_bucket = min_bucket
+        self._table_capacity_factor = int(table_capacity_factor)
         self._spawn_timeout_s = float(spawn_timeout_s)
         self.child_env = dict(child_env or {})
         self._proc: Optional[subprocess.Popen] = None
@@ -672,6 +675,7 @@ class SubprocessReplica(ScorerReplica):
             "buckets": list(self._buckets) if self._buckets else None,
             "max_batch": self._cfg_max_batch,
             "min_bucket": self._min_bucket,
+            "table_capacity_factor": self._table_capacity_factor,
         }
         env = dict(os.environ)
         env.update(self.child_env)

@@ -311,6 +311,7 @@ class GameScorer:
         min_bucket: int = DEFAULT_MIN_BUCKET,
         telemetry=None,
         strict_after_warmup: bool = True,
+        table_capacity_factor: int = 1,
     ):
         from photon_tpu.telemetry import NULL_SESSION
 
@@ -327,8 +328,25 @@ class GameScorer:
 
         # -- device-resident model tables (loaded once; replaceable by
         # swap_model without recompiling — the programs take them as
-        # arguments) ----------------------------------------------------------
-        plan, tables, zero_rows, vocab = self._build_tables(model)
+        # arguments).  ``table_capacity_factor`` > 1 PRE-PROVISIONS gather-
+        # table headroom past the default next-power-of-two: an online-
+        # learning deployment expecting vocabulary growth provisions 2x/4x
+        # so refresh after refresh hot-swaps in place before hitting the
+        # capacity rebuild boundary. ------------------------------------------
+        capacities = None
+        if int(table_capacity_factor) > 1:
+            from photon_tpu.utils import pow2_at_least
+
+            capacities = {
+                name: pow2_at_least(
+                    int(table_capacity_factor) * (coord.num_entities + 1)
+                )
+                for name, coord in model.coordinates.items()
+                if isinstance(coord, RandomEffectModel)
+            }
+        plan, tables, zero_rows, vocab = self._build_tables(
+            model, capacities=capacities
+        )
         self._plan = tuple(plan)
         self._tables = tuple(tables)
         self._zero_rows = zero_rows

@@ -212,17 +212,75 @@ class ReplicaSupervisor:
 
     # -- one supervision pass -------------------------------------------------
     def check_once(self) -> None:
+        # Known-answer parity failures are COLLECTED, not declared inline:
+        # when EVERY live replica fails parity right after a swap, the
+        # fleet — not N replicas — regressed, and the fix is ONE rollout
+        # rollback to the predecessor artifact instead of N quarantines
+        # (ROADMAP fleet edge (d); ISSUE 15 satellite).  Crash/hang causes
+        # stay replica-local and declare immediately inside _health_check.
+        parity: dict = {}
         for replica in self.router.replicas:
             if replica.quarantined:
                 continue
             if replica.alive:
-                self._health_check(replica)
-            if replica.alive:
-                self._pull_stats(replica)
+                verdict = self._health_check(replica)
+                if verdict is not None:
+                    parity[replica] = verdict
+                elif replica.alive:
+                    self._pull_stats(replica)
+            # Crash/hang declarations keep the PR 13 per-replica
+            # interleaving: teardown + resurrection happen here, before
+            # the next replica's probes — a replica that just absorbed a
+            # dead sibling's rerouted work gets the resurrection window to
+            # complete a batch before its own heartbeat is judged.
             if not replica.alive and not replica.quarantined:
                 self._note_death(replica)
                 if self.policy.resurrect and not replica.quarantined:
                     self._maybe_resurrect(replica)
+        live = {
+            r for r in self.router.replicas
+            if r.alive and not r.quarantined
+        }
+        if parity and set(parity) == live:
+            _model, version = self.fleet.current_model()
+            if self._fleet_rollback(version):
+                return
+            if self.fleet.current_model()[1] != version:
+                # A publish landed while the rollback waited for the
+                # fleet's publish lock: every parity verdict was collected
+                # against a model nobody serves any more — drop them and
+                # re-probe next pass instead of declaring on stale
+                # evidence.
+                return
+        for replica, (cause, detail) in parity.items():
+            self._declare(replica, cause, detail)
+            if not replica.alive and not replica.quarantined:
+                if self.policy.resurrect and not replica.quarantined:
+                    self._maybe_resurrect(replica)
+
+    def _fleet_rollback(self, expected_version) -> bool:
+        """Every live replica failed its known-answer probe: republish the
+        predecessor artifact fleet-wide (``ServingFleet.
+        rollback_to_previous``).  Returns False when there is no
+        predecessor (nothing ever rolled out) or the model version moved
+        past ``expected_version`` (the probe evidence is stale) — the
+        caller then declares per-replica or drops the stale verdicts."""
+        rollback = getattr(self.fleet, "rollback_to_previous", None)
+        if rollback is None or not rollback(expected_version):
+            return False
+        # The model changed: drop the cached probe oracle so the next pass
+        # probes against the restored artifact.
+        self._probe_cache = (None, None, None)
+        for replica in self.router.replicas:
+            if replica.alive and not replica.quarantined:
+                self._mark(replica.replica_id, "fleet-rollback")
+        if self.logger is not None:
+            self.logger.warning(
+                "supervisor: every replica failed its known-answer probe "
+                "after a swap — rolled the fleet back to the predecessor "
+                "artifact (one rollback, zero quarantines)"
+            )
+        return True
 
     def _pull_stats(self, replica) -> None:
         """Child-telemetry aggregation (ISSUE 14 satellite / ROADMAP fleet
@@ -240,7 +298,7 @@ class ReplicaSupervisor:
             pass
 
     # -- detection ------------------------------------------------------------
-    def _health_check(self, replica) -> None:
+    def _health_check(self, replica):
         # 1. Crash: the backing process hard-exited (subprocess replicas).
         code = replica.poll_exit()
         if code is not None:
@@ -308,12 +366,15 @@ class ReplicaSupervisor:
                 # mismatch here is the rollout's job to resolve, not a
                 # replica fault — declaring would kill healthy replicas
                 # on every rollout.
-                return
-            self._declare(
-                replica, "parity",
+                return None
+            # DEFERRED verdict: check_once declares it per-replica unless
+            # the whole fleet failed parity (→ one rollout rollback).
+            return (
+                "parity",
                 f"known-answer probe off by {worst:.2e} "
                 f"(> {self.policy.parity_tol:g})",
             )
+        return None
 
     def _declare(self, replica, cause: str, detail: str) -> None:
         if self.logger is not None:

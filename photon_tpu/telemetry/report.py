@@ -432,6 +432,90 @@ def _render_fleet_section(report: dict) -> list:
     return lines
 
 
+def _render_online_section(report: dict) -> list:
+    """The online-learning loop at a glance (``online.*`` + ``onboard.*``):
+    rows/batches ingested, coordinates refreshed vs locked per refresh,
+    the in-place device-data growth split (rows into headroom vs migrated
+    vs new entities — the zero-full-rebuild contract made visible),
+    append->serving refresh latency, and the staleness gauge.  Empty when
+    the run performed no online refresh."""
+    metrics = report.get("metrics") or {}
+    counters = metrics.get("counters") or []
+    gauges = metrics.get("gauges") or []
+
+    def total(name):
+        return sum(m["value"] for m in counters if m["name"] == name)
+
+    def gauge(name):
+        for m in gauges:
+            if m["name"] == name and not m.get("labels"):
+                return m["value"]
+        return None
+
+    refreshes = total("online.refreshes")
+    ingested = total("online.rows_ingested")
+    if not refreshes and not ingested:
+        return []
+    lines = ["", "## Online learning", "", "| metric | value |", "|---|---|"]
+    rows = [
+        ("online.refreshes", refreshes),
+        ("online.batches_ingested", total("online.batches_ingested")),
+        ("online.rows_ingested", ingested),
+        ("online.coordinates_refreshed", total("online.coordinates_refreshed")),
+        ("online.coordinates_locked", total("online.coordinates_locked")),
+        ("online.publishes", total("online.publishes")),
+    ]
+    failures = total("online.refresh_failures")
+    if failures:
+        rows.append(("online.refresh_failures", failures))
+    rollbacks = total("serving.rollout_rollbacks")
+    if rollbacks:
+        rows.append(("serving.rollout_rollbacks", rollbacks))
+    for name in ("onboard.rows_in_place", "onboard.rows_migrated",
+                 "onboard.entities_migrated", "onboard.entities_new",
+                 "onboard.rows_absent"):
+        v = total(name)
+        if v:
+            rows.append((name, v))
+    stale = gauge("online.staleness_s")
+    if stale is not None:
+        rows.append(("online.staleness_s", stale))
+    lines += [f"| {name} | {_fmt(value)} |" for name, value in rows]
+    hists = [
+        h for h in metrics.get("histograms") or []
+        if h["name"] == "online.refresh_latency_s"
+    ]
+    if hists:
+        lines += ["", "| distribution | count | mean | p50 | p99 | max |",
+                  "|---|---|---|---|---|---|"]
+        for h in hists:
+            lines.append(
+                f"| {h['name']} | {h['count']} | {_fmt(h['mean'])} "
+                f"| {_fmt(h['p50'])} | {_fmt(h['p99'])} | {_fmt(h['max'])} |"
+            )
+    # Per-bin capacity headroom (the in-place growth budget): grouped like
+    # the entity-solves section.
+    by_bin: dict = {}
+    for m in gauges:
+        if not m["name"].startswith("onboard.bin_"):
+            continue
+        labels = m.get("labels", {})
+        key = (labels.get("column", "?"), labels.get("bin", "?"))
+        by_bin.setdefault(key, {})[m["name"]] = m["value"]
+    if by_bin:
+        lines += ["", "| column | bin | row cells | live rows | headroom |",
+                  "|---|---|---|---|---|"]
+        for (column, b) in sorted(by_bin):
+            e = by_bin[(column, b)]
+            lines.append(
+                f"| {column} | {b} "
+                f"| {_fmt(e.get('onboard.bin_row_capacity'))} "
+                f"| {_fmt(e.get('onboard.bin_rows_live'))} "
+                f"| {_fmt(e.get('onboard.bin_row_headroom'))} |"
+            )
+    return lines
+
+
 def render_markdown(report: dict) -> str:
     """Human-readable view of a run report dict."""
     lines = [
@@ -472,6 +556,7 @@ def render_markdown(report: dict) -> str:
     lines += _render_entity_solves_section(report)
     lines += _render_serving_section(report)
     lines += _render_fleet_section(report)
+    lines += _render_online_section(report)
 
     metrics = report.get("metrics") or {}
     counters = metrics.get("counters") or []

@@ -446,7 +446,7 @@ def test_onboarding_matches_full_rebuild():
     )
 
 
-def test_onboarding_rejects_existing_entity_rows_and_shrunk_data():
+def test_onboarding_rejects_shrunk_data_and_grows_existing_rows():
     base, _ = _grown_datasets()
     config = _config()
     dd = RandomEffectDeviceData(base, config)
@@ -454,7 +454,9 @@ def test_onboarding_rejects_existing_entity_rows_and_shrunk_data():
 
     with pytest.raises(ValueError, match="append-only|GROWN"):
         dd.onboard(take_rows(base, np.arange(base.num_examples - 5)))
-    # Appending rows that reference an EXISTING entity must be rejected.
+    # Appending rows that reference an EXISTING entity GROWS the layout in
+    # place (ISSUE 15 blocker fix — tests/test_online_growth.py pins the
+    # fit parity; here: the vocabulary is unchanged and the rows landed).
     dup = GameDataset.create(
         label=np.concatenate([base.label, base.label[:3]]),
         shards={
@@ -468,16 +470,20 @@ def test_onboarding_rejects_existing_entity_rows_and_shrunk_data():
             ]),
         },
     )
-    with pytest.raises(ValueError, match="EXISTING entities"):
-        dd.onboard(dup)
+    dd.onboard(dup)
+    assert dd.dataset.num_entities == 30
+    assert len(dd.dataset.entity_idx_per_row) == dup.num_examples
+    live_rows = sum(st["live_rows"] for st in dd.bin_stats)
+    assert live_rows == dup.num_examples
 
 
 def test_estimator_onboarding_is_atomic_across_coordinates():
-    """A per-user + per-item estimator onboarding rows that are NEW users
-    but EXISTING items must reject up front and leave EVERY cached layout
-    untouched — not grow the per-user layout and then throw on the
-    per-item one (a half-onboarded cache would mix grown row indices with
-    old-length offset vectors)."""
+    """A per-user + per-item estimator onboarding a batch that one
+    coordinate must reject (its feature shard has the wrong dim in the
+    grown data) rejects up front and leaves EVERY cached layout untouched
+    — not grow the per-user layout and then throw on the per-item one (a
+    half-onboarded cache would mix grown row indices with old-length
+    offset vectors)."""
     from photon_tpu.game.estimator import (
         GameEstimator,
         GameOptimizationConfiguration,
@@ -502,11 +508,16 @@ def test_estimator_onboarding_is_atomic_across_coordinates():
     grown = GameDataset.create(
         label=np.concatenate([base.label, base.label[:n_new]]),
         shards={
-            name: DenseShard(np.concatenate([s.x, s.x[:n_new]]))
-            for name, s in base.shards.items()
+            # per-user's shard grows correctly; per-item's shard comes
+            # back at the WRONG dim — its layout must reject.
+            "re0": DenseShard(np.concatenate([
+                base.shards["re0"].x, base.shards["re0"].x[:n_new]
+            ])),
+            "re1": DenseShard(np.concatenate([
+                base.shards["re1"].x, base.shards["re1"].x[:n_new]
+            ], axis=0)[:, :3]),
         },
         id_columns={
-            # NEW users on re0, but re1 re-references EXISTING items.
             "re0": np.concatenate(
                 [base.id_columns["re0"],
                  np.arange(10_000, 10_000 + n_new, dtype=np.int64)]
@@ -529,7 +540,7 @@ def test_estimator_onboarding_is_atomic_across_coordinates():
     )
     estimator = GameEstimator("logistic_regression", base)
     estimator.fit([config])
-    with pytest.raises(ValueError, match="EXISTING entities"):
+    with pytest.raises(ValueError, match="dim"):
         estimator.onboard_training_data(grown)
     # NOTHING mutated: every cached layout still holds the base vocabulary
     # and the base row count, and another fit on the base data still runs.

@@ -112,3 +112,15 @@ def test_checker_ignores_jnp_and_comments(tmp_path):
         "y = jnp.asarray([1.0])\n"
     )
     assert check_file(f) == []
+
+
+def test_online_loop_is_guarded():
+    """The online-learning loop rides the default guard set (ISSUE 15
+    satellite): ingest/delta/service are pure host control — a device
+    fetch added to any of them must fail CI."""
+    from check_host_sync import DEFAULT_FILES
+
+    guarded = set(DEFAULT_FILES)
+    assert "photon_tpu/online/feed.py" in guarded
+    assert "photon_tpu/online/delta.py" in guarded
+    assert "photon_tpu/online/service.py" in guarded

@@ -531,3 +531,93 @@ def test_explicit_newton_cg_problem_solves_fixed_effect():
     )
     assert bool(result.converged)
     assert int(result.cg_iterations) > 0
+
+
+# -- TRON through the precomputed-curvature operator (ISSUE 15 satellite) ----
+
+def test_tron_hvp_operator_route_matches_per_call_hvp():
+    """`tron(hvp_at=...)` (the hvp_operator closure — margins/D(w) once
+    per outer iteration) matches the legacy per-call `hvp` route and the
+    derived jvp-of-grad default ≤1e-6, directly and through the cached
+    GAME solver path."""
+    import jax.numpy as jnp
+
+    from photon_tpu.core.optimizers.tron import tron
+    from photon_tpu.core.problem import (
+        GlmOptimizationProblem,
+        hvp_at_for,
+    )
+    from photon_tpu.data.synthetic import make_glm_data
+
+    batch, _ = make_glm_data(300, 10, task="logistic_regression", seed=9)
+    objective = GlmObjective.create(
+        "logistic_regression", RegularizationContext("l2", 0.5)
+    )
+    fun = lambda w: objective.value_and_grad(w, batch)  # noqa: E731
+    w0 = jnp.zeros(10)
+    cfg = OptimizerConfig(max_iterations=30)
+    legacy = tron(
+        fun, w0, cfg,
+        hvp=lambda w, v: objective.hessian_vector(w, v, batch),
+    )
+    operator = tron(fun, w0, cfg, hvp_at=hvp_at_for(objective, batch))
+    derived = tron(fun, w0, cfg)
+    assert float(jnp.abs(legacy.w - operator.w).max()) <= 1e-6
+    assert float(jnp.abs(legacy.w - derived.w).max()) <= 1e-6
+    # The problem route (what GAME coordinates run) wires hvp_at now.
+    problem = GlmOptimizationProblem(
+        objective,
+        ProblemConfig(optimizer="tron", optimizer_config=cfg),
+    )
+    coefficients, _result = problem.run(batch, None, dim=10)
+    assert float(jnp.abs(coefficients.means - operator.w).max()) <= 1e-6
+
+
+def test_tron_vmapped_entity_route_unchanged():
+    """The vmapped per-entity TRON route (GAME random effects): the
+    operator wiring (`hvp_at`, what `_run_fit` now passes) produces the
+    same per-lane solutions as the legacy per-call `hvp` wiring under the
+    same vmap — the rewire changes where the curvature is built, not what
+    any entity converges to."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.core.optimizers.tron import tron
+    from photon_tpu.core.problem import cached_solver, hvp_at_for
+    from photon_tpu.data.batch import DenseBatch
+    from photon_tpu.data.synthetic import make_glm_data
+
+    objective = GlmObjective.create(
+        "logistic_regression", RegularizationContext("l2", 1.0)
+    )
+    cfg = OptimizerConfig(max_iterations=25)
+    batches = []
+    for seed in range(4):
+        b, _ = make_glm_data(16, 6, task="logistic_regression", seed=seed)
+        batches.append(b)
+    stacked = DenseBatch(
+        jnp.stack([b.x for b in batches]),
+        jnp.stack([b.label for b in batches]),
+        jnp.stack([b.offset for b in batches]),
+        jnp.stack([b.weight for b in batches]),
+    )
+    w0 = jnp.zeros((4, 6))
+
+    def legacy_lane(batch, w):
+        fun = lambda u: objective.value_and_grad(u, batch)  # noqa: E731
+        return tron(
+            fun, w, cfg,
+            hvp=lambda ww, v: objective.hessian_vector(ww, v, batch),
+        ).w
+
+    def operator_lane(batch, w):
+        fun = lambda u: objective.value_and_grad(u, batch)  # noqa: E731
+        return tron(fun, w, cfg, hvp_at=hvp_at_for(objective, batch)).w
+
+    legacy = jax.jit(jax.vmap(legacy_lane))(stacked, w0)
+    operator = jax.jit(jax.vmap(operator_lane))(stacked, w0)
+    assert float(jnp.abs(legacy - operator).max()) <= 1e-6
+    # And the cached GAME solver route (the production wiring) matches.
+    solver = cached_solver("tron", cfg, "none", vmapped=True)
+    coeff, _ = solver(objective, stacked, w0)
+    assert float(jnp.abs(coeff.means - operator).max()) <= 1e-6

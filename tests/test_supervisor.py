@@ -750,3 +750,90 @@ def test_report_renders_supervisor_timeline():
     assert "**supervisor timeline**" in md
     assert "r0:died-crash" in md and "r0:rejoined" in md
     assert "r0:quarantined" in md
+
+
+# -- fleet-wide rollback (ISSUE 15 satellite / ROADMAP fleet edge (d)) --------
+
+def test_fleet_wide_parity_regression_rolls_back_not_quarantines():
+    """EVERY replica failing its known-answer probe right after a swap is
+    a fleet-wide regression: the supervisor triggers ONE rollout rollback
+    to the predecessor artifact — zero deaths, zero quarantines, every
+    replica stays in the dispatch set serving the restored model."""
+    model, data = _fixture(seed=61)
+    model2 = _retrained(model, seed=62)
+    skewed = _retrained(model, seed=63)  # what the replicas "really" serve
+    session = TelemetrySession("t-fleet-rollback")
+    fleet = _fleet(model, data, session)
+    sup = _supervisor(fleet)
+    probes = build_requests(data, model2, [4, 4])
+    fleet.rollout(model2, probe_requests=probes)
+    assert fleet.current_model()[0] is model2
+    # Simulate post-swap fleet-wide artifact skew: every replica silently
+    # serves a model that disagrees with the published one's oracle.
+    for replica in fleet.replicas:
+        replica.scorer.swap_model(skewed)
+    sup.check_once()
+    assert _counter_total(session, "serving.rollout_rollbacks") == 1
+    assert _counter_total(session, "serving.replica_deaths") == 0
+    assert _counter_total(session, "serving.replica_quarantined") == 0
+    assert all(r.alive for r in fleet.replicas)
+    # Rolled back to the PREDECESSOR (version monotonic), serving parity
+    # restored end to end.
+    current, version = fleet.current_model()
+    assert current is model
+    assert version == 2
+    req = build_requests(data, model, [6])[0]
+    got = fleet.score(req)
+    np.testing.assert_allclose(
+        got, host_score_request(model, req), atol=1e-5
+    )
+    # The next pass is clean (no lingering suspicion), and the timeline
+    # carries the fleet-rollback marks.
+    sup.check_once()
+    assert _counter_total(session, "serving.rollout_rollbacks") == 1
+    phases = [phase for _rid, phase in _timeline(session)]
+    assert phases.count("fleet-rollback") == len(fleet.replicas)
+    fleet.close()
+
+
+def test_partial_parity_failure_still_declares_per_replica():
+    """One replica wrong, the rest fine: NOT a fleet regression — the
+    existing per-replica parity declaration (death + resurrection path)
+    applies, and no rollback fires."""
+    model, data = _fixture(seed=67)
+    model2 = _retrained(model, seed=68)
+    skewed = _retrained(model, seed=69)
+    session = TelemetrySession("t-partial-parity")
+    fleet = _fleet(model, data, session)
+    sup = _supervisor(fleet, resurrect=False)
+    fleet.rollout(model2, probe_requests=build_requests(data, model2, [4]))
+    fleet.replicas[0].scorer.swap_model(skewed)
+    sup.check_once()
+    assert _counter_total(session, "serving.rollout_rollbacks") == 0
+    assert _counter_total(
+        session, "serving.replica_deaths", cause="parity"
+    ) == 1
+    assert not fleet.replicas[0].alive
+    assert fleet.replicas[1].alive
+    assert fleet.current_model()[0] is model2
+    fleet.close()
+
+
+def test_rollback_without_predecessor_falls_back_to_declarations():
+    """A fleet that never completed a rollout has no predecessor: the
+    all-replica parity failure declares per-replica exactly as before
+    (rollback_to_previous returns False)."""
+    model, data = _fixture(seed=71)
+    skewed = _retrained(model, seed=72)
+    session = TelemetrySession("t-no-predecessor")
+    fleet = _fleet(model, data, session)
+    sup = _supervisor(fleet, resurrect=False)
+    assert fleet.rollback_to_previous() is False
+    for replica in fleet.replicas:
+        replica.scorer.swap_model(skewed)
+    sup.check_once()
+    assert _counter_total(session, "serving.rollout_rollbacks") == 0
+    assert _counter_total(
+        session, "serving.replica_deaths", cause="parity"
+    ) == len(fleet.replicas)
+    fleet.close()

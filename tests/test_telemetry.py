@@ -444,6 +444,50 @@ def test_render_markdown_serving_section(tmp_path):
     assert "## Online serving" not in text2
 
 
+def test_render_markdown_online_section(tmp_path):
+    """The online.* row block (ISSUE 15 satellite): ingest/refresh/lock
+    counters, the in-place growth split, the refresh-latency distribution,
+    and the per-bin capacity-headroom table; absent metrics -> absent
+    section."""
+    session = TelemetrySession("online-test")
+    session.counter("online.refreshes").inc(3)
+    session.counter("online.batches_ingested").inc(4)
+    session.counter("online.rows_ingested").inc(500)
+    session.counter("online.coordinates_refreshed").inc(7)
+    session.counter("online.coordinates_locked").inc(2)
+    session.counter("online.publishes").inc(3)
+    session.counter("onboard.rows_in_place", column="userId").inc(420)
+    session.counter("onboard.rows_migrated", column="userId").inc(60)
+    session.counter("onboard.entities_migrated", column="userId").inc(2)
+    session.counter("onboard.entities_new", column="userId").inc(9)
+    session.gauge("online.staleness_s").set(0.0)
+    session.gauge("onboard.bin_row_capacity", column="userId", bin=0).set(64)
+    session.gauge("onboard.bin_rows_live", column="userId", bin=0).set(50)
+    session.gauge("onboard.bin_row_headroom", column="userId", bin=0).set(14)
+    session.histogram("online.refresh_latency_s").observe(1.5)
+    session.finalize(str(tmp_path))
+    text = render_markdown(
+        json.load(open(tmp_path / "telemetry" / "run_report.json"))
+    )
+    assert "## Online learning" in text
+    assert "| online.refreshes | 3 |" in text
+    assert "| online.rows_ingested | 500 |" in text
+    assert "| online.coordinates_refreshed | 7 |" in text
+    assert "| online.coordinates_locked | 2 |" in text
+    assert "| onboard.rows_in_place | 420 |" in text
+    assert "| onboard.entities_migrated | 2 |" in text
+    assert "online.refresh_latency_s" in text
+    assert "| userId | 0 | 64 | 50 | 14 |" in text
+
+    plain = TelemetrySession("no-online")
+    plain.counter("rows").inc()
+    plain.finalize(str(tmp_path / "plain"))
+    text2 = render_markdown(
+        json.load(open(tmp_path / "plain" / "telemetry" / "run_report.json"))
+    )
+    assert "## Online learning" not in text2
+
+
 # ------------------------------------------------------ driver integration
 
 

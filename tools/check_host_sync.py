@@ -86,6 +86,14 @@ DEFAULT_FILES = (
     # host once per published version).
     "photon_tpu/serving/supervisor.py",
     "photon_tpu/serving/replica_proc.py",
+    # The online-learning loop (ISSUE 15): ingest, delta, and the refresh
+    # orchestration are pure host control — the sanctioned device edges
+    # are inside the estimator/descent/serving layers it drives.  A d2h
+    # here would serialize the refresh against the serving path it is
+    # supposed to leave untouched.
+    "photon_tpu/online/feed.py",
+    "photon_tpu/online/delta.py",
+    "photon_tpu/online/service.py",
 )
 
 SYNC_PATTERN = re.compile(
