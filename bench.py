@@ -1710,6 +1710,13 @@ def _bench_fleet() -> None:
         probe_interval_s=0.1, probe_deadline_s=60.0,
         respawn_base_s=0.05, max_deaths=5,
     ))
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from photon_tpu.serving import ObservePolicy
+    from photon_tpu.telemetry import TraceSampler
+
+    flight_dir = _tempfile.mkdtemp(prefix="bench-fleet-flight-")
     compile_events.clear()
     jax.monitoring.register_event_listener(listener)
     try:
@@ -1726,6 +1733,109 @@ def _bench_fleet() -> None:
         if any(o.status != "ok" for o in out_pre):
             raise AssertionError("pre-kill burst failed requests")
         qps_pre = len(out_pre) / wall_pre
+
+        # -- observability leg (ISSUE 16): tracing overhead + merged trace.
+        # Attach the fleet observer at full sampling, replay the SAME
+        # closed-loop burst traced, and bar the overhead: tracing is
+        # per-request dict bookkeeping and must cost < 5% QPS.  One-core
+        # closed-loop QPS swings with OS scheduling, so a miss re-draws
+        # BOTH sides (the sampler toggled off IS the untraced path) — a
+        # real overhead regression fails every pair.
+        observer = fleet3.observe(
+            policy=ObservePolicy(sample_rate=1.0, poll_interval_s=0.1),
+            flight_dir=flight_dir,
+        )
+
+        def burst_qps(leg):
+            out, wall = run_closed_loop_outcomes(
+                chaos_factory, burst_items, clients=clients
+            )
+            if any(o.status != "ok" for o in out):
+                raise AssertionError(f"{leg} burst failed requests")
+            return len(out) / wall
+
+        qps_untraced = qps_pre
+        for t_attempt in range(3):
+            qps_traced = burst_qps("traced")
+            overhead_x = qps_traced / qps_untraced
+            if overhead_x >= 0.95:
+                break
+            observer.sampler = TraceSampler(0.0)
+            qps_untraced = burst_qps("untraced re-draw")
+            observer.sampler = TraceSampler(1.0)
+        if overhead_x < 0.95:
+            raise AssertionError(
+                f"traced QPS is {overhead_x:.3f}x untraced "
+                f"({qps_traced:.0f} vs {qps_untraced:.0f} req/s) — "
+                "tracing overhead exceeds the 5% budget"
+            )
+
+        # One request through the full client→router→replica path over
+        # TCP: the merged trace tree must span the processes and its
+        # critical-path stage sum must reconcile with the end-to-end
+        # latency the router observed.
+        server3 = fleet3.serve()
+        obs_client = AsyncScoringClient(
+            server3.address, connections=1, telemetry=session3,
+            observer=observer,
+        )
+        try:
+            t_probe0 = _time.monotonic()
+            obs_client.submit(burst_items[0].request).result(timeout=60.0)
+            probe_wall = _time.monotonic() - t_probe0
+        finally:
+            obs_client.close()
+        observer.poll_once()  # drain child spans shipped inline/ctrl
+        tid = next(
+            (t for t in reversed(observer.collector.trace_ids())
+             if any(d.get("name") == "client.request"
+                    for d in observer.collector.trace(t))),
+            None,
+        )
+        if tid is None:
+            raise AssertionError(
+                "the traced probe request produced no merged trace with a "
+                "client span"
+            )
+        cp = observer.collector.critical_path(tid)
+        if cp is None:
+            raise AssertionError(
+                "no critical path for the probe trace (router span missing)"
+            )
+        n_procs = len(cp["processes"])
+        want_procs = 3 if chaos_backend == "subprocess" else 2
+        if n_procs < want_procs:
+            raise AssertionError(
+                f"probe trace spans {n_procs} process(es) "
+                f"({cp['processes']}) — expected >= {want_procs} on the "
+                f"{chaos_backend} backend"
+            )
+        if abs(cp["stage_sum_s"] - cp["total_s"]) > 1e-6 + 1e-3 * cp["total_s"]:
+            raise AssertionError(
+                f"critical-path stages sum to {cp['stage_sum_s']:.6f}s but "
+                f"the request took {cp['total_s']:.6f}s — the decomposition "
+                "does not reconcile"
+            )
+        if cp["total_s"] > probe_wall + 0.05:
+            raise AssertionError(
+                f"router-observed latency {cp['total_s']:.3f}s exceeds the "
+                f"client-measured wall {probe_wall:.3f}s"
+            )
+
+        _emit("game_fleet_traced_qps", qps_traced, "req/s", {
+            "backend": chaos_backend,
+            "sample_rate": 1.0,
+            "qps_untraced": round(qps_untraced, 2),
+            "overhead_x": round(overhead_x, 3),
+            "trace_processes": n_procs,
+            "trace_spans": cp["spans"],
+            "critical_path_ms": {
+                s["stage"]: round(s["duration_s"] * 1e3, 3)
+                for s in cp["stages"]
+            },
+            "end_to_end_ms": round(cp["total_s"] * 1e3, 3),
+            "platform": platform,
+        })
 
         rate = min(0.4 * qps2, 150.0)
         horizon_s = 12.0 if chaos_backend == "subprocess" else 8.0
@@ -1818,9 +1928,23 @@ def _bench_fleet() -> None:
                 f"post-rejoin QPS recovered only {recovered:.2f}x of "
                 f"pre-kill ({qps_post:.0f} vs {qps_pre:.0f} req/s)"
             )
+        # The kill must leave a postmortem: the supervisor hands the victim
+        # to the observer, which persists the flight ring next to the run
+        # artifacts (ISSUE 16 flight recorder).
+        if not observer.flight_dumps:
+            raise AssertionError(
+                "no flight dump collected after the chaos kill"
+            )
+        flight0 = observer.flight_dumps[0]
+        if not flight0["path"] or not os.path.exists(flight0["path"]):
+            raise AssertionError(
+                f"flight dump for {flight0['replica']} was not persisted "
+                f"({flight0['path']!r})"
+            )
     finally:
         monitoring_src._unregister_event_listener_by_callback(listener)
         fleet3.close()
+        _shutil.rmtree(flight_dir, ignore_errors=True)
     if compile_events:
         raise AssertionError(
             f"{len(compile_events)} jax compile events across the chaos "
@@ -1838,6 +1962,10 @@ def _bench_fleet() -> None:
         "chaos_requests": len(out_chaos),
         "deaths": int(deaths3),
         "resurrections": int(resurrections3),
+        "flight_dumps": len(observer.flight_dumps),
+        "lost_spans_recovered": int(sum(
+            d.get("lost_spans_recovered", 0) for d in observer.flight_dumps
+        )),
         "platform": platform,
     })
 

@@ -404,10 +404,42 @@ class OnlineLearningService:
                     "no replica exposes a request spec to probe with"
                 )
             probes = [probe_request_for(model, spec)]
-        self.fleet.rollout(
-            model, probe_requests=probes,
-            parity_tol=self.policy.rollout_parity_tol,
+        observer = getattr(self.fleet, "observer", None)
+        if observer is None:
+            self.fleet.rollout(
+                model, probe_requests=probes,
+                parity_tol=self.policy.rollout_parity_tol,
+            )
+            return
+        # Traced publish: refresh -> canary -> swap becomes ONE linked
+        # trace.  The publish span's context is activated as the ambient
+        # trace, so the router parents its serving.rollout span under it
+        # and the canary probes carry the same trace id down to the
+        # subprocess children.
+        from photon_tpu.telemetry.distributed import (
+            SpanRecord, activate_trace, current_trace, new_trace_id,
         )
+
+        ambient = current_trace()
+        span = SpanRecord(
+            trace_id=ambient.trace_id if ambient else new_trace_id(),
+            name="online.publish",
+            process=observer.process,
+            parent_id=ambient.span_id if ambient else None,
+        )
+        span.attrs["version"] = getattr(model, "version", None)
+        try:
+            with activate_trace(span.context()):
+                self.fleet.rollout(
+                    model, probe_requests=probes,
+                    parity_tol=self.policy.rollout_parity_tol,
+                )
+            span.finish()
+        except BaseException:
+            span.finish("error")
+            raise
+        finally:
+            observer.collector.add(span)
 
     # -- background loop -----------------------------------------------------
     def start(self) -> "OnlineLearningService":

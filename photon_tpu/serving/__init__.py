@@ -38,6 +38,17 @@ serving (PAPERS.md, 1803.06333) and DrJAX's keep-everything-in-jit idiom
   the host oracle), backed-off resurrection whose rejoin is gated by
   mirrored-traffic parity probes against the CURRENT model, and
   permanent quarantine for flapping replicas.
+- The OBSERVABILITY plane (ISSUE 16): ``fleet.observe()`` attaches a
+  :class:`~photon_tpu.serving.observe.FleetObserver` — request-scoped
+  distributed tracing over the existing frame protocol (trace ids ride
+  request headers, child replicas stream completed spans back over the
+  open control connection, the parent merges one cross-process trace
+  tree with a critical-path breakdown), a live metrics plane
+  (per-replica mergeable histograms aggregated to fleet QPS/p50/p99/
+  shed-rate per model version, served over a stdlib-HTTP Prometheus
+  endpoint and the ``python -m photon_tpu.telemetry.live`` console),
+  declarative SLO burn-rate alerting, and a crash flight recorder whose
+  per-replica ring the supervisor collects on death/quarantine.
 
 The batch scoring driver (``drivers/score_game``, non-streamed) routes
 through the same :class:`GameScorer` gather-table build, so the online and
@@ -51,6 +62,13 @@ from photon_tpu.serving.batcher import (  # noqa: F401
     run_closed_loop,
 )
 from photon_tpu.serving.fleet import ServingFleet  # noqa: F401
+from photon_tpu.serving.observe import (  # noqa: F401
+    DEFAULT_SLOS,
+    FleetObserver,
+    ObservePolicy,
+    Slo,
+    SloMonitor,
+)
 from photon_tpu.serving.replica_proc import (  # noqa: F401
     ModelStore,
     ReplicaSpawnError,

@@ -31,6 +31,7 @@ from photon_tpu.serving.scorer import (
     concat_requests,
     padded_cost,
 )
+from photon_tpu.telemetry.distributed import attach_trace, span_of
 
 DEFAULT_MAX_DELAY_S = 0.002
 
@@ -223,9 +224,25 @@ class RequestBatcher:
             batch = self._take_batch()
             if not batch:
                 return
+            # Traced requests: stamp the coalesce window onto each root
+            # span, and let the merged micro-batch carry the first traced
+            # request's context so a subprocess scorer links its child hop
+            # (the batch IS one device dispatch — one representative trace
+            # is the honest granularity).
+            spans = [sp for sp in (span_of(p.request) for p in batch) if sp]
+            batch_rows = sum(p.rows for p in batch)
+            for sp in spans:
+                sp.event("batch_close", coalesced=len(batch),
+                         batch_rows=batch_rows)
             try:
                 merged = concat_requests([p.request for p in batch])
+                if spans:
+                    attach_trace(merged, spans[0].context())
+                    for sp in spans:
+                        sp.event("score_begin")
                 scores = self.scorer.score_batch(merged)
+                for sp in spans:
+                    sp.event("score_end")
             except BaseException as e:  # surface through every waiter
                 self._retire(batch)
                 for p in batch:

@@ -191,10 +191,17 @@ class ServingFleet:
                         telemetry=self.telemetry,
                     )
                 )
+        if backend == "thread":
+            # Thread replicas have no child artifact version; the fleet
+            # stamps its own monotonic version on them so response spans
+            # carry the served model version on either backend.
+            for replica in self.replicas:
+                replica.served_version = 0
         self.router = FleetRouter(
             self.replicas, telemetry=self.telemetry, admission=admission
         )
         self._server = None
+        self.observer = None
         self.telemetry.gauge("serving.replicas").set(int(replicas))
 
     @classmethod
@@ -283,6 +290,15 @@ class ServingFleet:
                 # it instead of quarantining every replica — ROADMAP
                 # fleet edge (d)).
                 self._previous_model = previous_model
+                self._stamp_served_version()
+
+    def _stamp_served_version(self) -> None:
+        """Thread replicas: mirror the fleet's monotonic model version onto
+        each live replica (subprocess replicas carry their child artifact
+        version instead).  Caller holds ``_model_lock``."""
+        for replica in self.replicas:
+            if hasattr(replica, "served_version") and replica.alive:
+                replica.served_version = self._model_version
 
     def rollback_to_previous(self, expected_version=None) -> bool:
         """Fleet-wide rollback to the predecessor artifact — the
@@ -319,6 +335,7 @@ class ServingFleet:
             self._previous_model = None
             self.model = target
             self._model_version += 1
+            self._stamp_served_version()
         for replica in self.replicas:
             if not replica.alive:
                 continue
@@ -357,6 +374,36 @@ class ServingFleet:
             self._supervisor.start()
         return self._supervisor
 
+    # -- observability -------------------------------------------------------
+    def observe(self, policy=None, slos=None, flight_dir=None,
+                start: bool = True):
+        """Attach the fleet observability plane (cross-process tracing,
+        live metrics, SLO burn rates, flight-recorder collection); returns
+        the :class:`~photon_tpu.serving.observe.FleetObserver`.  Wires the
+        router's request hook and each subprocess replica's span sink;
+        the supervisor and online refresh pick the observer up via
+        ``fleet.observer``.  ``flight_dir`` is where collected crash dumps
+        persist (pass the run's output dir to land them next to the run
+        report).  ``start=False`` builds it unthreaded — tests drive
+        ``poll_once()`` deterministically."""
+        from photon_tpu.serving.observe import FleetObserver
+
+        if self.observer is not None:
+            raise RuntimeError("fleet already observed")
+        kwargs = {} if slos is None else {"slos": slos}
+        observer = FleetObserver(
+            fleet=self, telemetry=self.telemetry, policy=policy,
+            flight_dir=flight_dir, **kwargs,
+        )
+        self.router.observer = observer
+        for replica in self.replicas:
+            if hasattr(replica, "span_sink"):
+                replica.span_sink = observer.collector.merge_remote
+        self.observer = observer
+        if start:
+            observer.start()
+        return observer
+
     # -- transport -----------------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0):
         """Attach the socket ingest; returns the
@@ -378,6 +425,11 @@ class ServingFleet:
         if self._supervisor is not None:
             self._supervisor.stop()
             self._supervisor = None
+        # Observer closes while the children are still alive so its final
+        # poll can drain pending span streams over the open control
+        # connections; after router.close() those sockets are gone.
+        if self.observer is not None:
+            self.observer.close()
         if self._server is not None:
             self._server.close()
             self._server = None

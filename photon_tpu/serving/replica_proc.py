@@ -77,11 +77,17 @@ from photon_tpu.serving.transport import (
     payload_kind,
     read_frame,
     unpack_control,
-    unpack_request,
-    unpack_response,
+    unpack_request_ex,
+    unpack_response_ex,
     write_frame,
     _pack,
     _unpack,
+)
+from photon_tpu.telemetry.distributed import (
+    FlightRecorder,
+    MergeableHistogram,
+    SpanRecord,
+    trace_of,
 )
 
 ARTIFACT_VERSION = 1
@@ -276,7 +282,9 @@ class _ChildService:
     edge (e))."""
 
     def __init__(self, replica_id: str, scorer, version: int,
-                 telemetry=None):
+                 telemetry=None, flight_path: Optional[str] = None):
+        from collections import deque
+
         from photon_tpu.telemetry import NULL_SESSION
 
         self.replica_id = replica_id
@@ -284,6 +292,73 @@ class _ChildService:
         self.version = version
         self.telemetry = telemetry or NULL_SESSION
         self.lock = threading.Lock()
+        # Observability: the crash flight recorder (flushed to
+        # ``flight_path`` at traced-frame ingress, BEFORE scoring — so a
+        # SIGKILL mid-batch still leaves the victim's last accepted work
+        # on disk), the mergeable compute-latency histogram the parent
+        # aggregates fleet-wide, and the overflow queue for spans whose
+        # response frame could not carry them (error paths).
+        self.process = f"replica-{replica_id}:{os.getpid()}"
+        self.flight_path = flight_path
+        self.flight = FlightRecorder(self.process)
+        self.latency_hist = MergeableHistogram()
+        self._pending_spans: deque = deque(maxlen=256)
+        self._spans_lock = threading.Lock()
+
+    def _flush_flight(self) -> None:
+        if not self.flight_path:
+            return
+        try:
+            self.flight.dump(self.flight_path)
+        except OSError:
+            pass  # a full disk must not fail the scoring path
+
+    def _drain_spans(self) -> list:
+        with self._spans_lock:
+            out = list(self._pending_spans)
+            self._pending_spans.clear()
+        return out
+
+    def _score_frame(self, payload: bytes) -> bytes:
+        """One scoring exchange, with the traced-request hop recorded: a
+        request carrying a wire trace context gets a child span (ingress →
+        compute → egress) shipped back inline on the response header."""
+        self.flight.note_frame("in", "score", len(payload))
+        self.maybe_fault()
+        request, _, _ = unpack_request_ex(payload)
+        ctx = trace_of(request)
+        span = None
+        if ctx is not None:
+            span = SpanRecord(ctx.trace_id, "replica.score", self.process,
+                              parent_id=ctx.span_id)
+            span.event("ingress", rows=request.num_rows,
+                       nbytes=len(payload))
+            self.flight.note_span(span, "open")
+            self._flush_flight()
+        t0 = time.monotonic()
+        try:
+            if span is not None:
+                span.event("compute_begin")
+            scores = self.scorer.score_batch(request)
+            if span is not None:
+                span.event("compute_end")
+        except BaseException:
+            if span is not None:
+                span.finish(status="error")
+                self.flight.note_span(span, "close")
+                with self._spans_lock:
+                    self._pending_spans.append(span.to_dict())
+            raise
+        self.latency_hist.observe(time.monotonic() - t0)
+        meta = {"version": self.version}
+        if span is not None:
+            span.event("egress")
+            span.attrs["rows"] = request.num_rows
+            span.attrs["version"] = self.version
+            span.finish()
+            self.flight.note_span(span, "close")
+            meta["spans"] = [span.to_dict()] + self._drain_spans()
+        return pack_scores(scores, meta=meta)
 
     def serving_counters(self) -> list:
         """This child's scorer-level ``serving.*`` counters as JSON-ready
@@ -321,9 +396,7 @@ class _ChildService:
             kind = payload_kind(payload)
             try:
                 if kind == "score":
-                    self.maybe_fault()
-                    request, _ = unpack_request(payload)
-                    out = pack_scores(self.scorer.score_batch(request))
+                    out = self._score_frame(payload)
                 elif kind == "ping":
                     self.maybe_fault()
                     out = pack_control(
@@ -338,7 +411,12 @@ class _ChildService:
                     out = pack_control(
                         "stats", version=self.version,
                         counters=self.serving_counters(),
+                        hist=self.latency_hist.snapshot(),
                     )
+                elif kind == "spans":
+                    # Drain completed-but-unshipped spans (error paths) —
+                    # advisory like stats, so NOT behind maybe_fault.
+                    out = pack_control("spans", spans=self._drain_spans())
                 elif kind == "swap":
                     header = unpack_control(payload)
                     model, version = load_model_artifact(header["path"])
@@ -413,7 +491,8 @@ def _child_main(argv=None) -> None:
         table_capacity_factor=int(cfg.get("table_capacity_factor", 1)),
     ).warmup()
     service = _ChildService(cfg["replica_id"], scorer, version,
-                            telemetry=session)
+                            telemetry=session,
+                            flight_path=cfg.get("flight_path"))
 
     class _Handler(socketserver.BaseRequestHandler):
         def handle(self):  # noqa: D102 — per-connection loop
@@ -485,12 +564,17 @@ class _RemoteScorer:
                  store: ModelStore, request_spec: Dict[str, ShardSpec],
                  buckets, max_batch: int, min_bucket: int,
                  port: int, compilations: int, telemetry=None,
-                 timeout_s: float = 300.0):
+                 timeout_s: float = 300.0, span_sink=None):
         from photon_tpu.telemetry import NULL_SESSION
 
         self.replica_id = replica_id
         self.model = model
         self.version = version
+        # Observability: completed child spans piggybacked on response
+        # headers (or pulled via the ``spans`` control frame) go here; the
+        # last shipped histogram snapshot is what the observer aggregates.
+        self.span_sink = span_sink
+        self.last_hist_snapshot: Optional[dict] = None
         self.request_spec = request_spec
         self.buckets = bucket_ladder(buckets, max_batch, min_bucket)
         self.max_bucket = self.buckets[-1]
@@ -537,11 +621,18 @@ class _RemoteScorer:
         try:
             with self._data_lock:
                 write_frame(self._data, payload)
-                return unpack_response(read_frame(self._data))
+                scores, header = unpack_response_ex(read_frame(self._data))
         except OSError as e:
             raise ReplicaDeadError(
                 f"replica {self.replica_id} child connection lost: {e}"
             ) from e
+        spans = header.get("spans")
+        if spans and self.span_sink is not None:
+            try:
+                self.span_sink(spans)
+            except Exception:  # noqa: BLE001 — span delivery is advisory
+                pass
+        return scores
 
     def swap_model(self, model) -> None:
         """Hot-swap the CHILD to a newer model: publish the shared
@@ -593,7 +684,24 @@ class _RemoteScorer:
         header = call_with_timeout(
             exchange, deadline_s, site=f"replica:{self.replica_id}:stats"
         )
+        self.last_hist_snapshot = header.get("hist") or self.last_hist_snapshot
         return header.get("counters", [])
+
+    def pull_spans(self, deadline_s: float = 5.0) -> list:
+        """Drain the child's completed-but-unshipped spans (error paths)
+        over the control connection — deadline-bounded like every other
+        control exchange."""
+        from photon_tpu.fault.watchdog import call_with_timeout
+
+        def exchange():
+            with self._ctrl_lock:
+                write_frame(self._ctrl, pack_control("spans"))
+                return unpack_control(read_frame(self._ctrl))
+
+        header = call_with_timeout(
+            exchange, deadline_s, site=f"replica:{self.replica_id}:spans"
+        )
+        return header.get("spans", [])
 
     def shutdown(self, deadline_s: float = 5.0) -> None:
         from photon_tpu.fault.watchdog import call_with_timeout
@@ -650,6 +758,15 @@ class SubprocessReplica(ScorerReplica):
         self._proc: Optional[subprocess.Popen] = None
         self._replica_id = replica_id
         self._cfg_max_batch = int(max_batch)
+        # Observability: where the child flushes its flight-recorder ring
+        # (the supervisor's postmortem collector reads it after a kill),
+        # and the observer-installed sink completed child spans forward to.
+        # The sink lives on the REPLICA (not the per-child scorer) so it
+        # survives respawn; _spawn hands each child scorer the bound
+        # forwarder.
+        self.flight_path = os.path.join(store.workdir,
+                                        f"{replica_id}.flight.json")
+        self.span_sink = None
         scorer = self._spawn(model, telemetry=telemetry)
         super().__init__(replica_id, scorer, max_batch=max_batch,
                          max_delay_s=max_delay_s, telemetry=telemetry)
@@ -676,6 +793,7 @@ class SubprocessReplica(ScorerReplica):
             "max_batch": self._cfg_max_batch,
             "min_bucket": self._min_bucket,
             "table_capacity_factor": self._table_capacity_factor,
+            "flight_path": self.flight_path,
         }
         env = dict(os.environ)
         env.update(self.child_env)
@@ -724,8 +842,13 @@ class SubprocessReplica(ScorerReplica):
             self._request_spec, self._buckets, self._cfg_max_batch,
             self._min_bucket, port=int(ready["port"]),
             compilations=int(ready.get("compilations", 0)),
-            telemetry=telemetry,
+            telemetry=telemetry, span_sink=self._deliver_spans,
         )
+
+    def _deliver_spans(self, spans: list) -> None:
+        sink = self.span_sink
+        if sink is not None:
+            sink(spans)
 
     def poll_exit(self) -> Optional[int]:
         return None if self._proc is None else self._proc.poll()
@@ -761,6 +884,9 @@ class SubprocessReplica(ScorerReplica):
 
     def ping(self, deadline_s: float) -> dict:
         return self.scorer.ping(deadline_s)
+
+    def pull_spans(self, deadline_s: float = 5.0) -> list:
+        return self.scorer.pull_spans(deadline_s)
 
     def pull_stats(self, deadline_s: float = 5.0) -> dict:
         """Pull the child's scorer-level ``serving.*`` counters and merge

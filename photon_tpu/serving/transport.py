@@ -44,6 +44,11 @@ import numpy as np
 from photon_tpu.fault.injection import fault_point
 from photon_tpu.serving.router import RequestShedError
 from photon_tpu.serving.scorer import ScoringRequest
+from photon_tpu.telemetry.distributed import (
+    TraceContext,
+    attach_trace,
+    trace_of,
+)
 
 MAX_FRAME_BYTES = 1 << 28  # 256 MB: far past any sane micro-batch
 
@@ -174,6 +179,11 @@ def pack_request(request: ScoringRequest,
     }
     if seq is not None:
         header["seq"] = int(seq)
+    ctx = trace_of(request)
+    if ctx is not None:
+        # Distributed-trace propagation: the context rides the frame header
+        # so the receiving hop parents its span under the sender's.
+        header["trace"] = ctx.to_wire()
     return _pack(header)
 
 
@@ -206,9 +216,13 @@ def unpack_request_ex(
             raise TransportError(f"sparse shard {name!r} missing ids/vals")
         features[name] = (pair["ids"], pair["vals"])
     deadline_ms = header.get("deadline_ms")
+    request = ScoringRequest(features=features, entity_ids=entity_ids,
+                             offset=offset)
+    ctx = TraceContext.from_wire(header.get("trace"))
+    if ctx is not None:
+        attach_trace(request, ctx)
     return (
-        ScoringRequest(features=features, entity_ids=entity_ids,
-                       offset=offset),
+        request,
         None if deadline_ms is None else deadline_ms / 1e3,
         header.get("seq"),
     )
@@ -225,14 +239,19 @@ def _seqed(header: dict, seq: Optional[int]) -> dict:
     return header
 
 
-def pack_scores(scores: np.ndarray, seq: Optional[int] = None) -> bytes:
-    return _pack(_seqed(
-        {"v": 1, "kind": "scores",
-         # host-sync: response egress — wire serialization of the host
-         # scores array the scorer already fetched (its ONE d2h).
-         "_arrays": [("scores", "", np.asarray(scores, np.float32))]},
-        seq,
-    ))
+def pack_scores(scores: np.ndarray, seq: Optional[int] = None,
+                meta: Optional[dict] = None) -> bytes:
+    """``meta`` piggybacks observability on the response header — the
+    child replica ships its completed span dicts (``spans``) and served
+    model version (``version``) inline, so trace merge needs no extra
+    round-trip on the hot path."""
+    header = {"v": 1, "kind": "scores",
+              # host-sync: response egress — wire serialization of the host
+              # scores array the scorer already fetched (its ONE d2h).
+              "_arrays": [("scores", "", np.asarray(scores, np.float32))]}
+    if meta:
+        header.update(meta)
+    return _pack(_seqed(header, seq))
 
 
 def pack_shed(reason: str, detail: str = "",
@@ -247,28 +266,40 @@ def pack_error(message: str, seq: Optional[int] = None) -> bytes:
 
 
 def _decode_response(payload: bytes):
-    """``(seq, scores, exception)`` from a response frame — exactly one of
-    scores/exception is set."""
+    """``(seq, scores, exception, header)`` from a response frame — exactly
+    one of scores/exception is set; the header carries any piggybacked
+    observability metadata (child spans, served version)."""
     header, arrays = _unpack(payload)
     kind = header.get("kind")
     seq = header.get("seq")
     if kind == "scores":
-        return seq, arrays[0], None
+        return seq, arrays[0], None, header
     if kind == "shed":
         return seq, None, RequestShedError(header.get("reason", "unknown"),
-                                           header.get("detail", ""))
+                                           header.get("detail", "")), header
     if kind == "error":
         return seq, None, TransportError(
             f"remote scoring failed: {header.get('message')}"
-        )
-    return seq, None, TransportError(f"unexpected response kind {kind!r}")
+        ), header
+    return (seq, None,
+            TransportError(f"unexpected response kind {kind!r}"), header)
 
 
 def unpack_response(payload: bytes) -> np.ndarray:
-    _, scores, exc = _decode_response(payload)
+    _, scores, exc, _ = _decode_response(payload)
     if exc is not None:
         raise exc
     return scores
+
+
+def unpack_response_ex(payload: bytes):
+    """``(scores, header)`` — the header-aware decode for callers that
+    consume the piggybacked span/version metadata (raises like
+    :func:`unpack_response` on shed/error frames)."""
+    _, scores, exc, header = _decode_response(payload)
+    if exc is not None:
+        raise exc
+    return scores, header
 
 
 # -- server ------------------------------------------------------------------
@@ -486,11 +517,15 @@ class AsyncScoringClient:
         resolve_once(fut, value, exc)
 
     def __init__(self, address, connections: int = 2, telemetry=None,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0, observer=None):
         from photon_tpu.telemetry import NULL_SESSION
 
         self.address = tuple(address)
         self.telemetry = telemetry or NULL_SESSION
+        # Optional FleetObserver: when set, sampled requests originate a
+        # client-side span whose context rides the request frame, so the
+        # server-side trace links under the caller's clock.
+        self.observer = observer
         self._seq = 0
         self._lock = threading.Lock()
         self._closed = False
@@ -502,6 +537,7 @@ class AsyncScoringClient:
                 "sock": sock,
                 "wlock": threading.Lock(),
                 "pending": {},  # seq -> Future (this connection's)
+                "spans": {},  # seq -> client-side SpanRecord (traced only)
             }
             conn["reader"] = threading.Thread(
                 target=self._read_loop, args=(conn,),
@@ -524,13 +560,19 @@ class AsyncScoringClient:
             seq = self._seq
         conn = self._conns[seq % len(self._conns)]
         fut = Future()
+        span = (self.observer.client_span(request)
+                if self.observer is not None else None)
         payload = pack_request(request, deadline_s, seq=seq)
+        if span is not None:
+            span.event("send", seq=seq, nbytes=len(payload))
+            conn["spans"][seq] = span
         conn["pending"][seq] = fut
         try:
             with conn["wlock"]:
                 write_frame(conn["sock"], payload)
         except OSError as e:
             conn["pending"].pop(seq, None)
+            self._finish_span(conn, seq, status="error")
             self._settle(fut, exc=TransportError(f"send failed: {e}"))
             return fut
         dead = conn.get("dead")
@@ -539,10 +581,24 @@ class AsyncScoringClient:
             # peer FIN can still succeed into the socket buffer): nothing
             # will ever match this seq — fail it now, not at timeout.
             conn["pending"].pop(seq, None)
+            self._finish_span(conn, seq, status="error")
             self._settle(fut, exc=TransportError(
                 f"connection lost with request in flight: {dead}"
             ))
         return fut
+
+    def _finish_span(self, conn, seq, status: str = "ok",
+                     header: Optional[dict] = None) -> None:
+        span = conn["spans"].pop(seq, None)
+        if span is None:
+            return
+        version = None if header is None else header.get("version")
+        span.event("response", seq=seq, version=version)
+        if version is not None:
+            span.attrs["version"] = version
+        span.finish(status=status)
+        if self.observer is not None:
+            self.observer.collector.add(span)
 
     def _read_loop(self, conn) -> None:
         while True:
@@ -556,8 +612,15 @@ class AsyncScoringClient:
                 conn["dead"] = e
                 self._fail_pending(conn, e)
                 return
-            seq, scores, exc = _decode_response(payload)
+            seq, scores, exc, header = _decode_response(payload)
             fut = conn["pending"].pop(seq, None)
+            if isinstance(exc, RequestShedError):
+                status = "shed"
+            elif exc is not None:
+                status = "error"
+            else:
+                status = "ok"
+            self._finish_span(conn, seq, status=status, header=header)
             if fut is None:
                 continue  # unknown tag: a late frame after a local failure
             self._settle(fut, scores, exc)
@@ -566,6 +629,8 @@ class AsyncScoringClient:
         pending, conn["pending"] = conn["pending"], {}
         if not self._closed and pending:
             self.telemetry.counter("serving.transport_drops").inc()
+        for seq in list(conn["spans"]):
+            self._finish_span(conn, seq, status="error")
         for fut in pending.values():
             self._settle(fut, exc=TransportError(
                 f"connection lost with request in flight: {error}"

@@ -35,6 +35,20 @@ from photon_tpu.telemetry.registry import (  # noqa: F401
     MetricsRegistry,
 )
 from photon_tpu.telemetry.tracing import Span, Tracer  # noqa: F401
+from photon_tpu.telemetry.distributed import (  # noqa: F401
+    FlightRecorder,
+    MergeableHistogram,
+    SpanRecord,
+    TraceCollector,
+    TraceContext,
+    TraceSampler,
+    activate_trace,
+    attach_trace,
+    current_trace,
+    new_trace_id,
+    span_of,
+    trace_of,
+)
 
 # photon_tpu.telemetry.report is imported lazily (build_report below): it is
 # also the `python -m photon_tpu.telemetry.report` CLI, and importing it here

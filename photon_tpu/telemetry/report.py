@@ -516,6 +516,76 @@ def _render_online_section(report: dict) -> list:
     return lines
 
 
+def _render_observe_section(report: dict) -> list:
+    """The fleet observability plane (ISSUE 16): cross-process trace
+    critical paths (queue vs batch-wait vs transport vs compute per
+    request, stage sum reconciling with end-to-end latency by
+    construction), SLO burn-rate state + fired alerts, and the flight
+    dumps collected from dead replicas.  Reads the driver-provided
+    ``extra["observe"]`` payload (``FleetObserver.export()``); empty when
+    the run was not observed."""
+    observe = (report.get("extra") or {}).get("observe") or {}
+    if not observe:
+        return []
+    lines = ["", "## Fleet traces / SLOs", ""]
+    lines.append(
+        f"- **tracing**: sample rate {_fmt(observe.get('sample_rate'))}, "
+        f"{_fmt(observe.get('traces_kept'))} trace(s) kept, "
+        f"{_fmt(observe.get('spans_merged'))} child span(s) merged"
+    )
+    paths = observe.get("critical_paths") or []
+    if paths:
+        stage_names = [s["stage"] for s in paths[0].get("stages", [])]
+        lines += ["",
+                  "| trace | procs | spans | total (s) | "
+                  + " | ".join(f"{n} (s)" for n in stage_names) + " |",
+                  "|---|---|---|---|" + "---|" * len(stage_names)]
+        for cp in paths:
+            stages = {s["stage"]: s["duration_s"]
+                      for s in cp.get("stages", [])}
+            lines.append(
+                f"| {cp.get('trace_id', '?')} "
+                f"| {len(cp.get('processes', []))} "
+                f"| {_fmt(cp.get('spans'))} | {_fmt(cp.get('total_s'))} | "
+                + " | ".join(_fmt(stages.get(n)) for n in stage_names)
+                + " |"
+            )
+    slo = observe.get("slo") or {}
+    slos = slo.get("slos") or []
+    if slos:
+        lines += ["", "| SLO | kind | objective | budget | fast burn "
+                  "| slow burn | state |",
+                  "|---|---|---|---|---|---|---|"]
+        for row in slos:
+            state = "**ALERT**" if row.get("alerting") else "ok"
+            lines.append(
+                f"| {row.get('name', '?')} | {row.get('kind', '?')} "
+                f"| {_fmt(row.get('objective'))} | {_fmt(row.get('budget'))} "
+                f"| {_fmt(row.get('fast_burn'))} "
+                f"| {_fmt(row.get('slow_burn'))} | {state} |"
+            )
+    alerts = slo.get("alerts") or []
+    if alerts:
+        parts = ", ".join(
+            f"{a.get('slo', '?')} (fast {_fmt(a.get('fast_burn'))}×)"
+            for a in alerts
+        )
+        lines.append(f"- **alerts fired**: {len(alerts)} — {parts}")
+    dumps = observe.get("flight_dumps") or []
+    if dumps:
+        lines += ["", "### Flight dumps", ""]
+        for d in dumps:
+            where = d.get("path") or "(in memory)"
+            lines.append(
+                f"- **{d.get('replica', '?')}** g{d.get('generation', 0)} "
+                f"({d.get('cause', '?')}): "
+                f"{_fmt(d.get('child_records'))} child record(s), "
+                f"{_fmt(d.get('lost_spans_recovered'))} lost span(s) "
+                f"recovered — {where}"
+            )
+    return lines
+
+
 def render_markdown(report: dict) -> str:
     """Human-readable view of a run report dict."""
     lines = [
@@ -556,6 +626,7 @@ def render_markdown(report: dict) -> str:
     lines += _render_entity_solves_section(report)
     lines += _render_serving_section(report)
     lines += _render_fleet_section(report)
+    lines += _render_observe_section(report)
     lines += _render_online_section(report)
 
     metrics = report.get("metrics") or {}

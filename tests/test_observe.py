@@ -1,0 +1,665 @@
+"""Fleet observability plane (ISSUE 16): cross-process tracing, live
+metrics, SLO burn rates, crash flight recorder.
+
+The contracts pinned here:
+
+- a trace context attached to a request survives the wire roundtrip
+  (``pack_request`` → ``unpack_request_ex``) bit-exactly;
+- the deterministic sampler honors its rate exactly (no RNG — sampling is
+  a property of the rate, not of luck);
+- mergeable histograms merge cross-process snapshots by addition and keep
+  sane quantiles;
+- a traced request through a live fleet yields ONE merged trace whose
+  critical-path stage sum reconciles with the measured end-to-end latency
+  by construction, stamped with the served model version;
+- a shed request's trace carries the shed-decision event;
+- a replica killed mid-replay yields a single merged trace showing the
+  reroute — no orphan spans;
+- a SUBPROCESS fleet merges client + router + child-replica spans into
+  one trace spanning >= 3 processes, and a SIGKILL'd child leaves a
+  flight-recorder dump (collected by the supervisor, persisted to disk,
+  unfinished child spans adopted as "lost" stubs);
+- the multiwindow SLO burn-rate monitor alerts only when BOTH windows
+  burn, fires on entering alert state only, and notifies subscribers;
+- per-bucket admission-error histograms break the projection error down
+  by bucket;
+- the HTTP metrics plane serves Prometheus text and the JSON snapshot the
+  ``python -m photon_tpu.telemetry.live`` console renders;
+- a rollout under an ambient trace (the online publish path) links
+  publish → rollout → probe spans into one trace;
+- the report renderer draws the "Fleet traces / SLOs" section.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+import types
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.synthetic import make_game_dataset
+from photon_tpu.fault.injection import FaultPlan, set_plan
+from photon_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.models.glm import Coefficients, model_for_task
+from photon_tpu.serving import (
+    AsyncScoringClient,
+    FleetObserver,
+    ObservePolicy,
+    RequestShedError,
+    ServingFleet,
+    Slo,
+    SloMonitor,
+    SupervisorPolicy,
+    build_requests,
+    host_score_request,
+    request_spec_for_dataset,
+)
+from photon_tpu.serving.transport import pack_request, unpack_request_ex
+from photon_tpu.telemetry import TelemetrySession
+from photon_tpu.telemetry.distributed import (
+    FlightRecorder,
+    MergeableHistogram,
+    SpanRecord,
+    TraceContext,
+    TraceSampler,
+    activate_trace,
+    attach_trace,
+    new_trace_id,
+    trace_of,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    yield
+    set_plan(None)
+
+
+def _fixture(seed=3, n_entities=40, fixed_dim=6, random_dim=4):
+    data, _ = make_game_dataset(
+        n_entities, 4, fixed_dim, random_dim, seed=seed
+    )
+    rng = np.random.default_rng(seed)
+    keys = np.unique(data.id_columns["re0"])
+    model = GameModel(
+        coordinates={
+            "fixed": FixedEffectModel(
+                model_for_task("logistic_regression", Coefficients(
+                    rng.standard_normal(fixed_dim).astype(np.float32)
+                )),
+                "global",
+            ),
+            "per_entity": RandomEffectModel(
+                table=rng.standard_normal(
+                    (len(keys), random_dim)
+                ).astype(np.float32),
+                keys=keys, entity_column="re0", shard_name="re0",
+                task_type="logistic_regression",
+            ),
+        },
+        task_type="logistic_regression",
+    )
+    return model, data
+
+
+def _retrained(model: GameModel, seed: int) -> GameModel:
+    rng = np.random.default_rng(seed)
+    fixed = model.coordinates["fixed"]
+    per_entity = model.coordinates["per_entity"]
+    means = np.asarray(fixed.coefficients.means)
+    return GameModel(
+        coordinates={
+            "fixed": FixedEffectModel(
+                model_for_task(model.task_type, Coefficients(
+                    (means + rng.standard_normal(means.shape)).astype(
+                        np.float32
+                    )
+                )),
+                fixed.shard_name,
+            ),
+            "per_entity": RandomEffectModel(
+                table=rng.standard_normal(
+                    (per_entity.num_entities, per_entity.dim)
+                ).astype(np.float32),
+                keys=per_entity.keys,
+                entity_column=per_entity.entity_column,
+                shard_name=per_entity.shard_name,
+                task_type=model.task_type,
+            ),
+        },
+        task_type=model.task_type,
+    )
+
+
+def _observed_fleet(model, data, session, replicas=2, **kwargs):
+    fleet = ServingFleet(
+        model, replicas=replicas,
+        request_spec=request_spec_for_dataset(model, data),
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+        **kwargs,
+    ).warmup()
+    observer = fleet.observe(start=False)
+    return fleet, observer
+
+
+def _trace_with_span(collector, name):
+    for tid in reversed(collector.trace_ids()):
+        if any(d.get("name") == name for d in collector.trace(tid)):
+            return tid
+    return None
+
+
+# -- wire + primitives --------------------------------------------------------
+
+def test_trace_context_rides_the_wire():
+    (req,) = build_requests(*(_fixture(seed=5)[::-1]), [3])
+    ctx = TraceContext(new_trace_id(), "abcd1234", True)
+    attach_trace(req, ctx)
+    got, deadline, seq = unpack_request_ex(pack_request(req, seq=7))
+    assert seq == 7
+    got_ctx = trace_of(got)
+    assert got_ctx is not None
+    assert got_ctx.trace_id == ctx.trace_id
+    assert got_ctx.span_id == ctx.span_id
+    # An untraced request stays untraced across the wire.
+    (bare,) = build_requests(*(_fixture(seed=5)[::-1]), [3])
+    got2, _, _ = unpack_request_ex(pack_request(bare))
+    assert trace_of(got2) is None
+
+
+def test_sampler_is_deterministic_and_exact():
+    sampler = TraceSampler(0.25)
+    picks = [sampler.should_sample() for _ in range(100)]
+    assert picks[0] is True  # the first request always samples
+    # The accumulator crosses 1.0 every 4th request thereafter: the count
+    # is exact, not probabilistic.
+    assert sum(picks) == 26
+    twin = TraceSampler(0.25)
+    assert picks == [twin.should_sample() for _ in range(100)]
+    assert all(TraceSampler(1.0).should_sample() for _ in range(10))
+    assert not any(TraceSampler(0.0).should_sample() for _ in range(10))
+
+
+def test_mergeable_histogram_merges_across_snapshots():
+    a, b = MergeableHistogram(), MergeableHistogram()
+    for v in (0.001, 0.002, 0.004):
+        a.observe(v)
+    for v in (0.1, 0.2):
+        b.observe(v)
+    merged = MergeableHistogram.merged([a.snapshot(), b.snapshot()])
+    assert merged.count == 5
+    assert 0.0005 <= merged.quantile(0.5) <= 0.02
+    assert merged.quantile(0.99) >= 0.05
+
+
+def test_flight_recorder_ring_bounded_and_dump_roundtrip(tmp_path):
+    ring = FlightRecorder("r0", capacity=4)
+    for i in range(10):
+        ring.record("event", i=i)
+    snap = ring.snapshot()
+    assert len(snap["records"]) == 4
+    assert snap["records_total"] == 10
+    assert [r["i"] for r in snap["records"]] == [6, 7, 8, 9]
+    path = str(tmp_path / "r0.flight.json")
+    ring.dump(path)
+    loaded = FlightRecorder.load(path)
+    assert loaded["owner"] == "r0"
+    assert [r["i"] for r in loaded["records"]] == [6, 7, 8, 9]
+    assert FlightRecorder.load(str(tmp_path / "missing.json")) is None
+
+
+# -- SLO burn rates -----------------------------------------------------------
+
+def test_slo_multiwindow_burn_alerts_once_and_notifies():
+    clock = types.SimpleNamespace(t=1000.0)
+    session = TelemetrySession("test-slo")
+    monitor = SloMonitor(
+        [Slo("p99_latency", "latency", objective=0.1, budget=0.01,
+             fast_window_s=5.0, slow_window_s=60.0,
+             fast_burn=14.0, slow_burn=2.0)],
+        telemetry=session, clock=lambda: clock.t,
+    )
+    seen = []
+    monitor.subscribe(seen.append)
+    # Healthy traffic: no alert even after many evaluations.
+    for _ in range(50):
+        monitor.observe_request("ok", 0.01)
+        clock.t += 0.05
+    assert monitor.evaluate() == []
+    # A latency cliff: every request blows the objective — both windows
+    # burn and the alert fires exactly once while the state persists.
+    for _ in range(50):
+        monitor.observe_request("ok", 0.5)
+        clock.t += 0.05
+    fired = monitor.evaluate()
+    assert len(fired) == 1 and fired[0]["slo"] == "p99_latency"
+    assert monitor.evaluate() == []  # still alerting — not re-fired
+    assert seen == fired
+    gauges = {
+        (m["labels"]["slo"], m["labels"]["window"]): m["value"]
+        for m in session.registry.snapshot()["gauges"]
+        if m["name"] == "slo.burn_rate"
+    }
+    assert gauges[("p99_latency", "fast")] >= 14.0
+    # Recovery clears the alert state, so a second cliff re-fires.
+    for _ in range(200):
+        monitor.observe_request("ok", 0.01)
+        clock.t += 0.5
+    assert monitor.evaluate() == []
+    for _ in range(50):
+        monitor.observe_request("ok", 0.5)
+        clock.t += 0.05
+    assert len(monitor.evaluate()) == 1
+
+
+def test_slo_shed_fraction_kind_counts_sheds():
+    clock = types.SimpleNamespace(t=0.0)
+    monitor = SloMonitor(
+        [Slo("shed_fraction", "shed_fraction", objective=0.0, budget=0.05,
+             fast_burn=2.0, slow_burn=1.0)],
+        clock=lambda: clock.t,
+    )
+    for i in range(40):
+        monitor.observe_request("shed" if i % 2 else "ok", 0.01)
+        clock.t += 0.1
+    monitor.evaluate()
+    state = monitor.export()["slos"][0]
+    assert state["alerting"]  # 50% shed against a 5% budget
+    assert state["fast_burn"] == pytest.approx(10.0, rel=0.3)
+
+
+# -- traced serving -----------------------------------------------------------
+
+def test_traced_request_critical_path_reconciles_with_latency():
+    model, data = _fixture(seed=7)
+    session = TelemetrySession("test-trace")
+    fleet, observer = _observed_fleet(model, data, session, replicas=1)
+    with fleet:
+        (req,) = build_requests(data, model, [4])
+        t0 = time.monotonic()
+        got = fleet.score(req, deadline_s=30.0)
+        wall = time.monotonic() - t0
+        np.testing.assert_allclose(
+            got, host_score_request(model, req), rtol=1e-4, atol=1e-4
+        )
+    tids = observer.collector.trace_ids()
+    assert len(tids) == 1
+    spans = observer.collector.trace(tids[0])
+    (root,) = [d for d in spans if d.get("parent_id") is None]
+    assert root["name"] == "serving.request"
+    assert root["status"] == "ok"
+    events = {e["name"] for e in root["events"]}
+    assert {"enqueue", "admit", "dispatch", "batch_close",
+            "score_begin", "score_end"} <= events
+    # The served model version is stamped into the response span.
+    assert root["attrs"]["version"] == 0
+    cp = observer.collector.critical_path(tids[0])
+    assert cp["stage_sum_s"] == pytest.approx(cp["total_s"], abs=1e-6)
+    assert cp["total_s"] <= wall + 0.05
+    assert [s["stage"] for s in cp["stages"]] == [
+        "queue", "batch_wait", "transport", "compute", "child_other",
+        "resolve",
+    ]
+    # The live plane aggregated the request under its version.
+    snap = observer.fleet_snapshot()
+    assert snap["versions"]["0"]["requests"] == 1
+    assert snap["versions"]["0"]["p99_s"] is not None
+
+
+def test_shed_request_trace_carries_shed_decision_event():
+    model, data = _fixture(seed=11)
+    session = TelemetrySession("test-shed-trace")
+    fleet, observer = _observed_fleet(model, data, session, replicas=1)
+    with fleet:
+        (req,) = build_requests(data, model, [4])
+        fleet.score(req, deadline_s=30.0)
+        with pytest.raises(RequestShedError):
+            fleet.submit(req, deadline_s=0.0)
+    shed_spans = [
+        d for tid in observer.collector.trace_ids()
+        for d in observer.collector.trace(tid)
+        if d.get("status") == "shed"
+    ]
+    assert len(shed_spans) == 1
+    (shed_event,) = [
+        e for e in shed_spans[0]["events"] if e["name"] == "shed"
+    ]
+    assert shed_event["reason"] == "deadline"
+    snap = observer.fleet_snapshot()
+    assert sum(v["requests"] for v in snap["versions"].values()) == 2
+    assert any(v["shed_rate"] > 0 for v in snap["versions"].values())
+
+
+def test_replica_kill_yields_single_merged_trace_with_reroute():
+    model, data = _fixture(seed=13)
+    session = TelemetrySession("test-kill-trace")
+    fleet, observer = _observed_fleet(model, data, session, replicas=2)
+    with fleet:
+        requests = build_requests(data, model, [4] * 10)
+        set_plan(FaultPlan.parse("serve:replica_kill:replica=r0:times=1"))
+        futures = [fleet.submit(r) for r in requests]
+        results = [f.result(timeout=60) for f in futures]
+        set_plan(None)
+        assert len(results) == len(requests)
+    rerouted = [
+        tid for tid in observer.collector.trace_ids()
+        if any(e["name"] == "reroute"
+               for d in observer.collector.trace(tid)
+               for e in d.get("events", ()))
+    ]
+    assert rerouted  # the kill landed inside a traced request
+    for tid in rerouted:
+        spans = observer.collector.trace(tid)
+        # ONE merged trace: a single root, every span finished (the
+        # rerouted request resolved ok through the survivor — no orphans).
+        roots = [d for d in spans if d.get("parent_id") is None]
+        assert len(roots) == 1
+        assert roots[0]["status"] == "ok"
+        assert all(d.get("duration_s") is not None for d in spans)
+        (reroute_event,) = [
+            e for e in roots[0]["events"] if e["name"] == "reroute"
+        ]
+        assert reroute_event["from_replica"] == "r0"
+
+
+def test_per_bucket_admission_error_histograms():
+    model, data = _fixture(seed=17)
+    session = TelemetrySession("test-bucket-hist")
+    fleet, observer = _observed_fleet(model, data, session, replicas=1)
+    with fleet:
+        for req in build_requests(data, model, [1, 3, 9, 16]):
+            fleet.score(req, deadline_s=30.0)
+    hists = {
+        tuple(sorted((m.get("labels") or {}).items())): m
+        for m in session.registry.snapshot()["histograms"]
+        if m["name"] == "serving.admission_error_s"
+    }
+    buckets = {
+        dict(labels).get("bucket")
+        for labels in hists if dict(labels).get("bucket")
+    }
+    # Rows 1 and 3 pad into small buckets, 9 and 16 into 16 — at least
+    # two distinct per-bucket series, next to the unlabeled aggregate.
+    assert len(buckets) >= 2
+    assert () in hists  # the unlabeled twin keeps its historic shape
+    # Every projection-error sample lands in BOTH the aggregate and its
+    # bucket series (the first request has no pace EWMA yet, so no
+    # projection — both sides skip it identically).
+    assert sum(
+        m["count"] for labels, m in hists.items() if labels
+    ) == hists[()]["count"] >= 3
+
+
+# -- subprocess fleet: 3-process traces + flight recorder ---------------------
+
+def test_subprocess_trace_spans_three_processes_and_flight_dump(tmp_path):
+    """ISSUE 16 acceptance: one scoring request through client → router →
+    subprocess replica produces a single merged trace spanning >= 3
+    processes whose critical path reconciles; a SIGKILL'd child leaves a
+    flight dump the supervisor collects, with unfinished child spans
+    adopted as "lost" stubs."""
+    model, data = _fixture(seed=19)
+    session = TelemetrySession("test-subprocess-trace")
+    spec = request_spec_for_dataset(model, data)
+    fleet = ServingFleet(
+        model, replicas=1, backend="subprocess", request_spec=spec,
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+    ).warmup()
+    observer = fleet.observe(start=False, flight_dir=str(tmp_path))
+    try:
+        server = fleet.serve()
+        (req,) = build_requests(data, model, [4])
+        client = AsyncScoringClient(
+            server.address, connections=1, telemetry=session,
+            observer=observer,
+        )
+        try:
+            got = client.submit(req).result(timeout=60)
+        finally:
+            client.close()
+        np.testing.assert_allclose(
+            got, host_score_request(model, req), rtol=1e-4, atol=1e-4
+        )
+        observer.poll_once()
+        tid = _trace_with_span(observer.collector, "client.request")
+        assert tid is not None
+        spans = observer.collector.trace(tid)
+        names = {d["name"] for d in spans}
+        assert {"client.request", "serving.request",
+                "replica.score"} <= names
+        processes = observer.collector.processes(tid)
+        assert len(processes) >= 3
+        # The child hop ran in a DIFFERENT OS process.
+        child_pids = {
+            p.rsplit(":", 1)[-1] for p in processes
+            if p.startswith("replica-")
+        }
+        assert child_pids and str(os.getpid()) not in child_pids
+        (child,) = [d for d in spans if d["name"] == "replica.score"]
+        child_events = {e["name"] for e in child["events"]}
+        assert {"ingress", "compute_begin", "compute_end",
+                "egress"} <= child_events
+        assert child["attrs"]["version"] == 0
+        cp = observer.collector.critical_path(tid)
+        assert cp["stage_sum_s"] == pytest.approx(cp["total_s"], abs=1e-6)
+        stage = {s["stage"]: s["duration_s"] for s in cp["stages"]}
+        assert stage["compute"] > 0.0  # the child's own clock contributed
+        # The merged tree has one root (the client span) and no orphans.
+        tree = observer.collector.tree(tid)
+        assert tree["name"] == "client.request"
+
+        # -- the crash: SIGKILL the child mid-life, supervisor collects.
+        sup = fleet.supervise(
+            SupervisorPolicy(probe_interval_s=0.05, probe_deadline_s=30.0,
+                             resurrect=False),  # postmortem only
+            start=False,
+        )
+        r0 = fleet.replicas[0]
+        os.kill(r0.child_pid, signal.SIGKILL)
+        deadline = time.monotonic() + 60.0
+        while r0.alive and time.monotonic() < deadline:
+            sup.check_once()
+            time.sleep(0.05)
+        assert not r0.alive
+        assert observer.flight_dumps, "the death produced no flight dump"
+        dump_meta = observer.flight_dumps[0]
+        assert dump_meta["replica"] == "r0"
+        assert dump_meta["path"] and os.path.exists(dump_meta["path"])
+        with open(dump_meta["path"]) as f:
+            dump = json.load(f)
+        assert dump["cause"] and dump["cause"] == dump_meta["cause"]
+        # The child's pre-scoring flush left the traced batch's ingress.
+        child_kinds = {r["kind"] for r in dump["child"]["records"]}
+        assert {"frame", "span"} <= child_kinds
+        assert dump["parent"] is not None  # parent-side ring collected too
+    finally:
+        fleet.close()
+
+
+def test_collect_flight_adopts_unshipped_spans_as_lost(tmp_path):
+    """Span-stream loss recovery: a span the victim opened but never
+    shipped is adopted as a terminal "lost" stub — the trace keeps the
+    hop instead of orphaning it."""
+    observer = FleetObserver(telemetry=TelemetrySession("test-lost"),
+                             flight_dir=str(tmp_path))
+    tid = new_trace_id()
+    root = SpanRecord(tid, "serving.request", "router:1")
+    root.finish()
+    observer.collector.add(root)
+    # The victim's ring: one span opened, never closed, never shipped.
+    ring = FlightRecorder("r9")
+    orphan = SpanRecord(tid, "replica.score", "replica-r9:4242",
+                        parent_id=root.span_id)
+    ring.note_span(orphan, "open")
+    flight_path = str(tmp_path / "r9.flight.json")
+    ring.dump(flight_path)
+    victim = types.SimpleNamespace(
+        replica_id="r9", generation=2, flight_path=flight_path
+    )
+    path = observer.collect_flight(victim, "crash")
+    assert path and os.path.exists(path)
+    spans = observer.collector.trace(tid)
+    assert len(spans) == 2
+    (lost,) = [d for d in spans if d["name"] == "replica.score"]
+    assert lost["status"] == "lost"
+    assert lost["attrs"]["lost_reason"] == "crash"
+    assert observer.flight_dumps[0]["lost_spans_recovered"] == 1
+    # A shipped span is NOT duplicated by a later dump collection.
+    observer.collect_flight(victim, "crash")
+    assert len(observer.collector.trace(tid)) == 2
+
+
+# -- live metrics plane -------------------------------------------------------
+
+def test_http_metrics_plane_and_live_console(capsys):
+    import urllib.request
+
+    from photon_tpu.telemetry import live as live_console
+
+    model, data = _fixture(seed=23)
+    session = TelemetrySession("test-http-plane")
+    fleet = ServingFleet(
+        model, replicas=1,
+        request_spec=request_spec_for_dataset(model, data),
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+    ).warmup()
+    observer = fleet.observe(
+        policy=ObservePolicy(http_port=0, poll_interval_s=0.05)
+    )
+    with fleet:
+        for req in build_requests(data, model, [2, 5]):
+            fleet.score(req, deadline_s=30.0)
+        host, port = observer.http_address
+        base = f"http://{host}:{port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        assert "serving_requests" in prom
+        with urllib.request.urlopen(f"{base}/fleet.json", timeout=10) as r:
+            snap = json.loads(r.read().decode())
+        assert snap["versions"]["0"]["requests"] == 2
+        assert "slo" in snap
+        # The console view renders one frame from the same endpoint.
+        rc = live_console.main(["--url", base, "--once"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fleet @" in out
+        assert "qps" in out
+    assert observer.http_address is None  # close() tore the server down
+
+
+# -- linked publish trace + report --------------------------------------------
+
+def test_rollout_under_ambient_trace_links_publish_and_probes():
+    """The online publish path (``RefreshService._publish``): the rollout
+    and its canary probes parent under the ambient publish span — one
+    linked trace for refresh → canary → swap."""
+    from photon_tpu.online.service import OnlineLearningService
+
+    model, data = _fixture(seed=29)
+    retrained = _retrained(model, seed=31)
+    session = TelemetrySession("test-publish-trace")
+    fleet, observer = _observed_fleet(model, data, session, replicas=2)
+    with fleet:
+        for req in build_requests(data, model, [4, 4]):
+            fleet.score(req, deadline_s=30.0)  # seeds the probe mirror
+        svc = types.SimpleNamespace(
+            fleet=fleet,
+            policy=types.SimpleNamespace(rollout_parity_tol=1e-3),
+        )
+        OnlineLearningService._publish(svc, retrained)
+        # Served version advanced — new responses stamp version 1.
+        (req,) = build_requests(data, model, [4])
+        fleet.score(req, deadline_s=30.0)
+    tid = _trace_with_span(observer.collector, "online.publish")
+    assert tid is not None
+    spans = observer.collector.trace(tid)
+    (publish,) = [d for d in spans if d["name"] == "online.publish"]
+    (rollout,) = [d for d in spans if d["name"] == "serving.rollout"]
+    assert publish["parent_id"] is None
+    assert rollout["parent_id"] == publish["span_id"]
+    assert publish["status"] == "ok" and rollout["status"] == "ok"
+    phases = [e["name"] for e in rollout["events"]]
+    assert "canary" in phases and "promoted" in phases
+    # Probe requests rode the same trace through the router.
+    probe_roots = [d for d in spans if d["name"] == "serving.request"]
+    assert probe_roots
+    assert all(d["parent_id"] == rollout["span_id"] for d in probe_roots)
+    # Post-swap responses carry the new version.
+    v1 = [
+        d for t in observer.collector.trace_ids()
+        for d in observer.collector.trace(t)
+        if d["name"] == "serving.request"
+        and (d.get("attrs") or {}).get("version") == 1
+    ]
+    assert v1
+
+
+def test_rollout_without_ambient_trace_still_traced():
+    model, data = _fixture(seed=37)
+    retrained = _retrained(model, seed=41)
+    session = TelemetrySession("test-rollout-trace")
+    fleet, observer = _observed_fleet(model, data, session, replicas=2)
+    with fleet:
+        for req in build_requests(data, model, [4, 4]):
+            fleet.score(req, deadline_s=30.0)
+        fleet.rollout(retrained)
+    tid = _trace_with_span(observer.collector, "serving.rollout")
+    assert tid is not None
+    (rollout,) = [
+        d for d in observer.collector.trace(tid)
+        if d["name"] == "serving.rollout"
+    ]
+    assert rollout["parent_id"] is None  # fresh trace, no ambient parent
+
+
+def test_ambient_trace_context_manager_restores():
+    assert __import__(
+        "photon_tpu.telemetry.distributed", fromlist=["current_trace"]
+    ).current_trace() is None
+    ctx = TraceContext(new_trace_id(), "feed1234", True)
+    from photon_tpu.telemetry.distributed import current_trace
+
+    with activate_trace(ctx):
+        assert current_trace() is ctx
+        inner = TraceContext(new_trace_id(), "beef5678", True)
+        with activate_trace(inner):
+            assert current_trace() is inner
+        assert current_trace() is ctx
+    assert current_trace() is None
+
+
+def test_report_renders_fleet_traces_slos_section():
+    from photon_tpu.telemetry.report import render_markdown
+
+    model, data = _fixture(seed=43)
+    session = TelemetrySession("test-observe-report")
+    fleet, observer = _observed_fleet(model, data, session, replicas=1)
+    with fleet:
+        for req in build_requests(data, model, [4, 8]):
+            fleet.score(req, deadline_s=30.0)
+        with pytest.raises(RequestShedError):
+            fleet.submit(req, deadline_s=0.0)
+    observer.flight_dumps.append({
+        "replica": "r0", "cause": "crash", "path": None, "generation": 1,
+        "child_records": 7, "lost_spans_recovered": 1,
+        "collected_at": time.time(),
+    })
+    report = session.build_report(extra={"observe": observer.export()})
+    text = render_markdown(report)
+    assert "## Fleet traces / SLOs" in text
+    assert "queue (s)" in text and "compute (s)" in text
+    assert "p99_latency" in text and "shed_fraction" in text
+    assert "### Flight dumps" in text
+    assert "1 lost span(s) recovered" in text.replace("**", "") or (
+        "lost span(s)" in text
+    )
+    # A report without the payload renders no section.
+    assert "Fleet traces" not in render_markdown(session.build_report())

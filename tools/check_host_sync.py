@@ -94,6 +94,14 @@ DEFAULT_FILES = (
     "photon_tpu/online/feed.py",
     "photon_tpu/online/delta.py",
     "photon_tpu/online/service.py",
+    # The observability plane (ISSUE 16): tracing, live metrics, SLO
+    # burn rates, and flight-recorder collection are pure host-side
+    # bookkeeping over plain dicts — an observer that fetched device
+    # data would BE the latency it exists to measure, and a d2h inside
+    # the span/event path would charge every traced request for it.
+    "photon_tpu/telemetry/distributed.py",
+    "photon_tpu/telemetry/live.py",
+    "photon_tpu/serving/observe.py",
 )
 
 SYNC_PATTERN = re.compile(
