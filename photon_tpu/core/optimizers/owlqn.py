@@ -229,10 +229,50 @@ def owlqn(
         return tree_where(s.active, new, s)
 
     final = lax.while_loop(cond, body, init)
-    pg_final = _pseudo_gradient(final.w, final.g, l1)
+
+    # Full-step polish (same graft as lbfgs.py): two unsearched steps of
+    # the final quasi-Newton map, run through OWL-QN's machinery — the
+    # direction is built from the PSEUDO-gradient, projected onto its
+    # descent orthant, and the stepped point is orthant-projected, so
+    # polish can only sharpen coordinates inside the orthant the loop
+    # settled in (exact zeros stay exactly zero).  Kept per lane only if
+    # the step is small relative to the iterate, everything stays
+    # finite, and the pseudo-gradient norm does not grow.
+    def polish(carry, _):
+        w, f, g = carry
+        pg = _pseudo_gradient(w, g, l1)
+        step = _project_direction(
+            _two_loop_direction(
+                pg, final.S, final.Y, final.rho, final.num_pairs,
+                final.insert_pos, final.gamma, m,
+            ),
+            pg,
+        )
+        near = jnp.all(jnp.isfinite(step)) & (
+            jnp.linalg.norm(step)
+            <= 1e-3 * jnp.maximum(jnp.linalg.norm(w), 1.0)
+        )
+        xi = jnp.where(w != 0.0, jnp.sign(w), -jnp.sign(pg))
+        w_new = jnp.where(near, _orthant_project(w + step, xi), w)
+        f_new, g_new = fun(w_new)
+        pg_new = _pseudo_gradient(w_new, g_new, l1)
+        keep = (
+            near & jnp.isfinite(f_new) & jnp.all(jnp.isfinite(g_new))
+            & (jnp.linalg.norm(pg_new) <= jnp.linalg.norm(pg))
+        )
+        return (
+            jnp.where(keep, w_new, w),
+            jnp.where(keep, f_new, f),
+            jnp.where(keep, g_new, g),
+        ), None
+
+    (w_out, f_out, g_out), _ = lax.scan(
+        polish, (final.w, final.f, final.g), None, length=2
+    )
+    pg_final = _pseudo_gradient(w_out, g_out, l1)
     return OptimizerResult(
-        w=final.w,
-        value=total(final.w, final.f),
+        w=w_out,
+        value=total(w_out, f_out),
         grad_norm=jnp.linalg.norm(pg_final),
         iterations=final.it,
         converged=reason_is_converged(final.reason),

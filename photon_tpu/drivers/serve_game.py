@@ -99,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
                    "waits for coalescing partners")
     p.add_argument("--seed", type=int, default=0,
                    help="traffic stream seed")
+    p.add_argument("--table-dtype", choices=("f32", "bf16", "int8"),
+                   default="f32",
+                   help="storage dtype for the device-resident serving "
+                   "tables (ISSUE 17): bf16 halves table bytes, int8 "
+                   "quarters them (per-row absmax scale row); gathers "
+                   "decode on device and ALL accumulation stays f32")
     return p
 
 
@@ -196,6 +202,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
             max_delay_s=args.max_delay_ms / 1000.0,
             telemetry=session,
             admission=AdmissionPolicy(default_deadline_s=deadline_s),
+            table_dtype=args.table_dtype,
         ).warmup()
         if args.supervise:
             from photon_tpu.serving import SupervisorPolicy
@@ -306,6 +313,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         "transport": args.transport,
         "traffic": args.traffic,
         "deadline_ms": args.deadline_ms,
+        "table_dtype": args.table_dtype,
     }
     _publish_text(
         args.output_dir, "serving_summary.json",

@@ -121,6 +121,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "forces spilling; otherwise --max-host-mb derives "
                    "<output-dir>/tile_store when the host budget is "
                    "exceeded.  Requires streamed mode")
+    p.add_argument("--tile-dtype", choices=("f32", "bf16", "int8"),
+                   default="f32",
+                   help="storage codec for the DISK tier's tile store "
+                   "(ISSUE 17): bf16 halves and int8 (per-row absmax "
+                   "scale row) quarters spilled feature blocks and score "
+                   "tiles; host-resident tiles and all accumulation stay "
+                   "f32.  Requires --spill-dir (or a --max-host-mb that "
+                   "derives one)")
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"),
                    help="storage dtype for FEATURE VALUES in every shard "
@@ -640,6 +648,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         stream_chunks=stream_rows,
         spill_dir=spill_dir,
         max_host_mb=args.max_host_mb if spill_dir is not None else None,
+        tile_dtype=args.tile_dtype,
     )
 
     import jax as _jax

@@ -75,6 +75,7 @@ class GameEstimator:
         stream_chunks: Optional[int] = None,
         spill_dir: Optional[str] = None,
         max_host_mb: Optional[float] = None,
+        tile_dtype: Optional[str] = None,
     ):
         """``normalization`` is keyed by feature-shard name and applies to
         fixed-effect coordinates on that shard (the reference normalizes the
@@ -100,7 +101,15 @@ class GameEstimator:
         fixed-effect feature stream are bounded by the cache budget
         instead of the dataset.  (The caller-provided ``training_data``
         itself and the random-effect bin layouts are still host-resident
-        — the ROADMAP tiering item's remaining edges.)"""
+        — the ROADMAP tiering item's remaining edges.)
+
+        ``tile_dtype`` (requires ``spill_dir``) picks the disk tier's
+        storage codec for feature blocks and score tiles —
+        ``f32 | bf16 | int8`` (:mod:`photon_tpu.game.lowp`; default f32,
+        the bit-exact tier).  Lossy tiers trade a bounded, measured fit-
+        metric perturbation (``lowp.TILE_METRIC_TOL``) for 2-4× less
+        disk traffic; all accumulation stays f32 and kill→resume parity
+        stays exact per codec."""
         self.task_type = task_type
         self.training_data = training_data
         self.validation_data = validation_data
@@ -160,6 +169,14 @@ class GameEstimator:
                     "max_host_mb bounds the spill host cache; set "
                     "spill_dir (or let the driver derive one)"
                 )
+        from photon_tpu.game.lowp import TILE_DTYPES, check_dtype
+
+        self.tile_dtype = check_dtype(tile_dtype, TILE_DTYPES, "tile dtype")
+        if self.tile_dtype != "f32" and spill_dir is None:
+            raise ValueError(
+                "tile_dtype selects the DISK tier's storage codec; set "
+                "spill_dir (host-resident tiles are always f32)"
+            )
         # Device-resident data shared across sweep configurations: building
         # the bucketed random-effect datasets (the reference's shuffle) and
         # uploading feature blocks happens once per distinct data config.
@@ -296,7 +313,10 @@ class GameEstimator:
                 spill_dataset,
             )
 
-            store = TileStore(self.spill_dir, telemetry=self.telemetry)
+            store = TileStore(
+                self.spill_dir, telemetry=self.telemetry,
+                tile_dtype=self.tile_dtype,
+            )
             cache = HostTileCache(
                 max_bytes=(
                     None if self.max_host_mb is None

@@ -1,0 +1,151 @@
+"""Low-precision storage codecs + per-codec parity bounds (ISSUE 17).
+
+Every hot path the benches measure is bytes-bound, not FLOP-bound: the
+serving gathers move HBM bytes, the spilled trainer moves disk bytes.
+This module is the ONE place the repo's precision tiers are defined —
+the storage dtypes (``f32 | bf16 | int8``), the host-side row codecs the
+disk tier encodes with, and the measured per-codec parity tolerances the
+serve-time canary gate, the supervisor's known-answer probe, and the
+benches all assert against.
+
+The recipe is 2112.09017's: STORAGE drops to bf16/int8, every
+multiply-accumulate stays float32.  int8 is symmetric per-row absmax
+quantization — alongside each int8 row rides one f32 scale
+(``absmax / 127``); a decode is ``q * scale`` in f32.  An all-zero row
+has ``absmax == 0`` so its stored scale is exactly 0 and the decode is
+exactly 0 — the serving zero-row / cold-entity fallback survives
+quantization bit-for-bit.
+
+The scale arithmetic runs in float64 and the canonical encoder iterates
+to a quantization fixed point, so re-encoding a decoded tile is
+byte-identical — what makes the tile store's read-modify-write publish
+cycle drift-free and kill->resume parity exact per codec.
+
+Residency contract (``tools/check_host_sync.py`` guards this module):
+the codecs here are pure host numpy by design — they encode/decode the
+DISK tier's bytes and must never touch device data (the serving tier's
+on-device decode lives in ``photon_tpu/game/model.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# The storage dtypes either byte mover accepts: serving gather tables
+# (``GameScorer(table_dtype=...)``) and tile-store arrays
+# (``TileStore(tile_dtype=...)``).
+TABLE_DTYPES = ("f32", "bf16", "int8")
+TILE_DTYPES = TABLE_DTYPES
+
+# Serve-time parity bounds vs the f32 host oracle (worst |delta| per
+# request), per table dtype.  f32 keeps the historical exact-path gate;
+# the lossy bounds are MEASURED: on the standard-normal serving fixtures
+# (dim 8-32 tables, unit-scale features) bf16 lands ~1e-2 worst-case
+# (8-bit mantissa, ~0.4% per entry, f32 accumulation) and int8 ~3e-2
+# (<=0.5*scale per entry); the bounds below carry ~4x headroom and the
+# serving bench asserts the measured number stays under them.
+PARITY_TOL = {"f32": 1e-3, "bf16": 5e-2, "int8": 2e-1}
+
+# Spilled-training metric bounds vs the f32 oracle fit (per validation
+# metric, absolute): lossy FEATURE/score-tile storage perturbs the fit
+# itself, not just a readout, so the bounds are wider than serving's.
+# f32 keeps the bit-exact tier's 1e-6; the lossy numbers are measured by
+# ``bench.py --mode ooc`` against the f32 host-resident oracle.
+TILE_METRIC_TOL = {"f32": 1e-6, "bf16": 5e-2, "int8": 2e-1}
+
+
+def check_dtype(dtype, kinds: Tuple[str, ...] = TABLE_DTYPES,
+                what: str = "table dtype") -> str:
+    """Validate + normalize a storage-dtype token (None -> ``"f32"``)."""
+    if dtype is None:
+        return "f32"
+    dtype = str(dtype)
+    if dtype not in kinds:
+        raise ValueError(
+            f"unknown {what} {dtype!r}; expected one of {kinds}"
+        )
+    return dtype
+
+
+def parity_tol_for(dtype) -> float:
+    """The serve-time canary/probe parity bound for one table dtype."""
+    return PARITY_TOL[check_dtype(dtype)]
+
+
+def tile_metric_tol_for(dtype) -> float:
+    """The spilled-fit metric parity bound for one tile dtype."""
+    return TILE_METRIC_TOL[check_dtype(dtype, TILE_DTYPES, "tile dtype")]
+
+
+def bf16_dtype():
+    """The numpy-visible bfloat16 dtype (ml_dtypes ships with jax)."""
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+# -- host-side row codecs (the disk tier) -------------------------------------
+
+
+def quantize_int8_rows(arr) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-row absmax int8: ``(q int8 of arr.shape, scale f32 of
+    arr.shape[:-1])``.  The last axis is the "row"; the scale arithmetic
+    runs in float64 so ``absmax/127`` rounds to f32 exactly once (the
+    idempotence lever — see :func:`quantize_int8_canonical`).  Rows whose
+    absmax is 0 store scale 0 and decode exactly 0."""
+    # host-sync: disk-tier codec — pure host numpy by design; the input is
+    # caller-owned host data (tile arrays), never a device buffer.
+    x = np.asarray(arr, np.float32)
+    x64 = x.astype(np.float64)
+    absmax = np.max(np.abs(x64), axis=-1)
+    scale = (absmax / 127.0).astype(np.float32)
+    div = np.where(absmax > 0.0, absmax / 127.0, 1.0)
+    q = np.clip(
+        np.rint(x64 / div[..., None]), -127.0, 127.0
+    ).astype(np.int8)
+    return q, scale
+
+
+def dequantize_int8_rows(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """f32 decode of :func:`quantize_int8_rows` output: ``q * scale``."""
+    # host-sync: disk-tier codec — pure host numpy by design (see above).
+    return np.asarray(q, np.float32) * np.asarray(
+        scale, np.float32
+    )[..., None]
+
+
+def quantize_int8_canonical(
+    arr, max_rounds: int = 4
+) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """``(q, scale, converged)`` at a quantization FIXED POINT: re-encoding
+    the decoded array reproduces the same bytes.  The grid indices ``q``
+    are stable under sub-ulp scale wobble by construction (|q| <= 127, so
+    a <=1-ulp scale perturbation moves ``round(x/scale)`` by ~1e-5 — far
+    from any .5 boundary); only the stored scale can wobble by one ulp
+    through the decode->absmax->scale cycle, and iterating lands it.  A
+    pathological non-converging array (never observed; a tie-to-even
+    oscillation would need ``127*scale`` exactly on a rounding boundary)
+    returns ``converged=False`` and the tile codec stores it lossless."""
+    q, scale = quantize_int8_rows(arr)
+    for _ in range(max_rounds):
+        q2, scale2 = quantize_int8_rows(dequantize_int8_rows(q, scale))
+        if (q2.tobytes() == q.tobytes()
+                and scale2.tobytes() == scale.tobytes()):
+            return q2, scale2, True
+        q, scale = q2, scale2
+    return q, scale, False
+
+
+def encode_bf16(arr) -> np.ndarray:
+    """bf16 storage form of a float array (truncation is idempotent: a
+    bf16->f32->bf16 roundtrip is byte-identical by construction)."""
+    # host-sync: disk-tier codec — pure host numpy by design (see above).
+    return np.asarray(arr, np.float32).astype(bf16_dtype())
+
+
+def decode_bf16(raw: np.ndarray) -> np.ndarray:
+    """f32 decode of :func:`encode_bf16` output (exact widening)."""
+    # host-sync: disk-tier codec — pure host numpy by design (see above).
+    return np.asarray(raw).astype(np.float32)

@@ -71,18 +71,39 @@ def _fixed_margins(w: Array, feats, dense: bool) -> Array:
 
 
 @partial(jax.jit, static_argnames=("dense",))
-def serving_gather_margins(table: Array, safe_idx: Array, feats, dense: bool) -> Array:
+def serving_gather_margins(table, safe_idx: Array, feats, dense: bool) -> Array:
     """Margins via the serving gather convention: ``safe_idx`` is already
     in-table (unknown entities pre-mapped to the trailing all-zero row by the
     caller — :meth:`RandomEffectModel.serving_table`), so the gather itself
     produces the fixed-effect-only fallback with no output mask.  The online
     scoring hot path (photon_tpu.serving) runs this inside its per-bucket
     compiled programs; it is defined HERE so the serving path and the batch
-    ``margins_device`` path share one model layer."""
+    ``margins_device`` path share one model layer.
+
+    ``table`` is the serving STORAGE form (ISSUE 17 precision tiers): an
+    f32 or bf16 ``[capacity, dim]`` array, or an int8 ``(q, scale)`` tuple
+    (per-row absmax scale).  The gather moves the narrow stored bytes —
+    that IS the bandwidth win — and the decode runs on the gathered
+    ``[n, d]`` block; every multiply-accumulate stays f32.  The storage
+    form is part of the traced pytree structure, so each dtype compiles
+    its own bucket program at warmup and never again."""
+    if isinstance(table, tuple):
+        q, scale = table
+        row_scale = scale[safe_idx].astype(jnp.float32)
+        if dense:
+            rows = q[safe_idx].astype(jnp.float32) * row_scale[:, None]
+            return jnp.einsum("nd,nd->n", feats, rows)
+        ids, vals = feats
+        gathered = q[safe_idx[:, None], ids].astype(jnp.float32)
+        return jnp.sum(gathered * row_scale[:, None] * vals, axis=-1)
     if dense:
-        return jnp.einsum("nd,nd->n", feats, table[safe_idx])
+        return jnp.einsum(
+            "nd,nd->n", feats, table[safe_idx].astype(jnp.float32)
+        )
     ids, vals = feats
-    return jnp.sum(table[safe_idx[:, None], ids] * vals, axis=-1)
+    return jnp.sum(
+        table[safe_idx[:, None], ids].astype(jnp.float32) * vals, axis=-1
+    )
 
 
 @partial(jax.jit, static_argnames=("dense",))
@@ -246,7 +267,8 @@ class RandomEffectModel:
         movable zero-row index advances."""
         return pow2_at_least(self.num_entities + 1)
 
-    def serving_table(self, mesh=None, capacity: Optional[int] = None) -> Array:
+    def serving_table(self, mesh=None, capacity: Optional[int] = None,
+                      dtype: Optional[str] = None):
         """Flatten this coordinate's per-entity rows into ONE device-resident
         gather table for the online scoring service: ``[capacity, dim]``
         (default :attr:`serving_capacity` — amortized-doubling headroom),
@@ -265,9 +287,24 @@ class RandomEffectModel:
         hot-swapping a grown model passes its SERVED capacity so the new
         table keeps the compiled programs' shape.  A vocabulary that no
         longer fits is a layout-shape change and is refused loudly — that
-        rebuild boundary is the amortized-doubling contract."""
+        rebuild boundary is the amortized-doubling contract.
+
+        ``dtype`` picks the STORAGE precision tier (ISSUE 17):
+
+        - ``"f32"`` (default) — today's exact table;
+        - ``"bf16"`` — the same shape at half the bytes;
+        - ``"int8"`` — an ``(q int8 [capacity, dim], scale f32 [capacity])``
+          tuple: symmetric per-row absmax quantization, ~4x fewer gather
+          bytes.  Headroom/zero rows have absmax 0, so their stored scale
+          is 0 and the decoded margin is EXACTLY zero — the cold-entity
+          fallback survives quantization bit-for-bit.
+
+        All three forms feed :func:`serving_gather_margins`, which decodes
+        on device after the gather and accumulates in f32."""
+        from photon_tpu.game.lowp import check_dtype
         from photon_tpu.parallel.mesh import reshard_to_mesh
 
+        dtype = check_dtype(dtype)
         rows = self.num_entities + 1
         capacity = self.serving_capacity if capacity is None else int(capacity)
         if rows > capacity:
@@ -284,6 +321,16 @@ class RandomEffectModel:
                           jnp.float32),
             ]
         )
+        if dtype == "bf16":
+            return reshard_to_mesh(table.astype(jnp.bfloat16), mesh)
+        if dtype == "int8":
+            absmax = jnp.max(jnp.abs(table), axis=-1)
+            scale = (absmax / 127.0).astype(jnp.float32)
+            divisor = jnp.where(absmax > 0.0, absmax / 127.0, 1.0)
+            q = jnp.clip(
+                jnp.round(table / divisor[:, None]), -127.0, 127.0
+            ).astype(jnp.int8)
+            return (reshard_to_mesh(q, mesh), reshard_to_mesh(scale, mesh))
         return reshard_to_mesh(table, mesh)
 
 

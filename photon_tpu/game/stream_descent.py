@@ -1201,6 +1201,15 @@ class StreamedCoordinateDescent:
                         telemetry.gauge(
                             "descent.validation_metric", metric=k
                         ).set(v)
+            # Sweep-end write-back flush: every tile this iteration's C
+            # coordinate updates dirtied publishes ONCE (the ISSUE 17
+            # batching — the PR 11 write-through design republished each
+            # full tile C times per sweep).  Runs before the end-of-
+            # iteration checkpoint so its digests describe on-disk tiles
+            # a resume can adopt directly.
+            if hasattr(residuals, "flush"):
+                with telemetry.span("tiles.writeback_flush", iteration=it):
+                    residuals.flush()
             telemetry.counter("descent.iterations").inc()
             # The chunk-budget residency gauge: the streamer's measured
             # in-flight peak IS the device footprint of the streamed score

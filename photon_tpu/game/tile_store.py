@@ -42,6 +42,26 @@ high-order bytes group into runs, and zlib-deflated; an encoding that
 fails to shrink falls back to raw per array.  Either way the roundtrip is
 bit-exact — spilled and host-resident streamed runs produce identical
 tiles, which the tests pin with ``np.array_equal``.
+
+ISSUE 17 adds per-array LOSSY storage codecs next to the lossless
+encodings: ``bf16`` (truncate f32 payloads to bfloat16) and ``int8``
+(symmetric per-row absmax quantization — an f32 scale row rides beside
+the int8 grid; see :mod:`photon_tpu.game.lowp`).  Three contracts keep
+the lossy tiers as kill-safe as the exact one:
+
+- **digests cover the ENCODED payload** (pre-zlib bytes — the bf16
+  stream, or scale row + int8 grid), so a flipped bit in a scale row is
+  caught BEFORE a decode could silently rescale a whole row
+  (:class:`CorruptTileError`), and verify cost shrinks with the payload;
+- **encoding is idempotent**: both codecs re-encode their own decode to
+  identical bytes (bf16 by construction, int8 via
+  :func:`~photon_tpu.game.lowp.quantize_int8_canonical`'s fixed point),
+  so the write-through read-modify-write cycle never drifts and a
+  kill→resume digest compare is exact per codec;
+- **lossless fallback**: arrays a lossy codec cannot represent
+  faithfully (non-f32, NaN/infinity payloads, a non-convergent int8
+  quantization) are stored exact under the ``f32`` codec — per array,
+  recorded in the header, transparent at read.
 """
 
 from __future__ import annotations
@@ -111,8 +131,93 @@ def compress_enabled(override: Optional[bool] = None) -> bool:
 
 
 # ---------------------------------------------------------------------------
-# Array codec: raw | dsz (delta + byte-shuffle + zlib), bit-exact roundtrip
+# Array codec: raw | dsz (delta + byte-shuffle + zlib), bit-exact roundtrip,
+# plus the lossy bf16 / int8+scale storage codecs layered above (ISSUE 17)
 # ---------------------------------------------------------------------------
+
+LOSSY_CODECS = ("bf16", "int8")
+
+
+def _lossy_payload(arr: np.ndarray, codec: str) -> Optional[bytes]:
+    """Encoded-domain payload of one array under a lossy codec, or
+    ``None`` when the array must fall back to lossless storage: non-f32
+    or 0-d arrays, NaN/infinity payloads (neither codec represents them
+    — bf16 keeps NaN but absmax quantization cannot, and the fallback
+    keeps the two codecs' contracts identical), or an int8 quantization
+    that failed to reach its re-encode fixed point (never observed; the
+    guard exists so idempotence is a checked property, not a hope)."""
+    if arr.dtype != np.float32 or arr.ndim == 0 or arr.size == 0:
+        return None
+    if not np.isfinite(arr).all():
+        return None
+    if codec == "bf16":
+        from photon_tpu.game.lowp import encode_bf16
+
+        return np.ascontiguousarray(encode_bf16(arr)).tobytes()
+    if codec == "int8":
+        from photon_tpu.game.lowp import quantize_int8_canonical
+
+        q, scale, converged = quantize_int8_canonical(arr)
+        if not converged:
+            return None
+        # Scale row first: the decoder's split point is computable from
+        # the header shape alone (float32 scale of shape[:-1], then the
+        # int8 grid of the full shape).
+        return np.ascontiguousarray(scale).tobytes() + q.tobytes()
+    raise ValueError(f"unknown lossy codec {codec!r}")
+
+
+def _lossy_decode(
+    raw: bytes, codec: str, dtype: np.dtype, shape: tuple
+) -> np.ndarray:
+    """f32 decode of a lossy payload.  Size/shape disagreements are
+    corruption (same contract as a digest mismatch)."""
+    if np.dtype(dtype) != np.float32:
+        raise CorruptTileError(
+            f"lossy codec {codec!r} on non-f32 dtype {dtype!r}"
+        )
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if codec == "bf16":
+        from photon_tpu.game.lowp import bf16_dtype, decode_bf16
+
+        if len(raw) != 2 * n:
+            raise CorruptTileError(
+                f"bf16 payload is {len(raw)} bytes, want {2 * n}"
+            )
+        return decode_bf16(
+            np.frombuffer(raw, dtype=bf16_dtype()).reshape(shape)
+        )
+    if codec == "int8":
+        from photon_tpu.game.lowp import dequantize_int8_rows
+
+        scale_shape = tuple(shape[:-1])
+        scale_n = int(np.prod(scale_shape, dtype=np.int64)) if scale_shape else 1
+        if len(raw) != 4 * scale_n + n:
+            raise CorruptTileError(
+                f"int8 payload is {len(raw)} bytes, want {4 * scale_n + n}"
+            )
+        scale = np.frombuffer(
+            raw[: 4 * scale_n], np.float32
+        ).reshape(scale_shape)
+        q = np.frombuffer(raw[4 * scale_n:], np.int8).reshape(shape)
+        # dequantize allocates fresh f32 output — writable, like every
+        # other decode path (cached tiles are mutated in place).
+        return dequantize_int8_rows(q, scale)
+    raise CorruptTileError(f"unknown array codec {codec!r}")
+
+
+def codec_roundtrip(arr: np.ndarray, codec: Optional[str]) -> np.ndarray:
+    """``arr`` as it will decode back from disk under ``codec`` — what the
+    write-through publish path rounds a tile through BEFORE deriving
+    partials, digests, and the cached copy, so memory and disk agree bit
+    for bit (including when the codec falls back to lossless)."""
+    arr = np.ascontiguousarray(arr)
+    if codec in (None, "f32"):
+        return arr
+    payload = _lossy_payload(arr, codec)
+    if payload is None:
+        return arr  # lossless fallback: disk stores the exact bytes
+    return _lossy_decode(payload, codec, arr.dtype, arr.shape)
 
 
 def _encode(arr: np.ndarray, compress: bool) -> Tuple[bytes, str]:
@@ -172,29 +277,51 @@ def _pack(
     meta: dict,
     compress: bool,
     digests: Optional[Dict[str, str]] = None,
+    codecs: Optional[Dict[str, str]] = None,
 ) -> bytes:
     """``digests`` lets a caller that already hashed an array's raw bytes
     (sha256 of ``arr.tobytes()``) pass the hex digest in instead of
     paying a second tile-sized hash here — the write-through publish path
-    hashes every tile for its checkpoint digest anyway."""
+    hashes every tile for its checkpoint digest anyway.  ``codecs`` maps
+    array names to a lossy storage codec (``bf16``/``int8``); lossy
+    entries hash the ENCODED payload instead (the header's ``codec``
+    field doubles as the digest-domain marker) and ignore caller
+    digests, which are raw-domain by contract."""
     entries = []
     payloads = []
     offset = 0
     digests = digests or {}
+    codecs = codecs or {}
     for name, arr in arrays.items():
         arr = np.ascontiguousarray(arr)
-        buf, encoding = _encode(arr, compress)
+        codec = codecs.get(name) or "f32"
+        payload = _lossy_payload(arr, codec) if codec != "f32" else None
+        if payload is None:
+            codec = "f32"  # lossless (or fell back to it)
+            buf, encoding = _encode(arr, compress)
+            sha = (
+                digests.get(name)
+                or hashlib.sha256(arr.tobytes()).hexdigest()
+            )
+        else:
+            sha = hashlib.sha256(payload).hexdigest()
+            buf, encoding = payload, "raw"
+            if compress:
+                # Lossy payloads skip the delta/shuffle stage (a mixed
+                # scale+grid byte stream has no single item width) —
+                # plain zlib or nothing.
+                packed = zlib.compress(payload, 1)
+                if len(packed) < len(payload):
+                    buf, encoding = packed, "z"
         entries.append({
             "name": name,
             "dtype": _dtype_token(arr.dtype),
             "shape": list(arr.shape),
             "encoding": encoding,
+            "codec": codec,
             "offset": offset,
             "nbytes": len(buf),
-            "sha256": (
-                digests.get(name)
-                or hashlib.sha256(arr.tobytes()).hexdigest()
-            ),
+            "sha256": sha,
         })
         payloads.append(buf)
         offset += len(buf)
@@ -243,6 +370,39 @@ def _unpack(
                 raise CorruptTileError(
                     f"{path}: truncated payload for {entry['name']!r}"
                 )
+            codec = entry.get("codec", "f32")
+            if codec != "f32":
+                # Lossy entry: unwrap optional zlib, verify the digest
+                # over the ENCODED payload BEFORE decoding — a corrupt
+                # scale row is refused before it could rescale anything.
+                try:
+                    raw = (
+                        zlib.decompress(buf)
+                        if entry["encoding"] == "z" else buf
+                    )
+                    if entry["encoding"] not in ("raw", "z"):
+                        raise ValueError(
+                            f"encoding {entry['encoding']!r} invalid "
+                            f"for codec {codec!r}"
+                        )
+                except (zlib.error, ValueError) as e:
+                    raise CorruptTileError(
+                        f"{path}: undecodable payload for "
+                        f"{entry['name']!r} ({e}); on-disk tile corrupted"
+                    ) from None
+                if verify:
+                    digest = hashlib.sha256(raw).hexdigest()
+                    if digest != entry["sha256"]:
+                        raise CorruptTileError(
+                            f"{path}: content digest mismatch in "
+                            f"{entry['name']!r} ({codec} payload — e.g. "
+                            "a corrupt scale row); refusing the read"
+                        )
+                arrays[entry["name"]] = _lossy_decode(
+                    raw, codec, _resolve_dtype(entry["dtype"]),
+                    tuple(entry["shape"]),
+                )
+                continue
             try:
                 arr = _decode(
                     buf, _resolve_dtype(entry["dtype"]),
@@ -282,11 +442,21 @@ class TileStore:
     descent thread).
     """
 
-    def __init__(self, root: str, telemetry=None, compress: Optional[bool] = None):
+    def __init__(
+        self, root: str, telemetry=None, compress: Optional[bool] = None,
+        tile_dtype: Optional[str] = None,
+    ):
+        from photon_tpu.game.lowp import TILE_DTYPES, check_dtype
+
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.telemetry = telemetry or NULL_SESSION
         self.compress = compress_enabled(compress)
+        # The store's default storage codec for lossy-eligible arrays
+        # (feature blocks, score tiles).  Callers choose WHICH arrays are
+        # eligible per write (indices, labels, and partials always stay
+        # exact); the store only carries the tier choice.
+        self.tile_dtype = check_dtype(tile_dtype, TILE_DTYPES, "tile dtype")
         self._lock = threading.Lock()
         self._file_bytes: Dict[str, int] = {}
         for name in os.listdir(self.root):
@@ -322,24 +492,35 @@ class TileStore:
     def _publish_bytes_gauge(self) -> None:
         self.telemetry.gauge("tiles.disk_bytes").set(self.disk_bytes)
 
+    def lossy_codecs(self, names) -> Dict[str, str]:
+        """Per-array ``codecs`` dict applying the store's tier to
+        ``names`` (empty at f32 — the exact tier's writes are unchanged
+        byte for byte)."""
+        if self.tile_dtype == "f32":
+            return {}
+        return {str(name): self.tile_dtype for name in names}
+
     # -- guarded IO -----------------------------------------------------------
     def write(
         self, kind: str, k: int, arrays: Dict[str, np.ndarray],
         meta: Optional[dict] = None,
         digests: Optional[Dict[str, str]] = None,
+        codecs: Optional[Dict[str, str]] = None,
     ) -> None:
         """Publish one part file atomically (temp + fsync + rename).  The
         whole attempt — serialize, write, publish — retries as a unit
         under the ``tile:write`` site, so an injected/transient failure
         anywhere in the sequence costs backoff, not the run.  ``digests``
-        forwards caller-precomputed raw-byte sha256 hexes to the header
-        (see :func:`_pack`)."""
+        forwards caller-precomputed raw-byte sha256 hexes to the header;
+        ``codecs`` maps array names to a lossy storage codec (see
+        :func:`_pack`)."""
         from photon_tpu.fault.atomic import atomic_write_bytes
         from photon_tpu.fault.injection import fault_point
         from photon_tpu.fault.retry import retry_call
 
         final = self.path(kind, k)
-        blob = _pack(arrays, meta, self.compress, digests=digests)
+        blob = _pack(arrays, meta, self.compress, digests=digests,
+                     codecs=codecs)
 
         def attempt():
             fault_point("tile:write", kind=kind, chunk=k)

@@ -53,6 +53,7 @@ import numpy as np
 from photon_tpu.game.tile_store import (
     FEATURES as FEAT_KIND,
     TILES as TILE_KIND,
+    codec_roundtrip,
 )
 from photon_tpu.telemetry import NULL_SESSION
 
@@ -357,6 +358,7 @@ class HostTileCache:
         self._entries: "OrderedDict[tuple, _CacheEntry]" = OrderedDict()
         self._inflight: Dict[tuple, Future] = {}
         self._bytes = 0
+        self._evict_listeners: List[Callable[[tuple, object], None]] = []
         self._hits = self.telemetry.counter("tiles.cache_hits")
         self._misses = self.telemetry.counter("tiles.cache_misses")
         self._evictions = self.telemetry.counter("tiles.cache_evictions")
@@ -367,36 +369,58 @@ class HostTileCache:
         with self._lock:
             return self._bytes
 
-    def _evict_locked(self) -> None:
+    def add_evict_listener(
+        self, fn: Callable[[tuple, object], None]
+    ) -> None:
+        """Register ``fn(key, value)`` to run after an LRU EVICTION
+        (outside the cache lock — ``fn`` may do IO or re-enter the
+        cache).  Deliberate drops (:meth:`invalidate`, :meth:`clear`)
+        do NOT notify: the reset paths discard state on purpose, and a
+        write-back hook firing there would resurrect it.  The spilled
+        score table uses this to flush a still-dirty tile whose cached
+        copy is being displaced (write-back, not write-through)."""
+        self._evict_listeners.append(fn)
+
+    def _notify_evicted(self, evicted) -> None:
+        for key, entry in evicted:
+            for fn in self._evict_listeners:
+                fn(key, entry.value)
+
+    def _evict_locked(self) -> list:
         # The entry just inserted sits at the MRU end, so the `> 1` bound
         # both protects it and implements the oversized-entry allowance
         # (a lone entry larger than the budget stays until the next
-        # insert displaces it).
+        # insert displaces it).  Returns the evicted (key, entry) pairs —
+        # the caller notifies listeners AFTER releasing the lock.
+        evicted = []
         while (
             self.max_bytes is not None
             and self._bytes > self.max_bytes
             and len(self._entries) > 1
         ):
-            _, entry = self._entries.popitem(last=False)
+            key, entry = self._entries.popitem(last=False)
             self._bytes -= entry.nbytes
             self._evictions.inc()
+            evicted.append((key, entry))
         self._gauge.set(self._bytes)
+        return evicted
 
-    def _insert_locked(self, key: tuple, entry: _CacheEntry) -> None:
+    def _insert_locked(self, key: tuple, entry: _CacheEntry) -> list:
         old = self._entries.pop(key, None)
         if old is not None:
             self._bytes -= old.nbytes
         self._entries[key] = entry
         self._bytes += entry.nbytes
-        self._evict_locked()
+        return self._evict_locked()
 
     def put(self, key: tuple, value) -> None:
         """Insert/replace (write-through warm path: the tile just written
         to the store is the hottest possible entry)."""
         with self._lock:
-            self._insert_locked(
+            evicted = self._insert_locked(
                 key, _CacheEntry(value, _entry_nbytes(value), 0.0, True)
             )
+        self._notify_evicted(evicted)
 
     def invalidate(self, key: tuple) -> None:
         with self._lock:
@@ -425,13 +449,14 @@ class HostTileCache:
             fut.set_exception(e)
             raise
         with self._lock:
-            self._insert_locked(
+            evicted = self._insert_locked(
                 key,
                 _CacheEntry(value, _entry_nbytes(value), load_s, consumed),
             )
             self._inflight.pop(key, None)
         self._misses.inc()
         fut.set_result(value)
+        self._notify_evicted(evicted)
         return value, load_s
 
     def get(self, key: tuple, loader: Callable[[], object]):
@@ -529,12 +554,17 @@ def _shard_schema(data) -> dict:
     return out
 
 
-def dataset_fingerprint(data, chunk_rows: int) -> dict:
-    """Cheap identity of (dataset, chunk plan) for spill-dir reuse: shape,
-    schema, and a content hash of the per-row scalar columns (one pass
-    over 12·n bytes — features are not re-hashed; a dataset that changes
-    features while keeping labels/weights/offsets bit-identical is out of
-    scope and documented)."""
+def dataset_fingerprint(
+    data, chunk_rows: int, tile_dtype: str = "f32"
+) -> dict:
+    """Cheap identity of (dataset, chunk plan, storage codec) for
+    spill-dir reuse: shape, schema, a content hash of the per-row scalar
+    columns (one pass over 12·n bytes — features are not re-hashed; a
+    dataset that changes features while keeping labels/weights/offsets
+    bit-identical is out of scope and documented), and the store's
+    ``tile_dtype`` — changing the precision tier MUST invalidate the
+    spilled feature blocks, or a bf16 run would silently train on a
+    previous run's f32 chunks (or vice versa)."""
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(data.label, np.float32).tobytes())
     h.update(np.ascontiguousarray(data.weight, np.float32).tobytes())
@@ -544,6 +574,7 @@ def dataset_fingerprint(data, chunk_rows: int) -> dict:
         "chunk_rows": int(chunk_rows),
         "shards": _shard_schema(data),
         "scalar_sha256": h.hexdigest(),
+        "tile_dtype": str(tile_dtype),
     }
 
 
@@ -555,7 +586,8 @@ def spill_dataset(store, data, plan: ChunkPlan, telemetry=None) -> int:
     from photon_tpu.game.data import DenseShard
 
     tel = telemetry or NULL_SESSION
-    fp = dataset_fingerprint(data, plan.chunk_rows)
+    tile_dtype = getattr(store, "tile_dtype", "f32")
+    fp = dataset_fingerprint(data, plan.chunk_rows, tile_dtype)
     if store.read_dataset_meta() != fp:
         # Foreign/stale spill dir: drop everything, re-publish identity
         # LAST (a kill mid-spill leaves no matching dataset.json, so the
@@ -572,16 +604,23 @@ def spill_dataset(store, data, plan: ChunkPlan, telemetry=None) -> int:
                 "offset": data.offset[lo:hi],
                 "weight": data.weight[lo:hi],
             }
+            # Only feature VALUES take the lossy tier: sparse column ids
+            # are indices and the per-row scalars feed the objective (and
+            # the fingerprint hash) directly — both stay exact.
+            lossy = []
             for name, shard in data.shards.items():
                 if isinstance(shard, DenseShard):
                     arrays[f"s:{name}:x"] = shard.x[lo:hi]
+                    lossy.append(f"s:{name}:x")
                 else:
                     arrays[f"s:{name}:ids"] = shard.ids[lo:hi]
                     arrays[f"s:{name}:vals"] = shard.vals[lo:hi]
+                    lossy.append(f"s:{name}:vals")
             store.write(
                 FEAT_KIND, k, arrays,
                 meta={"chunk": k, "rows": hi - lo,
                       "shards": _shard_schema(data)},
+                codecs=store.lossy_codecs(lossy),
             )
             written += 1
     if store.read_dataset_meta() != fp:
@@ -971,15 +1010,34 @@ class TiledValidationTable(TiledScoreTable):
 
 class SpilledScoreTable(TiledScoreTable):
     """Score tiles resident at the DISK tier (ISSUE 11): every read goes
-    through the LRU host cache, every publish writes through to the
-    :class:`~photon_tpu.game.tile_store.TileStore` part file (atomic
-    rename — a torn write-back keeps the previous tile), so the host
-    working set of the score plane is the cache budget, not ``C × n``.
+    through the LRU host cache, every publish lands in a WRITE-BACK set
+    that flushes to the :class:`~photon_tpu.game.tile_store.TileStore`
+    part file once per descent sweep (atomic rename — a torn write-back
+    keeps the previous tile), so the host working set of the score plane
+    is the cache budget, not ``C × n``.
 
-    Numerics are IDENTICAL to the host-resident tiled table: the store
-    roundtrip is bit-exact and the partials are recomputed by the same
-    ``_neumaier_rows_np`` on the same tile bytes — spilled vs resident
-    streamed runs produce ``np.array_equal`` tiles (pinned by tests).
+    Write-back batching (ISSUE 17 / the ROADMAP tiering edge): a sweep
+    updates every coordinate's row of every tile, and the PR 11 write-
+    THROUGH design republished each full ``[C, rows_k]`` tile C times per
+    sweep — a C-fold disk amplification.  ``_publish_tile`` now only
+    refreshes the in-memory state (partials, digest, cache) and marks
+    the tile dirty; :meth:`flush` — called by the descent once per outer
+    iteration and before every checkpoint — publishes each dirty tile
+    ONCE.  Two hooks keep the old guarantees: an LRU evict listener
+    flushes a still-dirty tile whose cached copy is being displaced (the
+    dirty set never pins more than the cache budget), and kill-safety
+    falls back to the existing resume ladder — a kill between sweeps
+    finds disk == checkpoint digests (fast adopt), a kill mid-sweep
+    finds a digest mismatch and rebuilds deterministically from the
+    checkpointed models (exactly the torn-write-back path PR 11 pinned).
+
+    Numerics per codec: at the exact tier the store roundtrip is
+    bit-exact and spilled vs resident streamed runs produce
+    ``np.array_equal`` tiles (pinned by tests).  At a lossy tier
+    (``TileStore(tile_dtype="bf16"|"int8")``) every publish rounds the
+    tile through the storage codec FIRST — partials, digests, and the
+    cached copy all describe the decoded-from-disk bytes, so memory and
+    disk agree bit for bit and kill→resume parity stays exact per codec.
 
     Checkpoint contract: :meth:`snapshot_rows` returns ``{}`` — the
     on-disk tiles are REFERENCED by the checkpoint's per-chunk digests,
@@ -1001,7 +1059,15 @@ class SpilledScoreTable(TiledScoreTable):
     ):
         self._store = store
         self._cache = cache
+        self._dirty_lock = threading.Lock()
+        # k -> (tile, totals, comps, full_sha): everything one store
+        # publish needs, captured at _publish_tile time.  Tuples are
+        # immutable snapshots — a racing evict-flush and an iteration
+        # flush of the same chunk write identical bytes.
+        self._dirty: Dict[int, tuple] = {}
+        self._publishes_since_flush = 0
         super().__init__(base_offset, names, plan, telemetry=telemetry)
+        cache.add_evict_listener(self._on_cache_evict)
         self.telemetry.gauge(f"{self._PATH}.tiles_spilled").set(1)
 
     # -- residency hooks ------------------------------------------------------
@@ -1025,6 +1091,13 @@ class SpilledScoreTable(TiledScoreTable):
 
     def tile(self, k: int) -> np.ndarray:
         def load():
+            # Dirty-first: a dirty tile evicted from the cache may not
+            # have reached disk yet (its evict-flush could still be in
+            # flight) — the write-back set is the authoritative copy.
+            with self._dirty_lock:
+                entry = self._dirty.get(k)
+            if entry is not None:
+                return entry[0]
             if not self._store.has(self._tile_kind, k):
                 return self._zero_tile(k)
             arrays, _ = self._store.read(self._tile_kind, k)
@@ -1034,24 +1107,76 @@ class SpilledScoreTable(TiledScoreTable):
         return tile
 
     def _publish_tile(self, k: int, tile: np.ndarray) -> None:
+        # Storage-codec roundtrip FIRST (identity at the exact tier):
+        # partials, digest, and the cached copy must describe the bytes
+        # a reader will decode from disk, not pre-quantization values.
+        tile = codec_roundtrip(tile, self._store.tile_dtype)
         totals, comps = _neumaier_rows_np(tile)
         self.totals[k], self.comps[k] = totals, comps
         # One hash serves both contracts: the full sha256 goes to the
         # part-file header (via ``digests=``, saving _pack re-hashing the
-        # tile bytes) and its 16-char prefix is the checkpoint digest.
+        # tile bytes at the exact tier) and its 16-char prefix is the
+        # checkpoint digest — always over the roundtripped f32 bytes,
+        # the same domain the resume path hashes a decoded tile in.
         full = hashlib.sha256(tile.tobytes()).hexdigest()
-        digest = full[:16]
-        # Write-through: the store is always current, so an LRU eviction
-        # never loses state and a kill at any instant leaves every chunk's
-        # PREVIOUS complete tile readable (atomic publish).
+        self._digests[k] = full[:16]
+        # Write-BACK: mark dirty (coalescing this sweep's remaining
+        # coordinate updates of the same tile), keep the cache hot.  The
+        # store is refreshed by flush() / the evict listener.
+        with self._dirty_lock:
+            self._dirty[k] = (tile, totals, comps, full)
+            self._publishes_since_flush += 1
+        self._cache.put(self._key(k), tile)
+
+    def _write_entry(self, k: int, entry: tuple) -> None:
+        tile, totals, comps, full = entry
         self._store.write(
             self._tile_kind, k,
             {"tile": tile, "total": totals, "comp": comps},
-            meta={"chunk": k, "path": self._PATH, "tile_digest": digest},
+            meta={"chunk": k, "path": self._PATH,
+                  "tile_digest": full[:16]},
             digests={"tile": full},
+            codecs=self._store.lossy_codecs(("tile",)),
         )
-        self._digests[k] = digest
-        self._cache.put(self._key(k), tile)
+        # Pop AFTER the publish succeeds (identity compare: a newer
+        # publish of the same chunk must stay dirty).
+        with self._dirty_lock:
+            if self._dirty.get(k) is entry:
+                del self._dirty[k]
+
+    def _on_cache_evict(self, key: tuple, value) -> None:
+        if key[:2] != (TILE_KIND, self._PATH):
+            return
+        k = key[2]
+        with self._dirty_lock:
+            entry = self._dirty.get(k)
+        if entry is None:
+            return
+        self._write_entry(k, entry)
+        self.telemetry.counter(
+            "tiles.writeback_evict_flushes", path=self._PATH
+        ).inc()
+
+    def flush(self) -> int:
+        """Publish every dirty tile to the store — ONE atomic write per
+        touched tile per sweep, however many coordinate rows changed.
+        The descent calls this at the end of each outer iteration and
+        before every checkpoint (the checkpoint's digests must describe
+        tiles a resume can actually read)."""
+        with self._dirty_lock:
+            pending = dict(self._dirty)
+            publishes = self._publishes_since_flush
+            self._publishes_since_flush = 0
+        for k in sorted(pending):
+            self._write_entry(k, pending[k])
+        if pending:
+            self.telemetry.counter(
+                "tiles.writeback_flushes", path=self._PATH
+            ).inc()
+            self.telemetry.counter(
+                "tiles.writeback_coalesced", path=self._PATH
+            ).inc(max(0, publishes - len(pending)))
+        return len(pending)
 
     # -- digest / checkpoint contract ----------------------------------------
     def tile_digest(self, k: int) -> str:
@@ -1073,6 +1198,9 @@ class SpilledScoreTable(TiledScoreTable):
     def reset_store(self) -> None:
         """Fresh (non-resume) runs must not read a previous run's
         published tiles as their zero state."""
+        with self._dirty_lock:
+            self._dirty.clear()
+            self._publishes_since_flush = 0
         self._store.reset_tiles(self.num_chunks, kind=self._tile_kind)
         for k in range(self.num_chunks):
             self._cache.invalidate(self._key(k))

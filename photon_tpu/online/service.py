@@ -66,7 +66,10 @@ class RefreshPolicy:
     ``lock_untouched`` — lock coordinates the drained batches do not touch
     (False retrains everything every refresh).
     ``max_quarantined`` — the descent quarantine budget per refresh.
-    ``rollout_parity_tol`` — the canary parity gate of each publish.
+    ``rollout_parity_tol`` — the canary parity gate of each publish;
+    ``None`` (default) derives the gate from the fleet's serving table
+    dtype (``lowp.parity_tol_for`` — f32 keeps the historical 1e-3, a
+    bf16/int8 fleet gates at its measured codec bound).
     ``poll_interval_s`` — the background loop's cadence.
     """
 
@@ -74,7 +77,7 @@ class RefreshPolicy:
     min_rows: int = 1
     lock_untouched: bool = True
     max_quarantined: Optional[int] = 8
-    rollout_parity_tol: float = 1e-3
+    rollout_parity_tol: Optional[float] = None
     poll_interval_s: float = 0.2
 
 
@@ -390,6 +393,16 @@ class OnlineLearningService:
         is the router's mirror of recently admitted live requests; a cold
         fleet (no traffic yet) probes with the supervisor's synthetic
         known-answer request instead."""
+        parity_tol = self.policy.rollout_parity_tol
+        if parity_tol is None:
+            # Per-dtype gate: refresh preserves the fleet's storage tier
+            # (the scorers re-encode the published f32 model at their own
+            # dtype), so the publish gate is that tier's measured bound.
+            from photon_tpu.game.lowp import parity_tol_for
+
+            parity_tol = parity_tol_for(
+                getattr(self.fleet, "table_dtype", "f32")
+            )
         probes = None
         if not self.fleet.router.recent_requests():
             from photon_tpu.serving.supervisor import probe_request_for
@@ -407,8 +420,7 @@ class OnlineLearningService:
         observer = getattr(self.fleet, "observer", None)
         if observer is None:
             self.fleet.rollout(
-                model, probe_requests=probes,
-                parity_tol=self.policy.rollout_parity_tol,
+                model, probe_requests=probes, parity_tol=parity_tol,
             )
             return
         # Traced publish: refresh -> canary -> swap becomes ONE linked
@@ -431,8 +443,7 @@ class OnlineLearningService:
         try:
             with activate_trace(span.context()):
                 self.fleet.rollout(
-                    model, probe_requests=probes,
-                    parity_tol=self.policy.rollout_parity_tol,
+                    model, probe_requests=probes, parity_tol=parity_tol,
                 )
             span.finish()
         except BaseException:

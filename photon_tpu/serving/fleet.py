@@ -104,7 +104,9 @@ class ServingFleet:
         child_env: Optional[Dict[str, str]] = None,
         spawn_timeout_s: float = 120.0,
         table_capacity_factor: int = 1,
+        table_dtype: str = "f32",
     ):
+        from photon_tpu.game.lowp import check_dtype
         from photon_tpu.telemetry import NULL_SESSION
 
         if replicas < 1:
@@ -114,6 +116,10 @@ class ServingFleet:
                              "(thread | subprocess)")
         self.model = model
         self.backend = backend
+        # Fleet-wide gather-table storage tier (ISSUE 17): every replica
+        # serves the same dtype, and the canary/probe parity gates default
+        # to the tier's measured bound (lowp.parity_tol_for).
+        self.table_dtype = check_dtype(table_dtype)
         self.telemetry = telemetry or NULL_SESSION
         self._model_lock = threading.Lock()
         # Serializes whole PUBLISH operations (rollout, fleet rollback):
@@ -155,6 +161,7 @@ class ServingFleet:
                             telemetry=self.telemetry,
                             child_env=env, spawn_timeout_s=spawn_timeout_s,
                             table_capacity_factor=table_capacity_factor,
+                            table_dtype=self.table_dtype,
                         )
                     )
             except BaseException:
@@ -183,6 +190,7 @@ class ServingFleet:
                     min_bucket=min_bucket,
                     telemetry=self.telemetry,
                     table_capacity_factor=table_capacity_factor,
+                    table_dtype=self.table_dtype,
                 )
                 self.replicas.append(
                     ScorerReplica(
@@ -262,7 +270,17 @@ class ServingFleet:
 
         Whole publishes serialize on ``_publish_lock``: a rollout and the
         supervisor's fleet rollback interleaving their per-replica swaps
-        would split the fleet across models."""
+        would split the fleet across models.
+
+        The canary parity gate defaults to the fleet's TABLE-DTYPE bound
+        (``lowp.parity_tol_for`` — f32 keeps the exact-path 1e-3; bf16/
+        int8 gate at their measured codec bounds): a lossy fleet probed at
+        the f32 tolerance would fail every healthy rollout.  An explicit
+        ``parity_tol`` kwarg still wins."""
+        if "parity_tol" not in kwargs:
+            from photon_tpu.game.lowp import parity_tol_for
+
+            kwargs["parity_tol"] = parity_tol_for(self.table_dtype)
         with self._publish_lock:
             with self._model_lock:
                 previous_model = self.model
@@ -362,11 +380,24 @@ class ServingFleet:
         resurrection, flap quarantine); returns the
         :class:`~photon_tpu.serving.supervisor.ReplicaSupervisor`.  With
         ``start=False`` the supervisor is built but not threaded — tests
-        drive ``check_once()`` deterministically."""
-        from photon_tpu.serving.supervisor import ReplicaSupervisor
+        drive ``check_once()`` deterministically.
+
+        Without an explicit policy, the known-answer/rejoin parity gates
+        default to the fleet's table-dtype bound (a lossy fleet probed at
+        the f32 tolerance would declare every healthy replica dead)."""
+        from photon_tpu.serving.supervisor import (
+            ReplicaSupervisor,
+            SupervisorPolicy,
+        )
 
         if self._supervisor is not None:
             raise RuntimeError("fleet already supervised")
+        if policy is None and self.table_dtype != "f32":
+            from photon_tpu.game.lowp import parity_tol_for
+
+            policy = SupervisorPolicy(
+                parity_tol=parity_tol_for(self.table_dtype)
+            )
         self._supervisor = ReplicaSupervisor(
             self, policy=policy, telemetry=self.telemetry, logger=logger
         )

@@ -489,6 +489,7 @@ def _child_main(argv=None) -> None:
         min_bucket=int(cfg["min_bucket"]),
         telemetry=session,
         table_capacity_factor=int(cfg.get("table_capacity_factor", 1)),
+        table_dtype=cfg.get("table_dtype", "f32"),
     ).warmup()
     service = _ChildService(cfg["replica_id"], scorer, version,
                             telemetry=session,
@@ -564,12 +565,16 @@ class _RemoteScorer:
                  store: ModelStore, request_spec: Dict[str, ShardSpec],
                  buckets, max_batch: int, min_bucket: int,
                  port: int, compilations: int, telemetry=None,
-                 timeout_s: float = 300.0, span_sink=None):
+                 timeout_s: float = 300.0, span_sink=None,
+                 table_dtype: str = "f32"):
         from photon_tpu.telemetry import NULL_SESSION
 
         self.replica_id = replica_id
         self.model = model
         self.version = version
+        # Mirrors the child scorer's storage tier so parent-side parity
+        # gates (router canary histogram, fleet defaults) see one surface.
+        self.table_dtype = str(table_dtype)
         # Observability: completed child spans piggybacked on response
         # headers (or pulled via the ``spans`` control frame) go here; the
         # last shipped histogram snapshot is what the observer aggregates.
@@ -747,12 +752,14 @@ class SubprocessReplica(ScorerReplica):
         child_env: Optional[Dict[str, str]] = None,
         spawn_timeout_s: float = 120.0,
         table_capacity_factor: int = 1,
+        table_dtype: str = "f32",
     ):
         self._store = store
         self._request_spec = dict(request_spec)
         self._buckets = buckets
         self._min_bucket = min_bucket
         self._table_capacity_factor = int(table_capacity_factor)
+        self._table_dtype = str(table_dtype)
         self._spawn_timeout_s = float(spawn_timeout_s)
         self.child_env = dict(child_env or {})
         self._proc: Optional[subprocess.Popen] = None
@@ -793,6 +800,7 @@ class SubprocessReplica(ScorerReplica):
             "max_batch": self._cfg_max_batch,
             "min_bucket": self._min_bucket,
             "table_capacity_factor": self._table_capacity_factor,
+            "table_dtype": self._table_dtype,
             "flight_path": self.flight_path,
         }
         env = dict(os.environ)
@@ -843,6 +851,7 @@ class SubprocessReplica(ScorerReplica):
             self._min_bucket, port=int(ready["port"]),
             compilations=int(ready.get("compilations", 0)),
             telemetry=telemetry, span_sink=self._deliver_spans,
+            table_dtype=self._table_dtype,
         )
 
     def _deliver_spans(self, spans: list) -> None:

@@ -835,11 +835,20 @@ class FleetRouter:
             self._mark_rollout(canary.replica_id, "canary")
             old_model = canary.scorer.model
             canary.scorer.swap_model(model)
+            # Per-codec parity histogram (ISSUE 17): every canary probe's
+            # worst |delta| lands labeled with the served storage tier, so
+            # the measured bound per dtype is an observable distribution,
+            # not just a pass/fail gate.
+            dtype = getattr(canary.scorer, "table_dtype", "f32")
+            parity_hist = self.telemetry.histogram(
+                "serving.rollout_parity", dtype=dtype
+            )
             try:
                 futs = [canary.submit(req) for req in probes]
                 for req, fut in zip(probes, futs):
                     got = fut.result(timeout=probe_timeout_s)
                     worst = parity_worst(got, oracle(req))
+                    parity_hist.observe(worst)
                     if worst > parity_tol:
                         raise RolloutParityError(
                             f"canary {canary.replica_id} parity probe "

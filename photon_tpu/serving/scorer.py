@@ -281,13 +281,17 @@ class _CoordPlan:
 
     Deliberately carries the table CAPACITY (the compiled shape) and not
     the entity count: the zero-row index is dynamic published state, so a
-    swap that only grows the vocabulary within capacity compares equal."""
+    swap that only grows the vocabulary within capacity compares equal.
+    The storage ``dtype`` IS part of the plan: the decode is baked into
+    every bucket program, so a dtype-mismatched swap must refuse through
+    the same plan-equality gate as a capacity change."""
 
     name: str
     kind: str  # "fixed" | "random"
     shard: str
     column: Optional[str] = None  # random: id column joined on
     capacity: int = 0  # random: table rows (vocabulary + zero-row headroom)
+    dtype: str = "f32"  # random: gather-table storage tier (f32|bf16|int8)
 
 
 class GameScorer:
@@ -312,11 +316,17 @@ class GameScorer:
         telemetry=None,
         strict_after_warmup: bool = True,
         table_capacity_factor: int = 1,
+        table_dtype: str = "f32",
     ):
+        from photon_tpu.game.lowp import check_dtype
         from photon_tpu.telemetry import NULL_SESSION
 
         self.model = model
         self.mesh = mesh
+        # Gather-table storage tier (ISSUE 17): f32 | bf16 | int8.  Baked
+        # into every bucket program's decode (and into the plan, so a
+        # mismatched swap refuses); accumulation stays f32 regardless.
+        self.table_dtype = check_dtype(table_dtype)
         self.telemetry = telemetry or NULL_SESSION
         self.request_spec = request_spec or request_spec_for_model(model)
         self.buckets = bucket_ladder(buckets, max_batch, min_bucket)
@@ -387,10 +397,14 @@ class GameScorer:
                         name, "random", coord.shard_name,
                         column=coord.entity_column,
                         capacity=int(capacity),
+                        dtype=self.table_dtype,
                     )
                 )
                 tables.append(
-                    coord.serving_table(self.mesh, capacity=capacity)
+                    coord.serving_table(
+                        self.mesh, capacity=capacity,
+                        dtype=self.table_dtype,
+                    )
                 )
                 zero_rows.append(coord.num_entities)
                 # host-sync: build/swap-time only — entity vocabularies are
@@ -424,11 +438,20 @@ class GameScorer:
                 ).set(next(
                     c.capacity for c in self._plan if c.name == name
                 ))
-        self.telemetry.gauge("serving.model_bytes").set(
-            sum(t.nbytes for t in tables)
+        # Leaf-wise: an int8 table is a (q, scale) tuple — count both.
+        total_bytes = sum(
+            leaf.nbytes for leaf in jax.tree_util.tree_leaves(tables)
         )
+        self.telemetry.gauge("serving.model_bytes").set(total_bytes)
+        # The precision tier's headline gauge: gather-table bytes under
+        # the SERVED storage dtype (bf16 >= 1.9x, int8 >= 3.5x smaller
+        # than f32 at equal entity count — asserted by the serving bench).
+        self.telemetry.gauge(
+            "serving.table_bytes", dtype=self.table_dtype
+        ).set(total_bytes)
 
-    def swap_model(self, model: GameModel) -> None:
+    def swap_model(self, model: GameModel,
+                   table_dtype: Optional[str] = None) -> None:
         """HOT-SWAP a retrained model under live traffic: the new device
         table tuple is built (uploaded) FIRST — double-buffered next to the
         serving tables — then published in one reference assignment, so no
@@ -445,7 +468,20 @@ class GameScorer:
         In-flight requests complete against whichever triple they captured
         at dispatch: the old tables stay alive until their last dispatch
         retires (the runtime holds the references), then free.  Counted as
-        ``serving.swaps``."""
+        ``serving.swaps``.
+
+        ``table_dtype``, when given, asserts the caller's expected storage
+        tier: the decode is baked into the warmed bucket programs, so an
+        artifact published at a DIFFERENT dtype must refuse here instead
+        of silently re-encoding (serving it would change the fleet's
+        parity bound under live traffic)."""
+        if table_dtype is not None and table_dtype != self.table_dtype:
+            raise ValueError(
+                f"swap_model: model published at table dtype "
+                f"{table_dtype!r} but this scorer's warmed programs decode "
+                f"{self.table_dtype!r}; the storage tier is baked into the "
+                "compiled bucket ladder — rebuild the scorer to change it"
+            )
         capacities = {
             c.name: c.capacity for c in self._plan if c.kind == "random"
         }
@@ -456,10 +492,21 @@ class GameScorer:
             raise ValueError(
                 "swap_model: the new model's serving plan does not match "
                 f"the compiled programs (served {self._plan}, new "
-                f"{tuple(plan)}); a changed coordinate layout or table "
-                "capacity requires a new GameScorer"
+                f"{tuple(plan)}); a changed coordinate layout, table "
+                "capacity, or storage dtype requires a new GameScorer"
             )
-        for new, old in zip(tables, self._tables):
+        # Leaf-wise: an int8 table is a (q, scale) tuple; its structure,
+        # every leaf shape, AND every leaf dtype must match the compiled
+        # programs exactly or nothing recompile-free can serve it.
+        new_leaves, new_treedef = jax.tree_util.tree_flatten(tuple(tables))
+        old_leaves, old_treedef = jax.tree_util.tree_flatten(self._tables)
+        if new_treedef != old_treedef:
+            raise ValueError(
+                "swap_model: table pytree structure changed "
+                f"({old_treedef} -> {new_treedef}); a changed table "
+                "layout requires a new GameScorer"
+            )
+        for new, old in zip(new_leaves, old_leaves):
             if new.shape != old.shape or new.dtype != old.dtype:
                 raise ValueError(
                     "swap_model: table shape/dtype changed "
@@ -512,7 +559,8 @@ class GameScorer:
         accelerators only.  See the comment at the jit site: on CPU the
         placed buffers can alias the staged host memory and each other
         across replicas, and donating an aliased buffer corrupts scores."""
-        devices = self._tables[0].devices() if self._tables else set()
+        leaves = jax.tree_util.tree_leaves(self._tables)
+        devices = leaves[0].devices() if leaves else set()
         if any(d.platform == "cpu" for d in devices):
             return ()
         return (2, 3, 4)

@@ -359,6 +359,12 @@ class ReplicaSupervisor:
         if self.fleet.current_model()[1] != version:
             return  # a rollout landed mid-probe: the oracle is stale
         worst = parity_worst(got, want)
+        # Per-codec parity histogram (ISSUE 17): known-answer probe deltas
+        # labeled by the replica's serving storage tier.
+        self.telemetry.histogram(
+            "serving.probe_parity",
+            dtype=getattr(replica.scorer, "table_dtype", "f32"),
+        ).observe(worst)
         observer = getattr(self.fleet, "observer", None)
         if observer is not None:
             # Feed BOTH verdicts to the SLO monitor: the canary-parity

@@ -1,0 +1,597 @@
+"""Low-precision tables and tiles (ISSUE 17): bf16/int8 storage with f32
+accumulation, per-codec parity bounds, and the machinery that keeps the
+reduced tiers operationally identical to f32.
+
+Contracts pinned here:
+
+- quantization unit laws: int8 per-row absmax roundtrip error bound,
+  exact zero rows, canonical fixed-point idempotence (re-encode of a
+  decode is byte-identical — the property kill→resume digest compares
+  rely on), bf16 truncation idempotence;
+- serving parity per dtype vs the f32 HOST oracle (``GameModel.score``):
+  request path, dataset path, cold entities, post-``swap_model`` — each
+  within the codec's declared ``PARITY_TOL`` bound;
+- recompile freedom per dtype: post-warmup traffic across buckets
+  compiles NOTHING (the decode lives inside the warmed programs);
+- ``swap_model`` preserves the storage tier: a refresh and a
+  grow-in-place (within pre-provisioned capacity) keep the dtype with
+  zero compiles, and a dtype-mismatched swap REFUSES;
+- ``serving.table_bytes``: bf16 >= 1.9x and int8 >= 3.5x smaller than
+  f32 at equal entity count (the ISSUE acceptance bars);
+- tile-store codecs: lossy roundtrip within the metric bound, NaN/Inf
+  payloads fall back to the lossless path bit-exactly, a corrupted int8
+  SCALE ROW is refused at read (digest over the ENCODED payload — before
+  a decode could silently rescale a whole row);
+- spilled write-back + resume per codec: flushed lossy tiles re-attach
+  exactly (memory == disk after the publish-time roundtrip), and a
+  spilled fit's metrics track the host-resident streamed fit within the
+  per-codec ``TILE_METRIC_TOL``;
+- the estimator refuses a lossy ``tile_dtype`` without a spill dir, and
+  unknown dtypes are rejected everywhere.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from photon_tpu.core.objective import RegularizationContext
+from photon_tpu.core.optimizers import OptimizerConfig
+from photon_tpu.core.problem import ProblemConfig
+from photon_tpu.data.synthetic import make_game_dataset
+from photon_tpu.game.coordinate import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_tpu.game.data import split_game_dataset
+from photon_tpu.game.estimator import (
+    GameEstimator,
+    GameOptimizationConfiguration,
+)
+from photon_tpu.game.lowp import (
+    PARITY_TOL,
+    TABLE_DTYPES,
+    check_dtype,
+    dequantize_int8_rows,
+    encode_bf16,
+    parity_tol_for,
+    quantize_int8_canonical,
+    quantize_int8_rows,
+    tile_metric_tol_for,
+)
+from photon_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.game.tile_store import (
+    TILES,
+    CorruptTileError,
+    TileStore,
+    codec_roundtrip,
+)
+from photon_tpu.game.tiles import (
+    ChunkPlan,
+    HostTileCache,
+    SpilledResidualTable,
+)
+from photon_tpu.models.glm import Coefficients, model_for_task
+from photon_tpu.serving import (
+    GameScorer,
+    ScoringRequest,
+    build_requests,
+    request_spec_for_dataset,
+)
+from photon_tpu.telemetry import TelemetrySession
+
+LOSSY = ("bf16", "int8")
+
+# random_dim 32: wide enough that int8's per-row scale column amortizes
+# past the 3.5x acceptance bar (bytes ratio 4d/(d+4)).
+RANDOM_DIM = 32
+
+
+def _fixture(seed=3, n_entities=40, fixed_dim=6, random_dim=RANDOM_DIM):
+    data, _ = make_game_dataset(
+        n_entities, 4, fixed_dim, random_dim, seed=seed
+    )
+    rng = np.random.default_rng(seed)
+    keys = np.unique(data.id_columns["re0"])
+    model = GameModel(
+        coordinates={
+            "fixed": FixedEffectModel(
+                model_for_task("logistic_regression", Coefficients(
+                    rng.standard_normal(fixed_dim).astype(np.float32)
+                )),
+                "global",
+            ),
+            "per_entity": RandomEffectModel(
+                table=rng.standard_normal(
+                    (len(keys), random_dim)
+                ).astype(np.float32),
+                keys=keys, entity_column="re0", shard_name="re0",
+                task_type="logistic_regression",
+            ),
+        },
+        task_type="logistic_regression",
+    )
+    return model, data
+
+
+def _counter_total(session, name, **labels):
+    total = 0
+    for m in session.registry.snapshot()["counters"]:
+        if m["name"] != name:
+            continue
+        if labels and any(
+            str(m["labels"].get(k)) != str(v) for k, v in labels.items()
+        ):
+            continue
+        total += m["value"]
+    return total
+
+
+@pytest.fixture(scope="module")
+def served_tiers():
+    """One warmed scorer per storage dtype over the SAME model/data (the
+    f32 entry doubles as the table-bytes denominator)."""
+    model, data = _fixture()
+    out = {}
+    for dtype in TABLE_DTYPES:
+        session = TelemetrySession(f"test-lowp-{dtype}")
+        scorer = GameScorer(
+            model, request_spec=request_spec_for_dataset(model, data),
+            max_batch=64, telemetry=session, table_dtype=dtype,
+        ).warmup()
+        out[dtype] = (scorer, session)
+    return model, data, out
+
+
+# -- quantization unit laws --------------------------------------------------
+
+def test_int8_roundtrip_error_bound_and_zero_rows():
+    rng = np.random.default_rng(0)
+    arr = (rng.standard_normal((50, 16)) * 10.0 **
+           rng.integers(-3, 3, (50, 1))).astype(np.float32)
+    arr[7] = 0.0  # an exactly-zero row (a cold/unused entity)
+    q, scale = quantize_int8_rows(arr)
+    assert q.dtype == np.int8 and scale.dtype == np.float32
+    back = dequantize_int8_rows(q, scale)
+    # Symmetric absmax: per-row error <= half a quantization step (the
+    # 0.51 absorbs the f32 rounding of the scale itself).
+    step = np.abs(arr).max(axis=-1, keepdims=True) / 127.0
+    assert np.all(np.abs(back - arr) <= 0.51 * step)
+    # Zero rows decode EXACTLY zero (scale 0, not a 0/0 NaN).
+    assert scale[7] == 0.0
+    np.testing.assert_array_equal(back[7], np.zeros(16, np.float32))
+
+
+def test_int8_canonical_is_a_fixed_point():
+    """Re-encoding a decode must be byte-identical — the digest-over-
+    encoded-payload resume compare depends on it."""
+    rng = np.random.default_rng(1)
+    arr = rng.standard_normal((40, 12)).astype(np.float32)
+    q, scale, converged = quantize_int8_canonical(arr)
+    assert converged
+    back = dequantize_int8_rows(q, scale)
+    q2, scale2, converged2 = quantize_int8_canonical(back)
+    assert converged2
+    assert q2.tobytes() == q.tobytes()
+    assert scale2.tobytes() == scale.tobytes()
+
+
+def test_bf16_truncation_idempotent():
+    rng = np.random.default_rng(2)
+    arr = rng.standard_normal((33, 9)).astype(np.float32)
+    once = codec_roundtrip(arr, "bf16")
+    assert once.dtype == np.float32
+    assert np.abs(once - arr).max() <= 2.0 ** -6 * np.abs(arr).max()
+    np.testing.assert_array_equal(codec_roundtrip(once, "bf16"), once)
+    assert encode_bf16(once).tobytes() == encode_bf16(arr).tobytes()
+
+
+def test_check_dtype_rejects_unknown():
+    assert check_dtype(None) == "f32"
+    with pytest.raises(ValueError, match="fp8"):
+        check_dtype("fp8")
+    with pytest.raises(ValueError):
+        GameScorer(_fixture()[0], table_dtype="f16")
+
+
+# -- serving parity per dtype (request / dataset / cold / post-swap) ---------
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_request_path_parity_per_dtype(served_tiers, dtype):
+    model, data, tiers = served_tiers
+    scorer, _ = tiers[dtype]
+    want = model.score(data)  # f32 host oracle
+    tol = parity_tol_for(dtype)
+    pos = 0
+    sizes = [1, 3, 17, 64]
+    for req, size in zip(build_requests(data, model, sizes), sizes):
+        rows = np.arange(pos, pos + size) % data.num_examples
+        got = scorer.score_batch(req)
+        assert np.abs(got - want[rows]).max() <= tol
+        pos = (pos + size) % data.num_examples
+
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_dataset_path_parity_per_dtype(served_tiers, dtype):
+    model, data, tiers = served_tiers
+    scorer, _ = tiers[dtype]
+    got = scorer.score_dataset(data)
+    assert np.abs(got - model.score(data)).max() <= parity_tol_for(dtype)
+
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_cold_entities_fall_back_per_dtype(served_tiers, dtype):
+    """Unknown keys score fixed-effect-only through the ZERO gather row —
+    which every codec must decode to exactly zero (int8: scale-row 0)."""
+    model, data, tiers = served_tiers
+    scorer, session = tiers[dtype]
+    before = _counter_total(session, "serving.cold_entities")
+    x_fixed = data.shards["global"].x[:3]
+    x_rand = data.shards["re0"].x[:3]
+    req = ScoringRequest(
+        features={"global": x_fixed, "re0": x_rand},
+        entity_ids={"re0": np.array([10 ** 9, 10 ** 9 + 1, 10 ** 9 + 2])},
+    )
+    got = scorer.score_batch(req)
+    fixed_only = x_fixed @ np.asarray(
+        model.coordinates["fixed"].coefficients.means
+    )
+    # The cold fallback is EXACT per dtype (zero decodes to zero), so the
+    # f32 tolerance applies to every tier.
+    np.testing.assert_allclose(got, fixed_only, rtol=1e-5, atol=1e-5)
+    assert _counter_total(session, "serving.cold_entities") == before + 3
+
+
+@pytest.mark.parametrize("dtype", TABLE_DTYPES)
+def test_recompile_free_post_warmup_per_dtype(served_tiers, dtype):
+    model, data, tiers = served_tiers
+    scorer, _ = tiers[dtype]
+    warm = scorer.compilations
+    rng = np.random.default_rng(4)
+    sizes = rng.integers(1, 65, size=20).tolist()
+    for req in build_requests(data, model, sizes):
+        scorer.score_batch(req)
+    assert scorer.compilations == warm
+
+
+def test_table_bytes_reduction_bars(served_tiers):
+    """The ISSUE 17 acceptance bars at equal entity count: bf16 >= 1.9x,
+    int8 >= 3.5x smaller gather tables than f32."""
+    _, _, tiers = served_tiers
+    bytes_for = {}
+    for dtype, (_, session) in tiers.items():
+        bytes_for[dtype] = session.registry.gauge(
+            "serving.table_bytes", dtype=dtype
+        ).value
+    assert bytes_for["f32"] / bytes_for["bf16"] >= 1.9
+    assert bytes_for["f32"] / bytes_for["int8"] >= 3.5
+
+
+# -- hot swap: dtype preserved, growth in place, mismatch refused ------------
+
+def _perturbed(model, seed, extra_entities=0):
+    """A refreshed model: same shapes (plus optionally grown vocabulary),
+    different values — what a continual-training cycle publishes."""
+    rng = np.random.default_rng(seed)
+    re = model.coordinates["per_entity"]
+    keys = np.asarray(re.keys)
+    table = np.asarray(re.table) + 0.1 * rng.standard_normal(
+        (len(keys), re.table.shape[1])
+    ).astype(np.float32)
+    if extra_entities:
+        new_keys = np.arange(
+            keys.max() + 1, keys.max() + 1 + extra_entities
+        ).astype(keys.dtype)
+        keys = np.concatenate([keys, new_keys])
+        table = np.concatenate([
+            table,
+            rng.standard_normal(
+                (extra_entities, table.shape[1])
+            ).astype(np.float32),
+        ])
+    return GameModel(
+        coordinates={
+            "fixed": model.coordinates["fixed"],
+            "per_entity": RandomEffectModel(
+                table=table.astype(np.float32), keys=keys,
+                entity_column=re.entity_column,
+                shard_name=re.shard_name, task_type=re.task_type,
+            ),
+        },
+        task_type=model.task_type,
+    )
+
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_swap_model_preserves_dtype_and_parity(dtype):
+    model, data = _fixture(seed=9)
+    session = TelemetrySession(f"test-swap-{dtype}")
+    scorer = GameScorer(
+        model, request_spec=request_spec_for_dataset(model, data),
+        max_batch=64, telemetry=session, table_dtype=dtype,
+    ).warmup()
+    warm = scorer.compilations
+    new_model = _perturbed(model, seed=10)
+    scorer.swap_model(new_model)
+    assert scorer.table_dtype == dtype
+    assert scorer.compilations == warm  # swap never recompiles
+    got = scorer.score_dataset(data)
+    assert np.abs(got - new_model.score(data)).max() <= parity_tol_for(dtype)
+
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_grow_in_place_preserves_dtype(dtype):
+    """Vocabulary growth within pre-provisioned capacity hot-swaps in
+    place: the new rows land in the headroom UNDER THE SAME CODEC (int8:
+    their scale rows too), with zero compiles."""
+    model, data = _fixture(seed=12)
+    scorer = GameScorer(
+        model, request_spec=request_spec_for_dataset(model, data),
+        max_batch=64, table_dtype=dtype, table_capacity_factor=2,
+    ).warmup()
+    warm = scorer.compilations
+    grown = _perturbed(model, seed=13, extra_entities=8)
+    scorer.swap_model(grown)
+    assert scorer.table_dtype == dtype
+    assert scorer.compilations == warm
+    # Score rows carrying the NEW entities' ids: served through the grown
+    # rows, within the codec bound of the host oracle.
+    re = grown.coordinates["per_entity"]
+    new_keys = np.asarray(re.keys)[-8:]
+    x_fixed = data.shards["global"].x[:8]
+    x_rand = data.shards["re0"].x[:8]
+    req = ScoringRequest(
+        features={"global": x_fixed, "re0": x_rand},
+        entity_ids={"re0": new_keys},
+    )
+    got = scorer.score_batch(req)
+    fixed_w = np.asarray(grown.coordinates["fixed"].coefficients.means)
+    table = np.asarray(re.table)
+    want = x_fixed @ fixed_w + np.einsum(
+        "rd,rd->r", x_rand, table[-8:]
+    )
+    assert np.abs(got - want).max() <= parity_tol_for(dtype)
+
+
+def test_swap_model_dtype_mismatch_refuses():
+    model, data = _fixture(seed=14)
+    scorer = GameScorer(
+        model, request_spec=request_spec_for_dataset(model, data),
+        max_batch=64, table_dtype="bf16",
+    ).warmup()
+    with pytest.raises(ValueError, match="bf16"):
+        scorer.swap_model(_perturbed(model, seed=15), table_dtype="f32")
+    # The refused swap left the served tables untouched.
+    got = scorer.score_dataset(data)
+    assert np.abs(got - model.score(data)).max() <= parity_tol_for("bf16")
+
+
+# -- tile-store codecs --------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_tile_store_lossy_roundtrip(tmp_path, dtype):
+    rng = np.random.default_rng(5)
+    tile = (rng.standard_normal((3, 41)) * 10.0 **
+            rng.integers(-2, 3, (3, 1))).astype(np.float32)
+    store = TileStore(str(tmp_path), tile_dtype=dtype)
+    store.write(TILES, 0, {"tile": tile},
+                codecs=store.lossy_codecs(("tile",)))
+    arrays, _ = store.read(TILES, 0)
+    got = arrays["tile"]
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(got, codec_roundtrip(tile, dtype))
+    # Re-publishing the DECODE is a fixed point: byte-identical payload.
+    store.write(TILES, 1, {"tile": got},
+                codecs=store.lossy_codecs(("tile",)))
+    again, _ = store.read(TILES, 1)
+    np.testing.assert_array_equal(again["tile"], got)
+
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_tile_store_nan_inf_falls_back_lossless(tmp_path, dtype):
+    tile = np.array([[1.0, np.nan, 3.0], [np.inf, 5.0, -np.inf]],
+                    np.float32)
+    store = TileStore(str(tmp_path), tile_dtype=dtype)
+    store.write(TILES, 0, {"tile": tile},
+                codecs=store.lossy_codecs(("tile",)))
+    arrays, _ = store.read(TILES, 0)
+    # Non-finite payloads must come back BIT-exact (lossless fallback).
+    np.testing.assert_array_equal(arrays["tile"], tile)
+
+
+def test_corrupt_scale_row_refused_at_read(tmp_path):
+    """A flipped bit in the int8 SCALE ROW region is caught by the
+    digest over the ENCODED payload — before a decode could silently
+    rescale a whole row of 41 values."""
+    import json as _json
+    import struct
+
+    rng = np.random.default_rng(6)
+    tile = rng.standard_normal((3, 41)).astype(np.float32)
+    # compress=False keeps the payload at encoding "raw", so the flipped
+    # offset lands in the scale bytes themselves (a corrupt COMPRESSED
+    # stream would fail earlier, in zlib).
+    store = TileStore(str(tmp_path), tile_dtype="int8", compress=False)
+    store.write(TILES, 0, {"tile": tile},
+                codecs=store.lossy_codecs(("tile",)))
+    path = store.path(TILES, 0)
+    blob = bytearray(open(path, "rb").read())
+    (hlen,) = struct.unpack("<Q", bytes(blob[8:16]))
+    header = _json.loads(bytes(blob[16:16 + hlen]))
+    entry = next(e for e in header["arrays"] if e["name"] == "tile")
+    assert entry["codec"] == "int8"
+    # The int8 payload leads with the f32 scale rows: offset + 2 lands
+    # inside the first scale value.
+    pos = 16 + hlen + entry["offset"] + 2
+    blob[pos] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(CorruptTileError):
+        store.read(TILES, 0)
+
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_spilled_writeback_resume_per_codec(tmp_path, dtype):
+    """Flush → re-attach under a lossy codec: the publish-time roundtrip
+    makes memory == disk, so a fresh table adopts every tile (digests
+    over the encoded payload compare exact) and serves identical values."""
+    rng = np.random.default_rng(7)
+    n = 101
+    base = rng.standard_normal(n).astype(np.float32)
+    plan = ChunkPlan(n, 17)
+    names = ["a", "b"]
+    store = TileStore(str(tmp_path), tile_dtype=dtype)
+    spilled = SpilledResidualTable(
+        base, names, plan, store, HostTileCache()
+    )
+    for name in names:
+        spilled.update(name, rng.standard_normal(n).astype(np.float32))
+    assert spilled.flush() == plan.num_chunks
+    attached = SpilledResidualTable(
+        base, names, plan, store, HostTileCache()
+    )
+    assert attached.attach_resume() == []
+    assert attached.tile_digests() == spilled.tile_digests()
+    for name in names:
+        np.testing.assert_array_equal(
+            attached.scores_for(name), spilled.scores_for(name)
+        )
+    np.testing.assert_array_equal(
+        attached.composite_full(), spilled.composite_full()
+    )
+
+
+# -- spilled fit parity per codec --------------------------------------------
+
+CHUNK = 37
+
+
+def _problem(lam):
+    return ProblemConfig(
+        regularization=RegularizationContext("l2", lam),
+        optimizer_config=OptimizerConfig(
+            max_iterations=80, tolerance=1e-11, gradient_tolerance=1e-8,
+        ),
+    )
+
+
+def _config():
+    return GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", _problem(1.0)),
+            "re0": RandomEffectCoordinateConfig("re0", "re0", _problem(1.0)),
+        },
+        descent_iterations=2,
+        name="lowp",
+    )
+
+
+@pytest.fixture(scope="module")
+def fit_data():
+    data, _ = make_game_dataset(100, 5, 6, 3, seed=0, n_random_coords=1)
+    return split_game_dataset(data, 0.25, seed=1)
+
+
+@pytest.fixture(scope="module")
+def host_streamed_fit(fit_data):
+    train, val = fit_data
+    return GameEstimator(
+        "linear_regression", train, validation_data=val,
+        stream_chunks=CHUNK,
+    ).fit([_config()])[0]
+
+
+@pytest.mark.parametrize("dtype", LOSSY)
+def test_spilled_fit_metric_parity_per_codec(
+    tmp_path, fit_data, host_streamed_fit, dtype
+):
+    train, val = fit_data
+    result = GameEstimator(
+        "linear_regression", train, validation_data=val,
+        stream_chunks=CHUNK, spill_dir=str(tmp_path), tile_dtype=dtype,
+    ).fit([_config()])[0]
+    tol = tile_metric_tol_for(dtype)
+    for name, value in host_streamed_fit.metrics.items():
+        assert abs(value - result.metrics[name]) <= tol, (
+            f"{name}: {value} vs {result.metrics[name]} (bound {tol})"
+        )
+
+
+def test_tile_dtype_requires_spill_dir(fit_data):
+    train, _ = fit_data
+    with pytest.raises(ValueError, match="spill_dir"):
+        GameEstimator(
+            "linear_regression", train, stream_chunks=CHUNK,
+            tile_dtype="bf16",
+        )
+    with pytest.raises(ValueError, match="tile dtype"):
+        GameEstimator(
+            "linear_regression", train, stream_chunks=CHUNK,
+            tile_dtype="int4",
+        )
+
+
+# -- solver polish (ISSUE 17 satellite: the PR 8 stopping trick grafted) -----
+
+def test_lbfgs_polish_tightens_past_line_search_floor():
+    """The guarded full-step polish drives the final gradient well past
+    where f32 function differences round to zero (~1e-4 basin)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.core.optimizers.lbfgs import lbfgs
+
+    rng = np.random.default_rng(0)
+    n, d = 200, 12
+    X = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, n), jnp.float32)
+
+    def fun(w):
+        z = X @ w
+        f = jnp.mean(jnp.logaddexp(0.0, z) - y * z) + 0.01 * jnp.sum(w * w)
+        g = X.T @ (jax.nn.sigmoid(z) - y) / n + 0.02 * w
+        return f, g
+
+    r = jax.jit(lambda w0: lbfgs(fun, w0, OptimizerConfig()))(jnp.zeros(d))
+    assert bool(r.converged)
+    assert float(r.grad_norm) < 1e-5
+    assert np.all(np.isfinite(np.asarray(r.w)))
+
+
+def test_owlqn_polish_keeps_exact_zeros():
+    """Polish runs through the orthant machinery: coordinates the loop
+    zeroed stay EXACTLY zero while the pseudo-gradient tightens."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.core.optimizers.owlqn import owlqn
+
+    rng = np.random.default_rng(1)
+    n, d = 200, 12
+    X = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, n), jnp.float32)
+
+    def fun(w):
+        z = X @ w
+        f = jnp.mean(jnp.logaddexp(0.0, z) - y * z)
+        g = X.T @ (jax.nn.sigmoid(z) - y) / n
+        return f, g
+
+    r = jax.jit(
+        lambda w0: owlqn(fun, w0, OptimizerConfig(), l1_weight=0.05)
+    )(jnp.zeros(d))
+    w = np.asarray(r.w)
+    assert np.all(np.isfinite(w))
+    assert (w == 0.0).sum() > 0  # L1 sparsity survived the polish
+    assert float(r.grad_norm) < 1e-5
+
+
+@pytest.mark.parametrize("tol", list(PARITY_TOL.items()))
+def test_parity_tol_registry_consistent(tol):
+    dtype, bound = tol
+    assert parity_tol_for(dtype) == bound
+    assert tile_metric_tol_for(dtype) > 0

@@ -427,6 +427,10 @@ def test_spilled_table_matches_host_resident_bitwise(tmp_path):
     )
     assert spilled.tile_digests() == resident.tile_digests()
     assert spilled.snapshot_rows() == {}  # referenced, not re-saved
+    # Write-back batching (ISSUE 17): the three per-coordinate updates
+    # of each tile coalesce into ONE store publish at flush time.
+    assert spilled.flush() == plan.num_chunks
+    assert spilled.flush() == 0  # idempotent: nothing left dirty
     # A second table attaches to the published tiles exactly.
     attached = SpilledResidualTable(
         base, names, plan, store, HostTileCache()

@@ -102,6 +102,11 @@ DEFAULT_FILES = (
     "photon_tpu/telemetry/distributed.py",
     "photon_tpu/telemetry/live.py",
     "photon_tpu/serving/observe.py",
+    # Low-precision table/tile codecs (ISSUE 17): quantize/dequantize
+    # and the parity-tolerance registry are host-side numpy over already
+    # materialized arrays — the DEVICE decode lives in the scorer's
+    # gather programs; a hidden d2h here would stall every tile publish.
+    "photon_tpu/game/lowp.py",
 )
 
 SYNC_PATTERN = re.compile(
