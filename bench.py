@@ -2172,6 +2172,213 @@ def _bench_fleet(table_dtype: str = "f32") -> None:
     })
 
 
+def _tenant_clone(model, seed: int):
+    """A tenant model for the multi-model arena bench: SAME coordinate
+    structure and entity vocabulary as ``model`` (one arena layout hosts
+    them all), freshly seeded coefficient tables (so per-tenant parity
+    actually distinguishes the tenants)."""
+    import dataclasses as _dc
+
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, model_for_task
+
+    rng = np.random.default_rng(seed)
+    coords = {}
+    for name, coord in model.coordinates.items():
+        if isinstance(coord, RandomEffectModel):
+            coords[name] = _dc.replace(
+                coord,
+                table=rng.standard_normal(
+                    np.asarray(coord.table).shape
+                ).astype(np.float32),
+            )
+        else:
+            dim = int(np.asarray(coord.coefficients.means).shape[0])
+            coords[name] = FixedEffectModel(
+                model_for_task(model.task_type, Coefficients(
+                    rng.standard_normal(dim).astype(np.float32)
+                )),
+                coord.shard_name,
+            )
+    return GameModel(coordinates=coords, task_type=model.task_type)
+
+
+def _bench_fleet_multimodel(table_dtype: str = "f32",
+                            n_models: int = 8) -> None:
+    """Multi-model arena macro-bench (``--mode fleet --models N`` — the
+    ISSUE 18 tentpole's measurement).
+
+    Hosts ``n_models`` tenant models in ONE fleet replica — one shared
+    gather-table arena allocation, one compiled bucket ladder — and
+    serves seeded mixed-tenant traffic (hash-of-user split arms route
+    each request to its tenant).  In-bench acceptance (raises on
+    violation):
+
+    - ZERO jax compile events across the whole mixed-tenant serve (model
+      identity is a per-request offset vector, never a program key);
+    - per-tenant score parity vs a SOLO single-model ``GameScorer`` of
+      the same storage dtype ≤ the codec's declared bound on every
+      sampled served request;
+    - arena bytes ≤ 1.15x the sum of the tenants' solo table bytes (the
+      shared allocation carries headroom, not duplication);
+    - the seeded split assignment is deterministic (regenerating the
+      stream reproduces every arm) and every tenant receives traffic.
+    """
+    import jax.monitoring
+    from jax._src import monitoring as monitoring_src
+
+    from photon_tpu.game.lowp import parity_tol_for
+    from photon_tpu.serving import (
+        AdmissionPolicy,
+        ServingFleet,
+        TrafficSpec,
+        generate_traffic,
+        request_spec_for_dataset,
+        run_closed_loop_outcomes,
+    )
+    from photon_tpu.serving.scorer import GameScorer
+    from photon_tpu.telemetry import TelemetrySession
+
+    platform, base_model, data = _serving_fixture()
+    models = {
+        f"m{i}": _tenant_clone(base_model, seed=100 + i)
+        for i in range(n_models)
+    }
+    parity_bound = parity_tol_for(table_dtype)
+    spec = request_spec_for_dataset(base_model, data)
+    max_batch, clients = 128, 8
+    n_requests = 600 if platform != "cpu" else 240
+    splits = {mid: 1.0 / n_models for mid in models}
+    tspec = TrafficSpec(
+        requests=n_requests, mean_rows=8.0, max_rows=max_batch,
+        popularity="powerlaw", alpha=1.1, storm_frac=0.0, seed=0,
+        splits=splits,
+    )
+    traffic = generate_traffic(data, base_model, tspec)
+    # Split determinism + coverage: the same seed reproduces every arm,
+    # and the uniform split actually reaches every tenant.
+    arms = [item.arm for item in traffic.items]
+    if arms != [item.arm for item in
+                generate_traffic(data, base_model, tspec).items]:
+        raise AssertionError("seeded split arms are not deterministic")
+    arm_counts = {mid: arms.count(mid) for mid in models}
+    missing = [mid for mid, c in arm_counts.items() if c == 0]
+    if missing:
+        raise AssertionError(
+            f"tenants {missing} received no traffic from the uniform split"
+        )
+
+    # Solo baseline: ONE single-model scorer, swapped per tenant — its
+    # scores are the isolation oracle, its table bytes the per-tenant
+    # allocation the arena must not exceed in sum.
+    solo_session = TelemetrySession("bench-multimodel-solo")
+    solo = GameScorer(
+        models["m0"], request_spec=spec, max_batch=max_batch,
+        telemetry=solo_session, table_dtype=table_dtype,
+    ).warmup()
+    solo_bytes = 0
+    solo_scores: dict = {}
+    sample_per_tenant = 15
+    for mid, m in models.items():
+        if mid != "m0":
+            solo.swap_model(m)
+        solo_bytes += sum(
+            leaf.nbytes
+            for leaf in jax.tree_util.tree_leaves(solo._tables)
+        )
+        picked = [
+            item for item in traffic.items if item.arm == mid
+        ][:sample_per_tenant]
+        solo_scores[mid] = {
+            id(item): solo.score_batch(item.request) for item in picked
+        }
+
+    session = TelemetrySession("bench-fleet-multimodel")
+    fleet = ServingFleet(
+        None, models=models, replicas=1, request_spec=spec,
+        max_batch=max_batch, max_delay_s=0.001, telemetry=session,
+        table_dtype=table_dtype, admission=AdmissionPolicy(safety=2.0),
+    ).warmup()
+    arena = fleet.replicas[0].scorer.arena
+    arena_bytes = arena.arena_bytes()
+    compiled_programs = fleet.compilations
+    if arena_bytes > 1.15 * solo_bytes:
+        raise AssertionError(
+            f"arena allocates {arena_bytes} bytes for {n_models} tenants "
+            f"> 1.15x the {solo_bytes} bytes their solo tables sum to"
+        )
+
+    compile_events: list = []
+
+    def listener(event, **kwargs):
+        if "compile" in event:
+            compile_events.append(event)
+
+    def factory(tid):
+        return lambda item: fleet.score(item.request)
+
+    jax.monitoring.register_event_listener(listener)
+    try:
+        outcomes, wall = run_closed_loop_outcomes(
+            factory, traffic.items, clients=clients
+        )
+    finally:
+        monitoring_src._unregister_event_listener_by_callback(listener)
+        fleet.close()
+    bad = [o for o in outcomes if o.status != "ok"]
+    if bad:
+        raise AssertionError(
+            f"{len(bad)} mixed-tenant requests failed/shed; first: "
+            f"{bad[0].reason}"
+        )
+    if compile_events:
+        raise AssertionError(
+            f"{len(compile_events)} jax compile events across the "
+            f"{n_models}-tenant mixed serve (first: {compile_events[0]}) "
+            "— model identity leaked into a program key"
+        )
+    worst, compared = 0.0, 0
+    for out in outcomes:
+        want = solo_scores.get(out.item.arm, {}).get(id(out.item))
+        if want is None:
+            continue
+        compared += 1
+        worst = max(worst, float(np.max(np.abs(
+            np.asarray(out.scores, np.float64)
+            - np.asarray(want, np.float64)
+        ))))
+    if compared < n_models:
+        raise AssertionError(
+            f"parity sample covered only {compared} requests across "
+            f"{n_models} tenants"
+        )
+    if worst > parity_bound:
+        raise AssertionError(
+            f"arena/solo per-tenant parity broke ({table_dtype} tables): "
+            f"max |delta| {worst:.2e} > {parity_bound:g} over {compared} "
+            "sampled requests"
+        )
+    qps = len(outcomes) / wall if wall > 0 else 0.0
+    _emit("game_fleet_multimodel_qps", qps, "req/s", {
+        "models": n_models,
+        "requests": len(outcomes),
+        "clients": clients,
+        "table_dtype": table_dtype,
+        "arena_bytes": int(arena_bytes),
+        "solo_bytes_sum": int(solo_bytes),
+        "bytes_ratio": round(arena_bytes / solo_bytes, 4),
+        "compiled_programs": compiled_programs,
+        "parity_sampled": compared,
+        "max_parity_delta": worst,
+        "arm_counts": arm_counts,
+        "platform": platform,
+    })
+
+
 def _bench_online() -> None:
     """Online-learning refresh micro-bench (``--mode online`` — ISSUE 15).
 
@@ -2891,6 +3098,14 @@ def main() -> None:
             modes["fleet"] = lambda: _bench_fleet(
                 table_dtype=flag_value("--table-dtype")
             )
+        if mode == "fleet" and flag_value("--models"):
+            # ``--mode fleet --models N``: the ISSUE 18 multi-model arena
+            # leg alone — N tenants, one arena, one ladder; zero-recompile
+            # + per-tenant-parity + arena-bytes bars in-bench.
+            modes["fleet"] = lambda: _bench_fleet_multimodel(
+                table_dtype=flag_value("--table-dtype") or "f32",
+                n_models=int(flag_value("--models")),
+            )
         if mode not in modes:
             # An unknown mode must not silently fall through to the full
             # (minutes-long) default run; the raise reaches the top-level
@@ -2943,6 +3158,11 @@ def main() -> None:
                           # over the TCP ingest, traffic replay, admission
                           # control — the serving number going forward.
                           ("game_fleet", _bench_fleet),
+                          # Multi-model arena (ISSUE 18): N tenants in one
+                          # gather-table allocation and one compiled
+                          # bucket ladder, mixed split-arm traffic.
+                          ("game_fleet_multimodel",
+                           _bench_fleet_multimodel),
                           # Online learning (ISSUE 15): append->serving
                           # refresh latency + refreshed-vs-full-retrain
                           # parity on the CPU fixture.

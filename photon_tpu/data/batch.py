@@ -566,7 +566,12 @@ def pad_batch(batch: Batch, target_n: int) -> Batch:
 
     def _pad(a: Array) -> Array:
         widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-        return jnp.pad(a, widths)
+        if isinstance(a, jax.Array):
+            return jnp.pad(a, widths)
+        # Host leaves pad on host: a row-capacity rebuild at a new true
+        # row count then uploads at the (unchanged) padded shape and
+        # compiles nothing — the point of the capacity headroom.
+        return np.pad(np.asarray(a), widths)
 
     # The feature-major / aligned / routing auxes are row-count- and
     # block-structure-dependent; padding per-leaf would corrupt them (the

@@ -105,6 +105,19 @@ def build_parser() -> argparse.ArgumentParser:
                    "tables (ISSUE 17): bf16 halves table bytes, int8 "
                    "quarters them (per-row absmax scale row); gathers "
                    "decode on device and ALL accumulation stays f32")
+    p.add_argument("--models", type=int, default=1,
+                   help="tenant models hosted per replica (ISSUE 18 "
+                   "multi-model arena): N tenants m0..m{N-1} of the saved "
+                   "model share ONE gather-table allocation and ONE "
+                   "compiled bucket ladder; traffic is split across them "
+                   "by seeded hash-of-user arms unless --splits overrides")
+    p.add_argument("--splits", default=None,
+                   help="traffic split spec 'm0=0.7,m1=0.3' (weights "
+                   "normalize): each request's user hashes to an arm, the "
+                   "arm is the tenant model id it scores against")
+    p.add_argument("--tenant-queue-rows", type=int, default=0,
+                   help="per-tenant admission budget (queued rows cap per "
+                   "model id); 0 disables tenant isolation shedding")
     return p
 
 
@@ -192,6 +205,21 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
     deadline_s = (
         args.deadline_ms / 1000.0 if args.deadline_ms > 0 else None
     )
+    # Multi-model arena (ISSUE 18): N tenants of the saved model share one
+    # arena allocation + one compiled ladder per replica; traffic routes by
+    # seeded split arms (arm id == tenant model id).
+    models = (
+        {f"m{i}": model for i in range(args.models)}
+        if args.models > 1 else None
+    )
+    splits = None
+    if args.splits:
+        splits = {}
+        for part in args.splits.split(","):
+            arm, _, weight = part.partition("=")
+            splits[arm.strip()] = float(weight or 1.0)
+    elif models:
+        splits = {mid: 1.0 / len(models) for mid in models}
     with logger.timed("build-fleet"):
         fleet = ServingFleet(
             model,
@@ -201,8 +229,12 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
             max_batch=args.max_batch,
             max_delay_s=args.max_delay_ms / 1000.0,
             telemetry=session,
-            admission=AdmissionPolicy(default_deadline_s=deadline_s),
+            admission=AdmissionPolicy(
+                default_deadline_s=deadline_s,
+                tenant_queue_rows=args.tenant_queue_rows or None,
+            ),
             table_dtype=args.table_dtype,
+            models=models,
         ).warmup()
         if args.supervise:
             from photon_tpu.serving import SupervisorPolicy
@@ -226,6 +258,7 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         storm_frac=args.storm_frac if args.traffic == "powerlaw" else 0.0,
         deadline_ms=args.deadline_ms if args.deadline_ms > 0 else None,
         seed=args.seed,
+        splits=splits,
     )
     traffic = generate_traffic(data, model, spec)
 
@@ -314,6 +347,11 @@ def _run(args: argparse.Namespace, logger, session) -> dict:
         "traffic": args.traffic,
         "deadline_ms": args.deadline_ms,
         "table_dtype": args.table_dtype,
+        "models": args.models,
+        "splits": splits,
+        "tenant_shed": sum(
+            1 for o in shed if "tenant_budget" in str(o.reason or "")
+        ),
     }
     _publish_text(
         args.output_dir, "serving_summary.json",

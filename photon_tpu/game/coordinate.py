@@ -50,6 +50,7 @@ from photon_tpu.game.data import (
     RandomEffectDataset,
     _gather_shard_rows,
     build_random_effect_dataset,
+    SparseShard,
     entity_index_for,
     keys_match,
     pad_bucket_entities,
@@ -563,6 +564,45 @@ class Coordinate(Protocol):
 # ---------------------------------------------------------------------------
 
 
+def _pad_fixed_rows(shard, label, offset, weight, target_n):
+    """Host-side row padding for the fixed-effect batch's row-capacity
+    headroom: pad rows carry weight 0 (inert in every weighted objective),
+    zero features (ids=0/vals=0 for sparse — a no-op gather), and zero
+    label/offset.  Padding on HOST, before :func:`shard_to_batch` uploads,
+    is what makes a capacity rebuild compile-free — the device only ever
+    sees the capacity shape."""
+    n = len(label)
+    pad = target_n - n
+    # host-sync: every input here is caller-owned host numpy (this runs
+    # BEFORE the one device upload) — the asarray calls are dtype casts.
+    label = np.pad(np.asarray(label, np.float32), (0, pad))
+    # host-sync: host numpy offset (pre-upload).
+    offset = None if offset is None else np.pad(
+        np.asarray(offset, np.float32), (0, pad)
+    )
+    # A None weight means "all ones" — materialize it so the pad rows can
+    # carry the zeros that keep them out of the loss.
+    # host-sync: host numpy weight (pre-upload).
+    weight = np.pad(
+        np.ones(n, np.float32) if weight is None
+        else np.asarray(weight, np.float32),
+        (0, pad),
+    )
+    if isinstance(shard, DenseShard):
+        # host-sync: host numpy shard rows (pre-upload).
+        shard = DenseShard(
+            np.pad(np.asarray(shard.x), ((0, pad), (0, 0)))
+        )
+    else:
+        shard = SparseShard(
+            # host-sync: host numpy shard rows (pre-upload).
+            np.pad(np.asarray(shard.ids), ((0, pad), (0, 0))),
+            np.pad(np.asarray(shard.vals), ((0, pad), (0, 0))),
+            shard.dim_,
+        )
+    return shard, label, offset, weight
+
+
 class FixedEffectDeviceData:
     """The fixed-effect training batch, resident on device (sharded over the
     mesh's data axis when a mesh is given).  Built once per (shard,
@@ -574,6 +614,7 @@ class FixedEffectDeviceData:
         config: FixedEffectCoordinateConfig,
         mesh=None,
         build_fm: bool = True,
+        row_capacity: Optional[int] = None,
     ):
         self.mesh = mesh
         shard = data.shard(config.shard_name)
@@ -593,8 +634,20 @@ class FixedEffectDeviceData:
             label = label[keep]
             offset = offset[keep]
             weight = corrected
+        self.unpadded_n = len(label)
+        if row_capacity is not None and row_capacity > self.unpadded_n:
+            # Row-capacity headroom (ISSUE 18 satellite): weight-0 pad rows
+            # on HOST, ahead of the device upload and aux construction, so
+            # a refresh that rebuilds this layout at the SAME capacity
+            # reproduces the batch shape exactly — the upload lands at the
+            # (unchanged) padded shape, every program compiled against it
+            # stays hot, and nothing recompiles.  Pad rows are inert in the
+            # solve (the loss is weight-summed) and invisible to scoring
+            # (score paths read the shard, not the training batch).
+            shard, label, offset, weight = _pad_fixed_rows(
+                shard, label, offset, weight, row_capacity
+            )
         self.batch = shard_to_batch(shard, label, offset, weight)
-        self.unpadded_n = self.batch.num_examples
         self._train_rows_dev: Optional[Array] = None
         # Device scoring cache (residual engine): full-row-order shard
         # features + residency accounting, filled by _scoring_feats.
@@ -636,12 +689,25 @@ class FixedEffectDeviceData:
         else:
             if self.train_rows is not None:
                 offsets = offsets[self.train_rows]
-            dev = jnp.asarray(offsets, jnp.float32)
-        if self.mesh is None:
-            return dev
+            # host-sync: caller-owned host numpy on the seed path (this
+            # branch never sees device data — jax.Array took the one above).
+            offsets = np.asarray(offsets, np.float32)
+            pad = self.batch.num_examples - offsets.shape[0]
+            if pad:
+                # Pad on HOST: the upload then always lands at the batch's
+                # (capacity) shape, so a refresh at a new true row count
+                # compiles nothing on the seed path.
+                offsets = np.pad(offsets, (0, pad))
+            dev = jnp.asarray(offsets)
         short = self.batch.num_examples - dev.shape[0]
         if short:
+            # Device vectors (the residual engine's total) pad on device:
+            # covers both the mesh pad-to-shard-multiple and single-device
+            # row-capacity headroom (pad rows carry weight 0, so their
+            # offset value never reaches the loss).
             dev = jnp.pad(dev, (0, short))
+        if self.mesh is None:
+            return dev
         return reshard(dev, NamedSharding(self.mesh, P(DATA_AXIS)))
 
 

@@ -181,6 +181,13 @@ class GameEstimator:
         # the bucketed random-effect datasets (the reference's shuffle) and
         # uploading feature blocks happens once per distinct data config.
         self._device_data_cache: Dict[tuple, object] = {}
+        # Fixed-effect batch row-capacity headroom (ISSUE 18 satellite):
+        # per data config, the amortized-doubling padded row count the next
+        # FixedEffectDeviceData rebuild targets.  A refresh whose grown row
+        # count still fits rebuilds at the SAME shape, so every solve
+        # program compiled against the batch stays hot (zero recompiles
+        # across online refreshes — the test_online pin).
+        self._fixed_row_capacity: Dict[tuple, int] = {}
         # Streamed mode: host-side bucketed layouts + the shared chunk
         # streamer (overlap/stall telemetry accumulates across the sweep).
         self._stream_data_cache: Dict[tuple, object] = {}
@@ -224,6 +231,7 @@ class GameEstimator:
             if isinstance(coord_config, FixedEffectCoordinateConfig):
                 self._device_data_cache[key] = FixedEffectDeviceData(
                     self.training_data, coord_config, self.mesh,
+                    row_capacity=self._fixed_row_capacity.get(key),
                 )
             else:
                 from photon_tpu.game.coordinate import (
@@ -401,10 +409,12 @@ class GameEstimator:
         capacity; resident feature blocks untouched, ZERO full layout
         rebuilds — the contract the online service asserts via the
         ``estimator.device_data_rebuilds{kind}`` counter).  Fixed-effect
-        device data is whole-dataset (its batch shape IS the row count) and
-        is dropped for a lazy rebuild on the next fit, counted as
-        ``kind="fixed"``; the ``kind="random"`` count stays 0 by
-        construction.  Warm-start models from the previous fit can be grown
+        device data is whole-dataset and is dropped for a lazy rebuild on
+        the next fit, counted as ``kind="fixed"`` — but the rebuild pads to
+        an amortized-doubling ROW CAPACITY (weight-0 pad rows), so while
+        growth fits the previous capacity the batch shape is unchanged and
+        the compiled solve programs stay hot; the ``kind="random"`` count
+        stays 0 by construction.  Warm-start models from the previous fit can be grown
         to the merged vocabulary on device with
         :meth:`~photon_tpu.game.model.RandomEffectModel.with_entities`.
 
@@ -445,6 +455,22 @@ class GameEstimator:
                         dd.dataset.num_entities - before
                     )
                 else:
+                    # Record the amortized-doubling row capacity the lazy
+                    # rebuild will pad to: while the grown row count still
+                    # fits the previous capacity the rebuilt batch keeps
+                    # its exact shape (weight-0 pad rows), so the solve
+                    # programs compiled against it stay hot; past capacity,
+                    # double (at least) so growth pays a recompile only
+                    # O(log n) times.
+                    from photon_tpu.utils import pow2_at_least
+
+                    need = int(data.num_examples)
+                    prev = self._fixed_row_capacity.get(
+                        key, int(dd.batch.num_examples)
+                    )
+                    if need > prev:
+                        prev = max(pow2_at_least(need), 2 * prev)
+                    self._fixed_row_capacity[key] = prev
                     del self._device_data_cache[key]
                     self.telemetry.counter(
                         "estimator.device_data_rebuilds", kind="fixed"

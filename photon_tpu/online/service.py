@@ -130,12 +130,18 @@ class OnlineLearningService:
         policy: Optional[RefreshPolicy] = None,
         telemetry=None,
         logger=None,
+        model_id: Optional[str] = None,
     ):
         self.estimator = estimator
         self.configuration = configuration
         self.feed = feed
         self.model = model
         self.fleet = fleet
+        # Multi-model arena fleets: which tenant slice this service's
+        # refreshes publish INTO (None = the fleet's default model — the
+        # single-model shape).  Each refresh then rolls out as a
+        # slice-scatter swap of that tenant only.
+        self.model_id = model_id
         self.checkpoint_dir = checkpoint_dir
         self.policy = policy or RefreshPolicy()
         self.telemetry = telemetry or NULL_SESSION
@@ -417,10 +423,17 @@ class OnlineLearningService:
                     "no replica exposes a request spec to probe with"
                 )
             probes = [probe_request_for(model, spec)]
+        rollout_kwargs = {}
+        # getattr: _publish is duck-typed (tests drive it with a bare
+        # namespace standing in for the service).
+        model_id = getattr(self, "model_id", None)
+        if model_id is not None:
+            rollout_kwargs["model_id"] = model_id
         observer = getattr(self.fleet, "observer", None)
         if observer is None:
             self.fleet.rollout(
                 model, probe_requests=probes, parity_tol=parity_tol,
+                **rollout_kwargs,
             )
             return
         # Traced publish: refresh -> canary -> swap becomes ONE linked
@@ -444,6 +457,7 @@ class OnlineLearningService:
             with activate_trace(span.context()):
                 self.fleet.rollout(
                     model, probe_requests=probes, parity_tol=parity_tol,
+                    **rollout_kwargs,
                 )
             span.finish()
         except BaseException:

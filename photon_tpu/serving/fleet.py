@@ -28,6 +28,7 @@ fetches device data itself.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -105,6 +106,8 @@ class ServingFleet:
         spawn_timeout_s: float = 120.0,
         table_capacity_factor: int = 1,
         table_dtype: str = "f32",
+        models: Optional[Dict[str, object]] = None,
+        reserve_rows: int = 0,
     ):
         from photon_tpu.game.lowp import check_dtype
         from photon_tpu.telemetry import NULL_SESSION
@@ -114,6 +117,19 @@ class ServingFleet:
         if backend not in ("thread", "subprocess"):
             raise ValueError(f"unknown replica backend {backend!r} "
                              "(thread | subprocess)")
+        # Multi-model arena fleet (ISSUE 18): ``models`` maps tenant id ->
+        # GameModel; every replica hosts ALL of them in one shared arena
+        # behind one compiled bucket ladder, and requests route by their
+        # ``model`` field.  ``model`` (positional) may be None then; the
+        # first hosted model becomes the default tenant.
+        self.models: Optional[Dict[str, object]] = (
+            dict(models) if models else None
+        )
+        self._reserve_rows = int(reserve_rows)
+        if self.models and model is None:
+            model = next(iter(self.models.values()))
+        if self.models is not None and not self.models:
+            raise ValueError("models= needs at least one hosted model")
         self.model = model
         self.backend = backend
         # Fleet-wide gather-table storage tier (ISSUE 17): every replica
@@ -146,7 +162,13 @@ class ServingFleet:
                 workdir = tempfile.mkdtemp(prefix="photon-fleet-")
                 self._workdir_owned = True
             self._store = ModelStore(workdir)
-            self._store.publish(model)  # the v0 shared artifact
+            if self.models:
+                self._store.keep = max(self._store.keep,
+                                       len(self.models) + 2)
+                for m in self.models.values():
+                    self._store.publish(m)
+            else:
+                self._store.publish(model)  # the v0 shared artifact
             spec = request_spec or request_spec_for_model(model)
             try:
                 for i in range(int(replicas)):
@@ -162,6 +184,8 @@ class ServingFleet:
                             child_env=env, spawn_timeout_s=spawn_timeout_s,
                             table_capacity_factor=table_capacity_factor,
                             table_dtype=self.table_dtype,
+                            models=self.models,
+                            reserve_rows=self._reserve_rows,
                         )
                     )
             except BaseException:
@@ -181,17 +205,33 @@ class ServingFleet:
         else:
             meshes = _replica_meshes(int(replicas), mesh, devices)
             for i in range(int(replicas)):
-                scorer = GameScorer(
-                    model,
-                    mesh=meshes[i],
-                    request_spec=request_spec,
-                    buckets=buckets,
-                    max_batch=max_batch,
-                    min_bucket=min_bucket,
-                    telemetry=self.telemetry,
-                    table_capacity_factor=table_capacity_factor,
-                    table_dtype=self.table_dtype,
-                )
+                if self.models:
+                    from photon_tpu.serving.arena import MultiModelScorer
+
+                    scorer = MultiModelScorer(
+                        self.models,
+                        mesh=meshes[i],
+                        request_spec=request_spec,
+                        buckets=buckets,
+                        max_batch=max_batch,
+                        min_bucket=min_bucket,
+                        telemetry=self.telemetry,
+                        table_capacity_factor=table_capacity_factor,
+                        table_dtype=self.table_dtype,
+                        reserve_rows=self._reserve_rows,
+                    )
+                else:
+                    scorer = GameScorer(
+                        model,
+                        mesh=meshes[i],
+                        request_spec=request_spec,
+                        buckets=buckets,
+                        max_batch=max_batch,
+                        min_bucket=min_bucket,
+                        telemetry=self.telemetry,
+                        table_capacity_factor=table_capacity_factor,
+                        table_dtype=self.table_dtype,
+                    )
                 self.replicas.append(
                     ScorerReplica(
                         f"r{i}", scorer,
@@ -240,12 +280,52 @@ class ServingFleet:
         return sum(r.scorer.compilations for r in self.replicas)
 
     def submit(self, request: ScoringRequest,
-               deadline_s: Optional[float] = None):
+               deadline_s: Optional[float] = None,
+               model: Optional[str] = None):
+        """Admit one request.  ``model`` stamps a tenant id onto it (a
+        convenience for callers that route per call instead of building
+        requests with ``model=`` set); a multi-model fleet scores it
+        against that tenant's arena slice."""
+        if model is not None:
+            request = dataclasses.replace(request, model=model)
         return self.router.submit(request, deadline_s=deadline_s)
 
     def score(self, request: ScoringRequest,
-              deadline_s: Optional[float] = None):
-        return self.submit(request, deadline_s=deadline_s).result()
+              deadline_s: Optional[float] = None,
+              model: Optional[str] = None):
+        return self.submit(request, deadline_s=deadline_s,
+                           model=model).result()
+
+    # -- multi-model lifecycle -----------------------------------------------
+    def add_model(self, model_id: str, model) -> None:
+        """Onboard a tenant fleet-wide under live traffic: each replica's
+        arena takes the new model as a slice scatter (zero recompiles
+        unless the arena grows); in-flight batches finish on the tables
+        they captured — zero requests dropped."""
+        if self.models is None:
+            raise RuntimeError(
+                "add_model needs a multi-model fleet (pass models= at "
+                "construction)"
+            )
+        with self._publish_lock:
+            for replica in self.replicas:
+                if replica.alive:
+                    replica.scorer.add_model(model_id, model)
+            with self._model_lock:
+                self.models[model_id] = model
+
+    def retire_model(self, model_id: str) -> None:
+        """Retire a tenant fleet-wide: its rows stay in place (unreachable
+        via routing) until the free extents are reused; requests still
+        naming it shed with a KeyError."""
+        if self.models is None:
+            raise RuntimeError("retire_model needs a multi-model fleet")
+        with self._publish_lock:
+            for replica in self.replicas:
+                if replica.alive:
+                    replica.scorer.retire_model(model_id)
+            with self._model_lock:
+                self.models.pop(model_id, None)
 
     def current_model(self) -> Tuple[object, int]:
         """The model the fleet serves NOW and its monotonic version — the
@@ -281,17 +361,27 @@ class ServingFleet:
             from photon_tpu.game.lowp import parity_tol_for
 
             kwargs["parity_tol"] = parity_tol_for(self.table_dtype)
+        model_id = kwargs.get("model_id")
         with self._publish_lock:
             with self._model_lock:
                 previous_model = self.model
-                self.model = model
+                previous_slice = None
+                if model_id is None:
+                    self.model = model
+                elif self.models is not None:
+                    previous_slice = self.models.get(model_id)
+                    self.models[model_id] = model
                 self._model_version += 1
                 self._rolling += 1
             try:
                 self.router.rollout(model, **kwargs)
             except BaseException:
                 with self._model_lock:
-                    self.model = previous_model
+                    if model_id is None:
+                        self.model = previous_model
+                    elif (self.models is not None
+                            and previous_slice is not None):
+                        self.models[model_id] = previous_slice
                     # The version stays MONOTONIC: the rollback is itself
                     # a new published state.  Restoring the old number
                     # would let a later rollout reuse it and defeat the
@@ -306,8 +396,12 @@ class ServingFleet:
                 # the supervisor's fleet-rollback target (a post-swap
                 # fleet-wide known-answer parity regression rolls back to
                 # it instead of quarantining every replica — ROADMAP
-                # fleet edge (d)).
-                self._previous_model = previous_model
+                # fleet edge (d)).  A per-tenant rollout leaves the
+                # DEFAULT-model rollback target alone — the fleet-wide
+                # known-answer probe runs against the default model, and
+                # its rollback must not revert an unrelated slice.
+                if model_id is None:
+                    self._previous_model = previous_model
                 self._stamp_served_version()
 
     def _stamp_served_version(self) -> None:

@@ -342,20 +342,23 @@ class FleetObserver:
             self._last_eval = now
             self.slo_monitor.evaluate()
 
-    def on_shed(self, reason: str, rows: int, span=None) -> None:
+    def on_shed(self, reason: str, rows: int, span=None,
+                model: Optional[str] = None) -> None:
         if span is not None:
             span.event("shed", reason=reason)
             span.finish(status="shed")
             self.collector.add(span)
         self._record_event(status="shed", reason=reason, rows=rows,
-                           replica=None, version=None, latency_s=None)
+                           replica=None, version=None, latency_s=None,
+                           model=model)
         self.slo_monitor.observe_request("shed", None)
         self._maybe_evaluate()
 
     def on_done(self, status: str, latency_s: Optional[float], rows: int,
-                replica_id: Optional[str], version=None) -> None:
+                replica_id: Optional[str], version=None,
+                model: Optional[str] = None) -> None:
         self._record_event(status=status, latency_s=latency_s, rows=rows,
-                           replica=replica_id, version=version)
+                           replica=replica_id, version=version, model=model)
         self.slo_monitor.observe_request(status, latency_s)
         self._maybe_evaluate()
 
@@ -508,9 +511,12 @@ class FleetObserver:
 
     # -- the live plane --------------------------------------------------------
     def fleet_snapshot(self) -> dict:
-        """Fleet-level live aggregates over the sliding window, per model
-        version: QPS, p50/p99 latency, shed rate — plus the merged child
-        histogram (device-side compute seconds) and current SLO state."""
+        """Fleet-level live aggregates over the sliding window, grouped per
+        model version AND per tenant model id: QPS, p50/p99 latency, shed
+        rate — plus the merged child histogram (device-side compute
+        seconds) and current SLO state.  The per-model grouping is what a
+        multi-tenant arena's isolation claims are checked against: tenant
+        A's storm shows up in A's shed rate, not B's."""
         now = time.monotonic()
         cut = now - self.policy.window_s
         with self._events_lock:
@@ -518,45 +524,50 @@ class FleetObserver:
         span_s = self.policy.window_s
         if window:
             span_s = min(span_s, max(now - window[0]["t"], 1e-3))
-        by_version: dict = {}
-        for e in window:
-            key = str(e.get("version"))
-            g = by_version.setdefault(
-                key, {"ok": 0, "shed": 0, "error": 0, "rows": 0,
-                      "latencies": []}
-            )
-            status = e.get("status", "ok")
-            g[status if status in g else "error"] += 1
-            g["rows"] += int(e.get("rows") or 0)
-            if e.get("latency_s") is not None:
-                g["latencies"].append(float(e["latency_s"]))
-        versions = {}
-        for key, g in sorted(by_version.items()):
-            lat = sorted(g["latencies"])
 
-            def pct(p):
-                if not lat:
-                    return None
-                return lat[min(len(lat) - 1,
-                               max(0, round(p * (len(lat) - 1))))]
+        def _aggregate(group_key: str) -> dict:
+            groups: dict = {}
+            for e in window:
+                key = str(e.get(group_key))
+                g = groups.setdefault(
+                    key, {"ok": 0, "shed": 0, "error": 0, "rows": 0,
+                          "latencies": []}
+                )
+                status = e.get("status", "ok")
+                g[status if status in g else "error"] += 1
+                g["rows"] += int(e.get("rows") or 0)
+                if e.get("latency_s") is not None:
+                    g["latencies"].append(float(e["latency_s"]))
+            out = {}
+            for key, g in sorted(groups.items()):
+                lat = sorted(g["latencies"])
 
-            total = g["ok"] + g["shed"] + g["error"]
-            versions[key] = {
-                "qps": g["ok"] / span_s,
-                "rows_per_s": g["rows"] / span_s,
-                "p50_s": pct(0.50),
-                "p99_s": pct(0.99),
-                "shed_rate": g["shed"] / total if total else 0.0,
-                "error_rate": g["error"] / total if total else 0.0,
-                "requests": total,
-            }
+                def pct(p):
+                    if not lat:
+                        return None
+                    return lat[min(len(lat) - 1,
+                                   max(0, round(p * (len(lat) - 1))))]
+
+                total = g["ok"] + g["shed"] + g["error"]
+                out[key] = {
+                    "qps": g["ok"] / span_s,
+                    "rows_per_s": g["rows"] / span_s,
+                    "p50_s": pct(0.50),
+                    "p99_s": pct(0.99),
+                    "shed_rate": g["shed"] / total if total else 0.0,
+                    "error_rate": g["error"] / total if total else 0.0,
+                    "requests": total,
+                }
+            return out
+
         merged_child = MergeableHistogram.merged(
             list(self._child_hists.values())
         )
         return {
             "at": time.time(),
             "window_s": span_s,
-            "versions": versions,
+            "versions": _aggregate("version"),
+            "models": _aggregate("model"),
             "child_compute": {
                 "p50_s": merged_child.quantile(0.50),
                 "p99_s": merged_child.quantile(0.99),

@@ -87,6 +87,7 @@ from photon_tpu.telemetry.distributed import (
     FlightRecorder,
     MergeableHistogram,
     SpanRecord,
+    shift_span_times,
     trace_of,
 )
 
@@ -402,6 +403,11 @@ class _ChildService:
                     out = pack_control(
                         "pong", version=self.version, pid=os.getpid(),
                         compilations=self.scorer.compilations,
+                        # Clock-offset estimation: the child's wall clock,
+                        # sampled mid-exchange — the parent subtracts the
+                        # RTT midpoint to estimate this host's skew and
+                        # de-skews child span timestamps before merging.
+                        child_time=time.time(),
                     )
                 elif kind == "stats":
                     # Deliberately NOT behind maybe_fault: a stats pull is
@@ -420,8 +426,14 @@ class _ChildService:
                 elif kind == "swap":
                     header = unpack_control(payload)
                     model, version = load_model_artifact(header["path"])
+                    model_id = header.get("model_id")
                     with self.lock:
-                        self.scorer.swap_model(model)
+                        if model_id is None:
+                            self.scorer.swap_model(model)
+                        else:
+                            # Multi-model arena child: replace ONE tenant
+                            # slice; every other hosted model is untouched.
+                            self.scorer.swap_model(model, model_id=model_id)
                         self.version = version
                     out = pack_control("ok", version=version)
                 elif kind == "shutdown":
@@ -448,7 +460,9 @@ def _child_main(argv=None) -> None:
     import socketserver
 
     p = argparse.ArgumentParser("photon_tpu.serving.replica_proc")
-    p.add_argument("--artifact", required=True)
+    # Optional when the config carries a multi-model "models" map (each
+    # tenant then names its own artifact path).
+    p.add_argument("--artifact", default=None)
     p.add_argument("--ready-file", required=True)
     p.add_argument("--config", required=True, help="JSON replica config")
     args = p.parse_args(argv)
@@ -471,7 +485,6 @@ def _child_main(argv=None) -> None:
     from photon_tpu.serving.scorer import GameScorer
     from photon_tpu.telemetry import TelemetrySession
 
-    model, version = load_model_artifact(args.artifact)
     spec = {
         shard: ShardSpec(kind=s["kind"], dim=int(s["dim"]),
                          nnz=int(s.get("nnz", 0)))
@@ -481,16 +494,39 @@ def _child_main(argv=None) -> None:
     # travel to the parent via the stats frame — never written to disk
     # here (the parent's run report is the one report of the fleet).
     session = TelemetrySession(f"replica-{cfg['replica_id']}")
-    scorer = GameScorer(
-        model,
-        request_spec=spec,
-        buckets=tuple(cfg["buckets"]) if cfg.get("buckets") else None,
-        max_batch=int(cfg["max_batch"]),
-        min_bucket=int(cfg["min_bucket"]),
-        telemetry=session,
-        table_capacity_factor=int(cfg.get("table_capacity_factor", 1)),
-        table_dtype=cfg.get("table_dtype", "f32"),
-    ).warmup()
+    if cfg.get("models"):
+        # Multi-model arena child: every hosted tenant loads from its own
+        # artifact into ONE shared arena + ONE compiled bucket ladder.
+        from photon_tpu.serving.arena import MultiModelScorer
+
+        loaded, version = {}, 0
+        for mid, path in cfg["models"].items():
+            m, v = load_model_artifact(path)
+            loaded[mid] = m
+            version = max(version, v)
+        scorer = MultiModelScorer(
+            loaded,
+            request_spec=spec,
+            buckets=tuple(cfg["buckets"]) if cfg.get("buckets") else None,
+            max_batch=int(cfg["max_batch"]),
+            min_bucket=int(cfg["min_bucket"]),
+            telemetry=session,
+            table_capacity_factor=int(cfg.get("table_capacity_factor", 1)),
+            table_dtype=cfg.get("table_dtype", "f32"),
+            reserve_rows=int(cfg.get("reserve_rows", 0)),
+        ).warmup()
+    else:
+        model, version = load_model_artifact(args.artifact)
+        scorer = GameScorer(
+            model,
+            request_spec=spec,
+            buckets=tuple(cfg["buckets"]) if cfg.get("buckets") else None,
+            max_batch=int(cfg["max_batch"]),
+            min_bucket=int(cfg["min_bucket"]),
+            telemetry=session,
+            table_capacity_factor=int(cfg.get("table_capacity_factor", 1)),
+            table_dtype=cfg.get("table_dtype", "f32"),
+        ).warmup()
     service = _ChildService(cfg["replica_id"], scorer, version,
                             telemetry=session,
                             flight_path=cfg.get("flight_path"))
@@ -566,12 +602,20 @@ class _RemoteScorer:
                  buckets, max_batch: int, min_bucket: int,
                  port: int, compilations: int, telemetry=None,
                  timeout_s: float = 300.0, span_sink=None,
-                 table_dtype: str = "f32"):
+                 table_dtype: str = "f32", models: Optional[Dict] = None):
         from photon_tpu.telemetry import NULL_SESSION
 
         self.replica_id = replica_id
         self.model = model
+        # Multi-model arena child: the hosted tenant map (id -> model),
+        # mirrored parent-side so a respawn can rebuild the same arena and
+        # a per-tenant rollout can read the old slice for rollback.
+        self.models: Optional[Dict] = dict(models) if models else None
         self.version = version
+        # Estimated child-minus-parent wall-clock offset (EWMA over ping
+        # RTT midpoints) — applied to child span timestamps before they
+        # merge into the parent's trace tree.
+        self.clock_offset_s = 0.0
         # Mirrors the child scorer's storage tier so parent-side parity
         # gates (router canary histogram, fleet defaults) see one surface.
         self.table_dtype = str(table_dtype)
@@ -639,22 +683,39 @@ class _RemoteScorer:
                 pass
         return scores
 
-    def swap_model(self, model) -> None:
+    def model_for(self, model_id: str):
+        """The hosted model behind one tenant id (multi-model children):
+        what a per-tenant rollout reads for its rollback slice."""
+        if self.models is None or model_id not in self.models:
+            raise KeyError(f"model {model_id!r} is not hosted on replica "
+                           f"{self.replica_id}")
+        return self.models[model_id]
+
+    def swap_model(self, model, model_id: Optional[str] = None) -> None:
         """Hot-swap the CHILD to a newer model: publish the shared
         artifact (cached per model object — one file serves every replica
         of the fleet) and instruct the child over the control connection.
         The child's scorer does the capacity-headroom swap — zero child
-        recompiles, same refusal semantics as a thread replica."""
+        recompiles, same refusal semantics as a thread replica.
+        ``model_id`` targets one tenant slice of a multi-model child; the
+        other hosted models are untouched."""
         path, version = self._store.publish(model)
+        frame = {"path": path, "version": version}
+        if model_id is not None:
+            frame["model_id"] = model_id
         with self._ctrl_lock:
-            write_frame(self._ctrl, pack_control("swap", path=path,
-                                                 version=version))
+            write_frame(self._ctrl, pack_control("swap", **frame))
             header = unpack_control(read_frame(self._ctrl))
         if header.get("kind") != "ok":
             raise TransportError(
                 f"swap refused: unexpected reply {header.get('kind')!r}"
             )
-        self.model = model
+        if model_id is not None and self.models is not None:
+            self.models[model_id] = model
+        if model_id is None:
+            self.model = model
+            if self.models is not None and self.models:
+                self.models[next(iter(self.models))] = model
         self.version = version
 
     # -- supervision ----------------------------------------------------------
@@ -662,13 +723,29 @@ class _RemoteScorer:
         """Liveness ping frame with a hard deadline: the exchange runs
         under the watchdog's ``call_with_timeout``, so a wedged child
         surfaces as a retriable stall timeout — the probe-timeout path the
-        supervisor treats exactly like a crash."""
+        supervisor treats exactly like a crash.
+
+        Each pong doubles as a clock-offset sample: the child echoes its
+        wall clock, and ``child_time - (t_send + t_recv)/2`` estimates
+        this child's skew (the RTT-midpoint trick — symmetric-path NTP).
+        An EWMA smooths jitter; the offset de-skews child span timestamps
+        before trace merge, so a skewed host cannot misorder hops."""
         from photon_tpu.fault.watchdog import call_with_timeout
 
         def exchange():
             with self._ctrl_lock:
+                t_send = time.time()
                 write_frame(self._ctrl, pack_control("ping"))
-                return unpack_control(read_frame(self._ctrl))
+                header = unpack_control(read_frame(self._ctrl))
+                t_recv = time.time()
+            child_time = header.get("child_time")
+            if isinstance(child_time, (int, float)):
+                sample = float(child_time) - (t_send + t_recv) / 2.0
+                self.clock_offset_s = (
+                    sample if self.clock_offset_s == 0.0
+                    else 0.8 * self.clock_offset_s + 0.2 * sample
+                )
+            return header
 
         return call_with_timeout(
             exchange, deadline_s, site=f"replica:{self.replica_id}:ping"
@@ -753,7 +830,11 @@ class SubprocessReplica(ScorerReplica):
         spawn_timeout_s: float = 120.0,
         table_capacity_factor: int = 1,
         table_dtype: str = "f32",
+        models: Optional[Dict] = None,
+        reserve_rows: int = 0,
     ):
+        self._models = dict(models) if models else None
+        self._reserve_rows = int(reserve_rows)
         self._store = store
         self._request_spec = dict(request_spec)
         self._buckets = buckets
@@ -784,7 +865,23 @@ class SubprocessReplica(ScorerReplica):
         the ``replica:spawn`` fault site (retriable: the supervisor backs
         off and retries a failed spawn)."""
         fault_point("replica:spawn", replica=self._replica_id)
-        artifact, version = self._store.publish(model)
+        model_paths = None
+        if self._models:
+            # The store's eviction horizon must cover every hosted tenant
+            # plus an in-flight rollout's predecessor — N live artifacts,
+            # not the single-model "current + previous" default.
+            self._store.keep = max(self._store.keep, len(self._models) + 2)
+            # Multi-model arena child: one shared artifact PER tenant
+            # (each cached per model object — untouched tenants re-use
+            # their published file across respawns).
+            model_paths, version = {}, 0
+            for mid, m in self._models.items():
+                path, v = self._store.publish(m)
+                model_paths[mid] = path
+                version = max(version, v)
+            artifact = next(iter(model_paths.values()))
+        else:
+            artifact, version = self._store.publish(model)
         ready_path = os.path.join(
             self._store.workdir,
             f"{self._replica_id}-ready-{os.getpid()}-{time.monotonic_ns()}"
@@ -802,6 +899,8 @@ class SubprocessReplica(ScorerReplica):
             "table_capacity_factor": self._table_capacity_factor,
             "table_dtype": self._table_dtype,
             "flight_path": self.flight_path,
+            "models": model_paths,
+            "reserve_rows": self._reserve_rows,
         }
         env = dict(os.environ)
         env.update(self.child_env)
@@ -851,13 +950,17 @@ class SubprocessReplica(ScorerReplica):
             self._min_bucket, port=int(ready["port"]),
             compilations=int(ready.get("compilations", 0)),
             telemetry=telemetry, span_sink=self._deliver_spans,
-            table_dtype=self._table_dtype,
+            table_dtype=self._table_dtype, models=self._models,
         )
 
     def _deliver_spans(self, spans: list) -> None:
         sink = self.span_sink
         if sink is not None:
-            sink(spans)
+            # De-skew the child's wall-clock timestamps onto the parent's
+            # clock before they merge into the trace tree (the ping-RTT
+            # offset estimate — ROADMAP observability edge (a)).
+            offset = getattr(self.scorer, "clock_offset_s", 0.0)
+            sink(shift_span_times(spans, offset))
 
     def poll_exit(self) -> Optional[int]:
         return None if self._proc is None else self._proc.poll()
@@ -884,9 +987,15 @@ class SubprocessReplica(ScorerReplica):
         router reroutes it), reap the dead child, spawn a FRESH child from
         the fleet's current model artifact (re-warmed at boot), and attach
         a fresh batcher.  Dispatch resumes only after ``router.revive()``
-        — the canary-gated rejoin."""
+        — the canary-gated rejoin.  A multi-model replica respawns its
+        whole hosted set (``self._models`` tracks per-tenant swaps)."""
         self.abandon_for_respawn()
         self.kill_backend()
+        if self._models:
+            # Carry per-tenant swaps that landed on the old child forward.
+            old = getattr(self.scorer, "models", None)
+            if old:
+                self._models = dict(old)
         model = model if model is not None else self.scorer.model
         self.scorer = self._spawn(model, telemetry=self.telemetry)
         self.attach_fresh_batcher()
@@ -895,7 +1004,10 @@ class SubprocessReplica(ScorerReplica):
         return self.scorer.ping(deadline_s)
 
     def pull_spans(self, deadline_s: float = 5.0) -> list:
-        return self.scorer.pull_spans(deadline_s)
+        spans = self.scorer.pull_spans(deadline_s)
+        return shift_span_times(
+            spans, getattr(self.scorer, "clock_offset_s", 0.0)
+        )
 
     def pull_stats(self, deadline_s: float = 5.0) -> dict:
         """Pull the child's scorer-level ``serving.*`` counters and merge

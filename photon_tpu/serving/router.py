@@ -73,8 +73,9 @@ class RequestShedError(RuntimeError):
     scored).  ``reason`` is the shed bucket: ``deadline`` (already past
     its deadline at arrival), ``overload`` (queue-wait projection blows
     the deadline), ``queue_full`` (hard per-replica depth cap),
-    ``no_replica`` (every replica dead), or ``closed`` (the router is
-    shutting down)."""
+    ``tenant_budget`` (the request's tenant is at its per-tenant queued-
+    rows budget — other tenants keep admitting), ``no_replica`` (every
+    replica dead), or ``closed`` (the router is shutting down)."""
 
     def __init__(self, reason: str, detail: str = ""):
         super().__init__(detail or f"request shed ({reason})")
@@ -380,6 +381,12 @@ class AdmissionPolicy:
 
     ``max_queue_rows`` — hard per-replica depth cap (rows); a request that
     would push the least-loaded replica past it sheds ``queue_full``.
+    ``tenant_queue_rows`` — per-TENANT in-flight rows budget (tenant = the
+    request's model id; unrouted requests share one default-tenant
+    budget).  One tenant's cold-start storm saturates its OWN budget and
+    sheds ``tenant_budget`` long before the global ``max_queue_rows``
+    cap, so the other tenants' traffic keeps admitting (ISSUE 18
+    admission isolation).  None disables the per-tenant gate.
     ``default_deadline_s`` — deadline budget applied to requests submitted
     without one (None = no deadline, never shed on time).
     ``safety`` — multiplier on the queue-wait projection before comparing
@@ -387,15 +394,25 @@ class AdmissionPolicy:
     ``ewma_alpha`` — smoothing of the per-row service-time estimate."""
 
     max_queue_rows: Optional[int] = None
+    tenant_queue_rows: Optional[int] = None
     default_deadline_s: Optional[float] = None
     safety: float = 1.0
     ewma_alpha: float = 0.25
 
 
+def request_tenant(request: ScoringRequest) -> str:
+    """The admission-budget tenant of one request: its scalar model id,
+    or the shared default tenant for unrouted (or per-row mixed — those
+    never reach admission, coalescing happens after) requests."""
+    model = getattr(request, "model", None)
+    return model if isinstance(model, str) else "__default__"
+
+
 class _Entry:
     __slots__ = ("request", "future", "rows", "deadline_at", "attempts",
                  "dispatched_at", "pending_before", "padded",
-                 "padded_before", "projected_wait", "span", "admitted_at")
+                 "padded_before", "projected_wait", "span", "admitted_at",
+                 "tenant", "budget_held")
 
     def __init__(self, request: ScoringRequest, deadline_at: Optional[float]):
         self.request = request
@@ -412,6 +429,11 @@ class _Entry:
         # admission timestamp its end-to-end latency is measured from.
         self.span = None
         self.admitted_at = 0.0
+        # Per-tenant budget accounting: held from admission until the
+        # request reaches a TERMINAL resolution (rerouting keeps holding
+        # it — the rows are still in flight somewhere).
+        self.tenant = request_tenant(request)
+        self.budget_held = False
 
 
 class FleetRouter:
@@ -448,6 +470,10 @@ class FleetRouter:
         # per request — the untraced hot path stays untraced.
         self.observer = None
         self._lock = threading.Lock()
+        # Live per-tenant in-flight row counts (tenant = model id) — the
+        # per-tenant admission budget's book; entries release exactly once
+        # at terminal resolution (_release_tenant is idempotent).
+        self._tenant_rows: dict = {}
         self._t0 = clock()
         # Recent admitted requests, mirrored to the canary as the rollout
         # parity probe's traffic sample.
@@ -464,11 +490,26 @@ class FleetRouter:
         return [r for r in self.replicas if r.alive]
 
     def _shed(self, reason: str, detail: str = "", span=None,
-              rows: int = 0) -> None:
+              rows: int = 0, model: Optional[str] = None) -> None:
         self.telemetry.counter("serving.shed", reason=reason).inc()
         if self.observer is not None:
-            self.observer.on_shed(reason, rows, span=span)
+            self.observer.on_shed(reason, rows, span=span, model=model)
         raise RequestShedError(reason, detail)
+
+    def _release_tenant(self, entry: _Entry) -> None:
+        """Return an entry's rows to its tenant's budget — exactly once,
+        at terminal resolution (success, terminal failure, or the
+        shutdown shed); rerouting keeps the hold, the rows are still in
+        flight somewhere."""
+        if not entry.budget_held:
+            return
+        entry.budget_held = False
+        with self._lock:
+            left = self._tenant_rows.get(entry.tenant, 0) - entry.rows
+            if left > 0:
+                self._tenant_rows[entry.tenant] = left
+            else:
+                self._tenant_rows.pop(entry.tenant, None)
 
     def submit(self, request: ScoringRequest,
                deadline_s: Optional[float] = None) -> Future:
@@ -478,6 +519,7 @@ class FleetRouter:
         span = (self.observer.maybe_start_span(request)
                 if self.observer is not None else None)
         rows = request.num_rows
+        tenant = request_tenant(request)
         if span is not None:
             span.event("enqueue", rows=rows)
         budget = (
@@ -488,7 +530,7 @@ class FleetRouter:
         healthy = self.healthy_replicas()
         if not healthy:
             self._shed("no_replica", "every replica is dead",
-                       span=span, rows=rows)
+                       span=span, rows=rows, model=tenant)
         replica = min(
             healthy, key=lambda r: (r.projected_wait_s(rows), r.pending_rows())
         )
@@ -498,23 +540,43 @@ class FleetRouter:
                 "queue_full",
                 f"least-loaded replica {replica.replica_id} is at "
                 f"{replica.pending_rows()} of {cap} queued rows",
-                span=span, rows=rows,
+                span=span, rows=rows, model=tenant,
             )
+        # The per-tenant gate sits BEFORE the deadline projection: a
+        # storming tenant must burn its own budget, not everyone's
+        # projection headroom.
+        tenant_cap = self.admission.tenant_queue_rows
+        if tenant_cap is not None:
+            with self._lock:
+                held = self._tenant_rows.get(tenant, 0)
+            if held + rows > tenant_cap:
+                self._shed(
+                    "tenant_budget",
+                    f"tenant {tenant!r} holds {held} of {tenant_cap} "
+                    "budgeted in-flight rows",
+                    span=span, rows=rows, model=tenant,
+                )
         if deadline_at is not None:
             if now >= deadline_at:
                 self._shed("deadline", "deadline already expired at arrival",
-                           span=span, rows=rows)
+                           span=span, rows=rows, model=tenant)
             wait = replica.projected_wait_s(rows) * self.admission.safety
             if now + wait > deadline_at:
                 self._shed(
                     "overload",
                     f"projected queue wait {wait * 1e3:.1f} ms blows the "
                     f"{(deadline_at - now) * 1e3:.1f} ms deadline budget",
-                    span=span, rows=rows,
+                    span=span, rows=rows, model=tenant,
                 )
         entry = _Entry(request, deadline_at)
         entry.span = span
         entry.admitted_at = now
+        if tenant_cap is not None:
+            with self._lock:
+                self._tenant_rows[tenant] = (
+                    self._tenant_rows.get(tenant, 0) + rows
+                )
+            entry.budget_held = True
         if span is not None:
             span.event("admit", replica=replica.replica_id)
         self.telemetry.counter("serving.admitted").inc()
@@ -557,8 +619,10 @@ class FleetRouter:
                 self.telemetry.counter("serving.shed", reason="closed").inc()
                 if self.observer is not None:
                     self.observer.on_shed("closed", entry.rows,
-                                          span=entry.span)
+                                          span=entry.span,
+                                          model=entry.tenant)
                     entry.span = None
+                self._release_tenant(entry)
                 entry.future.set_exception(
                     RequestShedError("closed", "router closed mid-dispatch")
                 )
@@ -629,13 +693,16 @@ class FleetRouter:
                 self.observer.on_done(
                     "ok", now - entry.admitted_at, entry.rows,
                     replica.replica_id, version=version,
+                    model=entry.tenant,
                 )
+            self._release_tenant(entry)
             entry.future.set_result(fut.result())
             return
         if isinstance(exc, ReplicaDeadError):
             self._replica_failed(entry, replica, exc)
             return
         self._finish_entry_span(entry, replica, status="error")
+        self._release_tenant(entry)
         entry.future.set_exception(exc)
 
     def _finish_entry_span(self, entry: _Entry, replica: ScorerReplica,
@@ -648,6 +715,7 @@ class FleetRouter:
             self.observer.on_done(
                 status, self.clock() - entry.admitted_at, entry.rows,
                 replica.replica_id, version=self._served_version(replica),
+                model=entry.tenant,
             )
 
     def _replica_failed(self, entry: _Entry, replica: ScorerReplica,
@@ -673,6 +741,7 @@ class FleetRouter:
             self._dispatch(entry, target)
             return
         self._finish_entry_span(entry, replica, status="error")
+        self._release_tenant(entry)
         entry.future.set_exception(
             NoHealthyReplicaError(
                 f"request could not be rerouted after replica "
@@ -750,6 +819,7 @@ class FleetRouter:
         parity_tol: float = 1e-3,
         probe_oracle: Optional[Callable] = None,
         probe_timeout_s: float = 30.0,
+        model_id: Optional[str] = None,
     ) -> None:
         """Staggered/canary ``swap_model`` across the fleet: ONE replica
         swaps first, a parity probe replays mirrored traffic through it
@@ -767,11 +837,21 @@ class FleetRouter:
         timeout, an oracle error) rolls it back the same way before
         propagating; a canary that DIES mid-probe is marked dead and the
         rollout restarts on the next healthy replica (the
-        mid-rollout-kill path)."""
+        mid-rollout-kill path).
+
+        ``model_id`` targets ONE tenant slice of a multi-model arena: the
+        swap replaces only that model's rows, probes are stamped with the
+        tenant id so the canary scores them against the swapped slice, and
+        every other hosted model keeps serving untouched."""
         oracle = probe_oracle or (
             lambda req: host_score_request(model, req)
         )
         probes = list(probe_requests) if probe_requests else list(self._mirror)
+        if model_id is not None:
+            # Stamp BEFORE span attach: replace() builds a new frozen
+            # request, which would drop spans attached to the old one.
+            probes = [dataclasses.replace(req, model=model_id)
+                      for req in probes]
         if not probes:
             raise ValueError(
                 "rollout has no traffic to probe the canary with: pass "
@@ -809,7 +889,7 @@ class FleetRouter:
         self._rollout_span = rspan
         try:
             self._run_rollout(model, oracle, probes, parity_tol,
-                              probe_timeout_s)
+                              probe_timeout_s, model_id)
             if rspan is not None:
                 rspan.finish()
         except BaseException:
@@ -824,7 +904,13 @@ class FleetRouter:
                 self.observer.collector.add(rspan)
 
     def _run_rollout(self, model, oracle, probes, parity_tol,
-                     probe_timeout_s) -> None:
+                     probe_timeout_s, model_id=None) -> None:
+        def _swap(scorer, new_model):
+            if model_id is None:
+                scorer.swap_model(new_model)
+            else:
+                scorer.swap_model(new_model, model_id=model_id)
+
         while True:
             healthy = self.healthy_replicas()
             if not healthy:
@@ -833,15 +919,21 @@ class FleetRouter:
                 )
             canary = healthy[0]
             self._mark_rollout(canary.replica_id, "canary")
-            old_model = canary.scorer.model
-            canary.scorer.swap_model(model)
+            if model_id is not None and hasattr(canary.scorer, "model_for"):
+                old_model = canary.scorer.model_for(model_id)
+            else:
+                old_model = canary.scorer.model
+            _swap(canary.scorer, model)
             # Per-codec parity histogram (ISSUE 17): every canary probe's
             # worst |delta| lands labeled with the served storage tier, so
             # the measured bound per dtype is an observable distribution,
             # not just a pass/fail gate.
             dtype = getattr(canary.scorer, "table_dtype", "f32")
+            labels = {"dtype": dtype}
+            if model_id is not None:
+                labels["model"] = model_id
             parity_hist = self.telemetry.histogram(
-                "serving.rollout_parity", dtype=dtype
+                "serving.rollout_parity", **labels
             )
             try:
                 futs = [canary.submit(req) for req in probes]
@@ -868,7 +960,7 @@ class FleetRouter:
                 # canary serving a model the rest of the fleet does not:
                 # roll it back before surfacing the failure.
                 if canary.alive:
-                    canary.scorer.swap_model(old_model)
+                    _swap(canary.scorer, old_model)
                 self._mark_rollout(canary.replica_id, "rolled_back")
                 raise
             self._mark_rollout(canary.replica_id, "probe_ok")
@@ -876,7 +968,7 @@ class FleetRouter:
                 if replica is canary or not replica.alive:
                     continue
                 try:
-                    replica.scorer.swap_model(model)
+                    _swap(replica.scorer, model)
                     self._mark_rollout(replica.replica_id, "promoted")
                 except Exception as e:
                     # The raw scorer's swap fails with its own error (a

@@ -123,11 +123,19 @@ class ScoringRequest:
     """One scoring request: per-shard feature rows, raw per-row entity keys
     for each id column a random coordinate joins on, and an optional
     per-row offset — a :class:`~photon_tpu.game.data.GameDataset` minus
-    labels/weights.  All arrays are host-side; the scorer owns placement."""
+    labels/weights.  All arrays are host-side; the scorer owns placement.
+
+    ``model`` routes the request in a MULTI-MODEL fleet (ISSUE 18): a
+    scalar model id (the whole request scores against one tenant), or —
+    after the batcher coalesces requests from different tenants — a
+    per-row id array.  ``None`` means the default model, which keeps every
+    single-model caller unchanged; a single-model :class:`GameScorer`
+    ignores the field entirely."""
 
     features: Dict[str, object]  # shard -> [n, d] dense | (ids, vals) sparse
     entity_ids: Dict[str, np.ndarray]  # id column -> [n] raw keys
     offset: Optional[np.ndarray] = None  # [n] float32
+    model: Optional[object] = None  # None | str | [n] object array
 
     @property
     def num_rows(self) -> int:
@@ -188,6 +196,16 @@ def request_from_dataset(data: GameDataset, model: GameModel) -> ScoringRequest:
     )
 
 
+def request_model_rows(model, n: int):
+    """One request's model-id routing as per-row values: ``None``/scalar
+    ids broadcast over the rows; a per-row array passes through.  The ONE
+    widening rule :func:`concat_requests` and the wire transport share."""
+    if model is None or isinstance(model, str):
+        return np.full(n, model, dtype=object)
+    # host-sync: ingest routing — caller-owned host id array.
+    return np.asarray(model, dtype=object)
+
+
 def slice_request(req: ScoringRequest, lo: int, hi: int) -> ScoringRequest:
     """Row window ``[lo, hi)`` of a request (oversize-batch chunking)."""
     def cut(leaf):
@@ -195,10 +213,16 @@ def slice_request(req: ScoringRequest, lo: int, hi: int) -> ScoringRequest:
             return tuple(a[lo:hi] for a in leaf)
         return leaf[lo:hi]
 
+    model = req.model
+    if model is not None and not isinstance(model, str):
+        # host-sync: request model-id routing vectors are host object
+        # arrays end to end — never device data.
+        model = np.asarray(model, dtype=object)[lo:hi]
     return ScoringRequest(
         features={k: cut(v) for k, v in req.features.items()},
         entity_ids={k: v[lo:hi] for k, v in req.entity_ids.items()},
         offset=None if req.offset is None else req.offset[lo:hi],
+        model=model,
     )
 
 
@@ -226,6 +250,21 @@ def concat_requests(requests: List[ScoringRequest]) -> ScoringRequest:
             # host-sync: request ingest — caller-owned host offsets.
             else np.asarray(r.offset, np.float32)
         )
+    # Model-id routing must survive coalescing: all-same scalars (the
+    # common single-tenant batch) stay scalar; any mix widens to a
+    # per-row id array the multi-model scorer resolves per row.
+    model = None
+    scalars = set()
+    for r in requests:
+        m = r.model
+        scalars.add(m if (m is None or isinstance(m, str)) else False)
+    if scalars != {None}:
+        if len(scalars) == 1 and False not in scalars:
+            model = next(iter(scalars))
+        else:
+            model = np.concatenate([
+                request_model_rows(r.model, r.num_rows) for r in requests
+            ])
     return ScoringRequest(
         features={k: cat(k) for k in first.features},
         entity_ids={
@@ -233,6 +272,7 @@ def concat_requests(requests: List[ScoringRequest]) -> ScoringRequest:
             for k in first.entity_ids
         },
         offset=np.concatenate(offsets),
+        model=model,
     )
 
 

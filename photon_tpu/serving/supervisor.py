@@ -176,7 +176,11 @@ class ReplicaSupervisor:
         self._noted: set = set()  # (replica_id, generation) deaths recorded
         self._deaths: Dict[str, deque] = {}
         self._attempts: Dict[str, Tuple[int, float]] = {}  # id -> (n, at)
-        self._probe_cache: Tuple = (None, None, None)  # (model, req, want)
+        # Per probed tenant: model_id (None = single-model fleet) ->
+        # (model, request, want).  A multi-model fleet rotates the probed
+        # tenant across passes, so the cache holds one oracle per tenant.
+        self._probe_cache: Dict[Optional[str], Tuple] = {}
+        self._probe_rr = 0  # round-robin cursor over hosted tenants
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -188,20 +192,37 @@ class ReplicaSupervisor:
             "serving.supervisor_step", replica=replica_id, phase=phase
         ).set(next(self._seq))
 
-    def _known_answer(self, model):
+    def _known_answer(self, model, model_id: Optional[str] = None):
         """``(request, want)`` for the health probe: a tiny SYNTHETIC
         request (deterministic, ``probe_rows`` rows — mirrored live
         requests can be max-batch sized, too heavy to score on host every
-        probe pass) with its host-oracle answer computed ONCE per model."""
-        cached_model, request, want = self._probe_cache
-        if cached_model is model:
-            return request, want
+        probe pass) with its host-oracle answer computed ONCE per model.
+        ``model_id`` stamps the probe for a multi-model fleet so the
+        replica scores it against that tenant's arena slice."""
+        cached = self._probe_cache.get(model_id)
+        if cached is not None and cached[0] is model:
+            return cached[1], cached[2]
         request = probe_request_for(
             model, self._request_spec(), rows=self.policy.probe_rows
         )
+        if model_id is not None:
+            request = dataclasses.replace(request, model=model_id)
         want = host_score_request(model, request)
-        self._probe_cache = (model, request, want)
+        self._probe_cache[model_id] = (model, request, want)
         return request, want
+
+    def _probe_target(self):
+        """Which model this pass's known-answer probe scores: a
+        single-model fleet probes THE model; a multi-model fleet rotates
+        the probed tenant across passes, so every hosted slice gets
+        periodic known-answer coverage without multiplying probe cost."""
+        model, version = self.fleet.current_model()
+        hosted = getattr(self.fleet, "models", None)
+        if hosted:
+            ids = list(hosted)
+            mid = ids[self._probe_rr % len(ids)]
+            return hosted[mid], mid, version
+        return model, None, version
 
     def _request_spec(self):
         for replica in self.router.replicas:
@@ -219,6 +240,7 @@ class ReplicaSupervisor:
         # (ROADMAP fleet edge (d); ISSUE 15 satellite).  Crash/hang causes
         # stay replica-local and declare immediately inside _health_check.
         parity: dict = {}
+        self._probe_rr += 1  # rotate the probed tenant once per pass
         for replica in self.router.replicas:
             if replica.quarantined:
                 continue
@@ -268,9 +290,9 @@ class ReplicaSupervisor:
         rollback = getattr(self.fleet, "rollback_to_previous", None)
         if rollback is None or not rollback(expected_version):
             return False
-        # The model changed: drop the cached probe oracle so the next pass
-        # probes against the restored artifact.
-        self._probe_cache = (None, None, None)
+        # The model changed: drop the cached probe oracles so the next
+        # pass probes against the restored artifact.
+        self._probe_cache = {}
         for replica in self.router.replicas:
             if replica.alive and not replica.quarantined:
                 self._mark(replica.replica_id, "fleet-rollback")
@@ -325,9 +347,10 @@ class ReplicaSupervisor:
             except (OSError, RuntimeError) as e:
                 self._declare(replica, "crash", f"ping failed: {e}")
                 return
-        # 4. Known-answer score probe vs the host oracle.
-        model, version = self.fleet.current_model()
-        request, want = self._known_answer(model)
+        # 4. Known-answer score probe vs the host oracle (rotated across
+        # hosted tenants on a multi-model fleet).
+        model, model_id, version = self._probe_target()
+        request, want = self._known_answer(model, model_id)
         try:
             got = replica.submit(request).result(
                 timeout=self.policy.probe_deadline_s
@@ -465,11 +488,27 @@ class ReplicaSupervisor:
             probes = self.router.recent_requests() or [
                 self._known_answer(model)[0]
             ]
+            hosted = getattr(self.fleet, "models", None)
             for request in probes:
+                # Per-tenant oracle: a mirrored request stamped with a
+                # tenant id must be checked against THAT tenant's model,
+                # not the fleet default.  Per-row-routed mirrors have no
+                # single oracle — skip them (the synthetic probe and
+                # scalar-routed mirrors cover the rejoin gate).
+                probe_model = model
+                req_mid = getattr(request, "model", None)
+                if req_mid is not None and not isinstance(req_mid, str):
+                    continue
+                if isinstance(req_mid, str) and hosted:
+                    probe_model = hosted.get(req_mid)
+                    if probe_model is None:
+                        continue  # tenant retired since the mirror
                 got = replica.submit(request).result(
                     timeout=self.policy.probe_deadline_s
                 )
-                worst = parity_worst(got, host_score_request(model, request))
+                worst = parity_worst(
+                    got, host_score_request(probe_model, request)
+                )
                 if worst > self.policy.parity_tol:
                     raise RejoinParityError(
                         f"rejoin probe off by {worst:.2e} "
@@ -478,9 +517,15 @@ class ReplicaSupervisor:
             # Model-version re-sync: a rollout may have published while
             # this replica was being resurrected — it must come back on
             # the model the fleet serves NOW, never the one it died on.
+            # A multi-model replica converges its whole hosted set (an
+            # add/retire/per-tenant swap may have landed mid-respawn).
             current, current_version = self.fleet.current_model()
             if current_version != version:
-                replica.scorer.swap_model(current)
+                sync = getattr(replica.scorer, "sync_models", None)
+                if hosted and sync is not None:
+                    sync(dict(hosted))
+                else:
+                    replica.scorer.swap_model(current)
             self.router.revive(replica)
             self._attempts.pop(rid, None)
             self._mark(rid, "rejoined")

@@ -36,10 +36,11 @@ offered-load model — sheds and deadline misses are the system's problem),
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import threading
 import time
 from concurrent.futures import wait as futures_wait
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -64,6 +65,32 @@ class TrafficSpec:
     target_qps: Optional[float] = None  # None = no arrival schedule
     deadline_ms: Optional[float] = None  # per-request budget (None = none)
     seed: int = 0
+    # A/B experiment splits over a multi-model fleet: arm (tenant model
+    # id) -> weight.  Each request's USER hashes to one arm —
+    # hash(seed:user) → [0,1) against the cumulative weights — so an
+    # entity sees one consistent model for the whole replay, assignment is
+    # deterministic under the seed, and the split never perturbs the rng
+    # stream (the PR 9 byte-exactness contract).  None = no splits.
+    splits: Optional[Dict[str, float]] = None
+
+
+def split_arm_for(seed: int, user_key, splits: Dict[str, float]) -> str:
+    """Deterministic hash-of-user arm assignment: the same (seed, user)
+    always lands the same arm, independent of request order and of every
+    other draw — re-running a replay reproduces the experiment exactly."""
+    if not splits:
+        raise ValueError("split_arm_for needs a non-empty splits map")
+    digest = hashlib.md5(f"{seed}:{user_key}".encode()).hexdigest()
+    u = int(digest, 16) / float(1 << 128)
+    total = float(sum(splits.values()))
+    if total <= 0:
+        raise ValueError("split weights must sum to a positive value")
+    acc = 0.0
+    for arm, weight in splits.items():
+        acc += float(weight) / total
+        if u < acc:
+            return arm
+    return arm  # float-roundoff tail lands in the last arm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,6 +99,7 @@ class TimedRequest:
     request: ScoringRequest
     deadline_s: Optional[float]
     kind: str  # "normal" | "storm"
+    arm: Optional[str] = None  # split arm (tenant model id), None = unsplit
 
 
 @dataclasses.dataclass(frozen=True)
@@ -160,6 +188,7 @@ def generate_traffic(data, model, spec: TrafficSpec) -> Traffic:
 
     sizes = geometric_sizes(n, spec.mean_rows, spec.max_rows, rng)
 
+    user_keys: List[object] = list(range(n))  # split-arm hash identities
     if spec.popularity == "geometric":
         # PR 9 compatibility stream: consecutive row windows.
         row_sets = []
@@ -188,6 +217,10 @@ def generate_traffic(data, model, spec: TrafficSpec) -> Traffic:
         weights = (rank_of + 1.0) ** -spec.alpha
         weights /= weights.sum()
         entities = rng.choice(len(uniq), size=n, p=weights)
+        # The request's USER is its drawn entity — the split-arm identity
+        # (cold-start storms keep the original user: a stormed request is
+        # still that user's traffic, just with unseen ids).
+        user_keys = [uniq[e] for e in entities]
         row_sets = []
         for e, size in zip(entities, sizes):
             mine = order[starts[e]: starts[e + 1]]
@@ -220,6 +253,15 @@ def generate_traffic(data, model, spec: TrafficSpec) -> Traffic:
             )
         requests.append(req)
 
+    arms: List[Optional[str]] = [None] * n
+    if spec.splits:
+        # Arm assignment AFTER every rng draw (pure hashing — the rng
+        # stream stays byte-exact with unsplit traffic); stamping replaces
+        # the frozen request with one routed at its arm's tenant model.
+        for i in range(n):
+            arms[i] = split_arm_for(spec.seed, user_keys[i], spec.splits)
+            requests[i] = dataclasses.replace(requests[i], model=arms[i])
+
     if spec.target_qps:
         duration = n / float(spec.target_qps)
         at = _arrival_times(n, duration, spec)
@@ -239,7 +281,7 @@ def generate_traffic(data, model, spec: TrafficSpec) -> Traffic:
     items = [
         TimedRequest(
             at_s=float(at[i]), request=requests[i], deadline_s=deadline_s,
-            kind="storm" if i in storm else "normal",
+            kind="storm" if i in storm else "normal", arm=arms[i],
         )
         for i in range(n)
     ]

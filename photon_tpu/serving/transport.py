@@ -172,11 +172,22 @@ def pack_request(request: ScoringRequest,
         entries.append(("col", col, request.entity_ids[col]))
     if request.offset is not None:
         entries.append(("offset", "", request.offset))
+    model = getattr(request, "model", None)
+    if model is not None and not isinstance(model, str):
+        # A coalesced mixed-tenant batch: per-row ids ride as a fixed-
+        # width string array (object arrays have no wire form).
+        # host-sync: model-id vectors live on host; this is a dtype cast.
+        entries.append(("model", "", np.asarray(model).astype(str)))
+        model = None
     header = {
         "v": 1, "kind": "score",
         "deadline_ms": None if deadline_s is None else deadline_s * 1e3,
         "_arrays": entries,
     }
+    if model is not None:
+        # Single-tenant request: the model id rides the header — the
+        # frame-level routing field (ISSUE 18).
+        header["model"] = model
     if seq is not None:
         header["seq"] = int(seq)
     ctx = trace_of(request)
@@ -199,6 +210,7 @@ def unpack_request_ex(
     sparse: Dict[str, dict] = {}
     entity_ids: Dict[str, np.ndarray] = {}
     offset = None
+    model = header.get("model")
     for entry, arr in zip(header.get("arrays", []), arrays):
         slot, name = entry["slot"], entry["name"]
         if slot == "feat":
@@ -209,6 +221,8 @@ def unpack_request_ex(
             entity_ids[name] = arr
         elif slot == "offset":
             offset = arr
+        elif slot == "model":
+            model = arr.astype(object)
         else:
             raise TransportError(f"unknown array slot {slot!r}")
     for name, pair in sparse.items():
@@ -217,7 +231,7 @@ def unpack_request_ex(
         features[name] = (pair["ids"], pair["vals"])
     deadline_ms = header.get("deadline_ms")
     request = ScoringRequest(features=features, entity_ids=entity_ids,
-                             offset=offset)
+                             offset=offset, model=model)
     ctx = TraceContext.from_wire(header.get("trace"))
     if ctx is not None:
         attach_trace(request, ctx)

@@ -56,7 +56,32 @@ __all__ = [
     "span_of",
     "activate_trace",
     "current_trace",
+    "shift_span_times",
 ]
+
+
+def shift_span_times(spans: List[dict], offset_s: float) -> List[dict]:
+    """Map child-process span timestamps onto the parent's clock before
+    merging trace trees.  ``offset_s`` is the estimated child-minus-parent
+    wall-clock offset (from control-connection ping RTT: the child's
+    ``pong`` echoes its ``time.time()``, and the parent estimates
+    ``offset = child_time - (t_send + t_recv) / 2``); subtracting it
+    de-skews ``start`` and every event ``t`` so a skewed host can no
+    longer misorder cross-process hops on the merged timeline.  Durations
+    are untouched — they were measured monotonically on the child and are
+    already skew-free.  Mutates and returns ``spans`` (the caller owns the
+    freshly-deserialized wire dicts)."""
+    if not offset_s:
+        return spans
+    for d in spans or []:
+        if not isinstance(d, dict):
+            continue
+        if isinstance(d.get("start"), (int, float)):
+            d["start"] = d["start"] - offset_s
+        for e in d.get("events") or []:
+            if isinstance(e, dict) and isinstance(e.get("t"), (int, float)):
+                e["t"] = e["t"] - offset_s
+    return spans
 
 _id_lock = threading.Lock()
 _id_counter = 0

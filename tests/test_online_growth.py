@@ -436,3 +436,109 @@ def test_onboard_rejects_layout_kind_mismatch_before_mutating():
     # Nothing mutated: same vocabulary object, same per-row map length.
     assert dd.dataset.keys is keys_before
     assert len(dd.dataset.entity_idx_per_row) == base.num_examples
+
+
+def test_fixed_batch_row_capacity_zero_recompiles_across_refresh():
+    """ISSUE 18 satellite: the fixed-effect training batch carries
+    row-capacity headroom (weight-0 pad rows, amortized doubling), so an
+    online refresh whose grown row count still fits the capacity rebuilds
+    the batch at the SAME padded shape — the solve programs compiled
+    against it stay hot (ZERO compile events on the refreshed train) —
+    and the pad rows are exact (the padded fit matches an unpadded one).
+
+    Pinned at the COORDINATE level: the descent loop's residual/validation
+    engines are sized off the true row count by design (their elementwise
+    kernels recompile cheaply per refresh); the expensive artifact this
+    satellite protects is the fixed-effect BATCH and its solve."""
+    import jax.monitoring
+    from jax._src import monitoring as monitoring_src
+
+    from photon_tpu.game.coordinate import (
+        FixedEffectCoordinate,
+        FixedEffectDeviceData,
+    )
+    from photon_tpu.utils import pow2_at_least
+
+    base = _dataset(30, seed=71, fixed=True)
+    g1 = _grown(base, seed=72)
+    g2 = _grown(g1, seed=73)
+    cfg = FixedEffectCoordinateConfig("global", _problem())
+    cap = max(pow2_at_least(g1.num_examples), 2 * base.num_examples)
+    assert g2.num_examples <= cap  # the refresh lands inside the headroom
+
+    def train_at(data, row_capacity):
+        dd = FixedEffectDeviceData(data, cfg, row_capacity=row_capacity)
+        coord = FixedEffectCoordinate(
+            data, cfg, "logistic_regression", device_data=dd
+        )
+        model, _ = coord.train(np.zeros(data.num_examples, np.float32))
+        return dd, model
+
+    dd1, _ = train_at(g1, cap)
+    assert dd1.batch.num_examples == cap
+    assert dd1.unpadded_n == g1.num_examples
+
+    events = []
+
+    def listener(event, **kwargs):
+        if "compile" in event:
+            events.append(event)
+
+    jax.monitoring.register_event_listener(listener)
+    try:
+        # The refresh: MORE rows, SAME capacity — same batch shape, so
+        # the rebuilt batch replays entirely against compiled programs.
+        dd2, padded = train_at(g2, cap)
+    finally:
+        monitoring_src._unregister_event_listener_by_callback(listener)
+    assert events == []
+    assert dd2.batch.num_examples == cap
+    assert dd2.unpadded_n == g2.num_examples
+
+    # Pad rows are weight-0 and therefore EXACT: the capacity-padded fit
+    # equals the unpadded fit on the same data.
+    _, unpadded = train_at(g2, None)
+    np.testing.assert_allclose(
+        np.asarray(padded.coefficients.means),
+        np.asarray(unpadded.coefficients.means),
+        atol=1e-5, rtol=0,
+    )
+
+
+def test_estimator_fixed_row_capacity_amortized_doubling():
+    """The estimator's capacity policy: the FIRST build is exact (no
+    padding — existing single-fit flows see unchanged shapes); the first
+    growth sets an amortized-doubled capacity; a later onboard that fits
+    rebuilds at the SAME capacity (the coordinate-level zero-recompile
+    contract above is what that buys)."""
+    base = _dataset(30, seed=71, fixed=True)
+    g1 = _grown(base, seed=72)
+    g2 = _grown(g1, seed=73)
+    config = GameOptimizationConfiguration(
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", _problem()),
+        },
+        descent_iterations=1,
+    )
+    estimator = GameEstimator("logistic_regression", base)
+    estimator.fit([config])
+    fixed_key = config.coordinates["fixed"].data_key
+    assert estimator._fixed_row_capacity == {}  # no growth yet: exact
+    batch0 = estimator._device_data_cache[fixed_key].batch
+    assert batch0.num_examples == base.num_examples
+
+    estimator.onboard_training_data(g1)
+    estimator.fit([config])  # pays the ONE growth rebuild, sets capacity
+    cap1 = estimator._fixed_row_capacity[fixed_key]
+    dd1 = estimator._device_data_cache[fixed_key]
+    assert cap1 >= g1.num_examples
+    assert dd1.batch.num_examples == cap1
+    assert dd1.unpadded_n == g1.num_examples
+    assert g2.num_examples <= cap1
+
+    estimator.onboard_training_data(g2)
+    estimator.fit([config])
+    assert estimator._fixed_row_capacity[fixed_key] == cap1
+    dd2 = estimator._device_data_cache[fixed_key]
+    assert dd2.batch.num_examples == cap1  # SAME padded shape
+    assert dd2.unpadded_n == g2.num_examples

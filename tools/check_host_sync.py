@@ -107,6 +107,12 @@ DEFAULT_FILES = (
     # materialized arrays — the DEVICE decode lives in the scorer's
     # gather programs; a hidden d2h here would stall every tile publish.
     "photon_tpu/game/lowp.py",
+    # Multi-model arena (ISSUE 18): slot allocation, gather-index
+    # resolution, and slice publication are host bookkeeping; the one
+    # device sync per scored batch lives in the scorer path and every
+    # np.asarray site must carry its sanction — an extra d2h here would
+    # tax EVERY tenant's request, not just one model's.
+    "photon_tpu/serving/arena.py",
 )
 
 SYNC_PATTERN = re.compile(
