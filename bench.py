@@ -2172,6 +2172,458 @@ def _bench_fleet(table_dtype: str = "f32") -> None:
     })
 
 
+def _bench_fleet_chaos_matrix(table_dtype: str = "f32") -> None:
+    """Partition-tolerance chaos matrix (``--mode fleet --chaos-matrix``
+    — the ISSUE 19 acceptance sweep).
+
+    Five deterministic network-fault cells against supervised 2-replica
+    SUBPROCESS fleets, each injected through the seeded transport shim
+    (``serving/netfault.py``), plus the capacity-boundary background-
+    rebuild leg:
+
+    - ``partition_heal``  — both-way partition SHORTER than the lease:
+      the replica rejoins silently (zero deaths, lease misses counted);
+    - ``partition_lease`` — partition PAST the lease: death declared
+      with cause ``lease``, canary-gated resurrection after heal;
+    - ``zombie_fenced``   — seeded frame drops force timeout/resend, and
+      a generation-ratcheted child (the resurrection race, distilled)
+      must have its stale-generation answer FENCED, never served;
+    - ``duplicate``       — every data frame duplicated both ways: the
+      extra responses are fenced by seq, each request served once;
+    - ``slow_replica``    — byte-rate throttle + per-frame delay: slow
+      is not dead (zero deaths, zero false resurrections).
+
+    Every cell bars ZERO lost futures and per-response parity vs the
+    host oracle (a double-served or cross-wired response breaks parity;
+    the fence counters prove the stale answers existed and were
+    discarded).  The rebuild leg grows the vocabulary PAST the serving
+    tables' headroom under live traffic: ``rollout_with_rebuild`` must
+    cross the capacity boundary with zero shed/lost requests and zero
+    parent-side recompiles."""
+    import dataclasses as _dc
+    import threading as _threading
+    import time as _time
+
+    import jax.monitoring
+    from jax._src import monitoring as monitoring_src
+
+    from photon_tpu.game.lowp import parity_tol_for
+    from photon_tpu.game.model import GameModel, RandomEffectModel
+    from photon_tpu.serving import (
+        AdmissionPolicy,
+        ReplicaDeadError,
+        ServingFleet,
+        SupervisorPolicy,
+        TrafficSpec,
+        generate_traffic,
+        host_score_request,
+        replay_open_loop,
+        request_spec_for_dataset,
+    )
+    from photon_tpu.serving.netfault import (
+        LinkRule,
+        NetFaultPlan,
+        partition,
+        set_net_plan,
+    )
+    from photon_tpu.telemetry import TelemetrySession
+
+    platform, model, data = _serving_fixture()
+    parity_bound = 1e-3 if table_dtype == "f32" else parity_tol_for(
+        table_dtype
+    )
+    spec = request_spec_for_dataset(model, data)
+    n_requests = 60 if platform == "cpu" else 200
+    cells: dict = {}
+
+    def counter_sum(session, name, **labels):
+        return sum(
+            m["value"] for m in session.registry.snapshot()["counters"]
+            if m["name"] == name and all(
+                m["labels"].get(k) == v for k, v in labels.items()
+            )
+        )
+
+    def check_parity(outcomes, cell, ref_model=None):
+        m = ref_model if ref_model is not None else model
+        worst = 0.0
+        for out in outcomes:
+            if out.status != "ok":
+                continue
+            want = host_score_request(m, out.item.request)
+            worst = max(worst, float(np.max(np.abs(
+                np.asarray(out.scores, np.float64) - want
+            ))))
+        if worst > parity_bound:
+            raise AssertionError(
+                f"chaos cell {cell}: served/host parity {worst:.2e} > "
+                f"{parity_bound:g} — a double-served or cross-wired "
+                "response leaked through"
+            )
+        return worst
+
+    def assert_none_lost(outcomes, cell):
+        lost = [o for o in outcomes if o.status == "error"]
+        if lost:
+            raise AssertionError(
+                f"chaos cell {cell}: LOST {len(lost)} futures (first: "
+                f"{lost[0].reason})"
+            )
+
+    def rewire(fleet):
+        """Close every replica's parent-side sockets: the next exchange's
+        silent reconnect dials back through ``maybe_shim``, so the links
+        pick up (or drop) the installed plan without restarting children."""
+        for r in fleet.replicas:
+            sc = getattr(r, "scorer", None)
+            for ch in ("_data", "_ctrl"):
+                s = getattr(sc, ch, None)
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+    def make_fleet(session, *, lease_s, probe_deadline_s):
+        set_net_plan(None)
+        fleet = ServingFleet(
+            model, replicas=2, request_spec=spec, backend="subprocess",
+            max_batch=64, max_delay_s=0.001, telemetry=session,
+            admission=AdmissionPolicy(safety=2.0), table_dtype=table_dtype,
+        ).warmup()
+        fleet.supervise(SupervisorPolicy(
+            probe_interval_s=0.1, probe_deadline_s=probe_deadline_s,
+            hang_timeout_s=120.0, lease_s=lease_s,
+            respawn_base_s=0.05, max_deaths=10,
+        ))
+        # Tight exchange timeout: a black-holed frame resolves in ~0.25s
+        # resends, so the cell's fault window dominates its wall clock.
+        for r in fleet.replicas:
+            r.scorer.exchange_timeout_s = 0.25
+        return fleet
+
+    def traffic_for(seed, requests=n_requests, qps=25.0):
+        # No per-request deadline: chaos cells bar exactly-once delivery,
+        # not latency — a deadline would let the admission controller shed
+        # the very requests whose survival is under test.
+        return generate_traffic(data, model, TrafficSpec(
+            requests=requests, mean_rows=8.0, max_rows=64,
+            popularity="powerlaw", alpha=1.1, ramp="flat",
+            target_qps=qps, seed=seed,
+        ))
+
+    # ---- cell 1: partition-then-heal-WITHIN-lease (silent rejoin) ----------
+    session = TelemetrySession("chaos-partition-heal")
+    fleet = make_fleet(session, lease_s=3.0, probe_deadline_s=1.0)
+    try:
+        plan = NetFaultPlan([partition("r0:*", 0.4, 1.2)], seed=11)
+        set_net_plan(plan)
+        rewire(fleet)
+        out = replay_open_loop(fleet.submit, traffic_for(1), timeout_s=180.0)
+        _time.sleep(0.5)  # a post-heal supervisor pass renews the lease
+        assert_none_lost(out, "partition_heal")
+        worst = check_parity(out, "partition_heal")
+        deaths = counter_sum(session, "serving.replica_deaths")
+        misses = counter_sum(session, "serving.lease_probe_misses")
+        if deaths:
+            raise AssertionError(
+                f"partition_heal: {deaths} death(s) declared inside the "
+                "lease window — the lease did not tolerate the partition"
+            )
+        if not misses:
+            raise AssertionError(
+                "partition_heal: zero lease probe misses counted — the "
+                "partition never actually hit the control channel"
+            )
+        if not fleet.replicas[0].alive:
+            raise AssertionError("partition_heal: r0 did not rejoin")
+        cells["partition_heal"] = {
+            "requests": len(out), "lease_misses": int(misses),
+            "partitioned_frames": plan.total("partitioned"),
+            "resends": int(counter_sum(
+                session, "serving.exchange_resends"
+            )),
+            "parity": worst,
+        }
+    finally:
+        set_net_plan(None)
+        fleet.close()
+
+    # ---- cell 2: partition PAST the lease (death + resurrection) -----------
+    session = TelemetrySession("chaos-partition-lease")
+    fleet = make_fleet(session, lease_s=1.0, probe_deadline_s=0.5)
+    try:
+        plan = NetFaultPlan([partition("r0:*", 0.3, 4.0)], seed=12)
+        set_net_plan(plan)
+        rewire(fleet)
+        out = replay_open_loop(
+            fleet.submit, traffic_for(2, qps=15.0), timeout_s=180.0
+        )
+        t0 = _time.monotonic()
+        while (not fleet.replicas[0].alive
+               and _time.monotonic() - t0 < 120.0):
+            _time.sleep(0.05)
+        assert_none_lost(out, "partition_lease")
+        worst = check_parity(out, "partition_lease")
+        lease_deaths = counter_sum(
+            session, "serving.replica_deaths", cause="lease"
+        )
+        resurrections = counter_sum(
+            session, "serving.replica_resurrections"
+        )
+        if lease_deaths < 1:
+            raise AssertionError(
+                "partition_lease: no death with cause 'lease' — expiry "
+                "did not declare"
+            )
+        if resurrections < 1 or not fleet.replicas[0].alive:
+            raise AssertionError(
+                "partition_lease: the expired replica never resurrected "
+                "after the heal"
+            )
+        cells["partition_lease"] = {
+            "requests": len(out), "lease_deaths": int(lease_deaths),
+            "resurrections": int(resurrections), "parity": worst,
+        }
+    finally:
+        set_net_plan(None)
+        fleet.close()
+
+    # ---- cells 3-5 share one fleet (generous lease: no deaths expected) ----
+    session = TelemetrySession("chaos-frames")
+    fleet = make_fleet(session, lease_s=60.0, probe_deadline_s=5.0)
+    try:
+        # -- duplicate-frames: every data frame duplicated, both ways.
+        plan = NetFaultPlan(
+            [LinkRule(link="r0:data", direction="both", dup_p=1.0)],
+            seed=13,
+        )
+        set_net_plan(plan)
+        rewire(fleet)
+        out = replay_open_loop(fleet.submit, traffic_for(3), timeout_s=180.0)
+        assert_none_lost(out, "duplicate")
+        worst = check_parity(out, "duplicate")
+        if plan.total("duplicated") < 1:
+            raise AssertionError("duplicate: the dup rule never fired")
+        fenced_seq = counter_sum(
+            session, "serving.fenced_responses", reason="stale_seq"
+        )
+        cells["duplicate"] = {
+            "requests": len(out),
+            "duplicated_frames": plan.total("duplicated"),
+            "fenced_stale_seq": int(fenced_seq), "parity": worst,
+        }
+
+        # -- slow-replica: throttle + delay; slow is NOT dead.
+        plan = NetFaultPlan([LinkRule(
+            link="r0:data", direction="both", delay_s=0.03,
+            rate_bytes_per_s=2e6,
+        )], seed=14)
+        set_net_plan(plan)
+        rewire(fleet)
+        out = replay_open_loop(
+            fleet.submit, traffic_for(4, qps=15.0), timeout_s=180.0
+        )
+        assert_none_lost(out, "slow_replica")
+        worst = check_parity(out, "slow_replica")
+        if plan.total("throttled") < 1:
+            raise AssertionError("slow_replica: the throttle never fired")
+        if counter_sum(session, "serving.replica_deaths"):
+            raise AssertionError(
+                "slow_replica: a merely-slow replica was declared dead"
+            )
+        if counter_sum(session, "serving.replica_resurrections"):
+            raise AssertionError(
+                "slow_replica: false-positive resurrection"
+            )
+        cells["slow_replica"] = {
+            "requests": len(out),
+            "throttled_frames": plan.total("throttled"),
+            "parity": worst,
+        }
+
+        # -- zombie-fenced: seeded drops force timeout/resend; then the
+        # distilled resurrection race — the child ratcheted PAST the
+        # router's recorded generation must have its answer fenced.
+        plan = NetFaultPlan(
+            [LinkRule(link="r0:data", direction="both", drop_p=0.3)],
+            seed=15,
+        )
+        set_net_plan(plan)
+        rewire(fleet)
+        out = replay_open_loop(fleet.submit, traffic_for(5), timeout_s=180.0)
+        assert_none_lost(out, "zombie_fenced")
+        worst = check_parity(out, "zombie_fenced")
+        resends = counter_sum(session, "serving.exchange_resends")
+        if plan.total("dropped") < 1 or resends < 1:
+            raise AssertionError(
+                "zombie_fenced: drops/resends never fired "
+                f"(dropped={plan.total('dropped')}, resends={resends})"
+            )
+        set_net_plan(None)
+        rewire(fleet)
+        r0 = fleet.replicas[0]
+        r0.scorer.ping(10.0, gen=r0.generation + 3)  # child ratchets ahead
+        try:
+            r0.scorer.score_batch(traffic_for(6, requests=1).items[0].request)
+            raise AssertionError(
+                "zombie_fenced: a stale-generation response was SERVED"
+            )
+        except ReplicaDeadError:
+            pass
+        fenced_gen = counter_sum(
+            session, "serving.fenced_responses", reason="stale_gen"
+        )
+        if fenced_gen < 1:
+            raise AssertionError(
+                "zombie_fenced: the stale-generation answer was not "
+                "counted as fenced"
+            )
+        # Re-sync the ratchet we injected so teardown sees a sane replica.
+        r0.generation += 3
+        r0.scorer.generation = r0.generation
+        cells["zombie_fenced"] = {
+            "requests": len(out), "dropped_frames": plan.total("dropped"),
+            "resends": int(resends), "fenced_stale_gen": int(fenced_gen),
+            "parity": worst,
+        }
+    finally:
+        set_net_plan(None)
+        fleet.close()
+
+    # ---- rebuild leg: growth past headroom, zero-downtime cutover ----------
+    # The grown model is built BEFORE the compile listener attaches:
+    # with_entities scatters on device (legitimate one-time compiles that
+    # are the MODEL's, not the serving path's).
+    coords = dict(model.coordinates)
+    for name, coord in model.coordinates.items():
+        if isinstance(coord, RandomEffectModel):
+            keys = np.asarray(coord.keys)
+            extra = max(4, len(keys))  # past the factor-1 headroom (E+1)
+            if keys.dtype.kind in "iu":
+                new = keys.max() + np.arange(
+                    1, extra + 1, dtype=np.int64
+                ).astype(keys.dtype)
+            else:
+                new = np.array([f"grown-{i:06d}" for i in range(extra)])
+            coords[name] = coord.with_entities(
+                np.unique(np.concatenate([keys, new]))
+            )
+    grown = GameModel(coordinates=coords, task_type=model.task_type)
+    import jax as _jax
+    _jax.block_until_ready([
+        c.table for c in grown.coordinates.values()
+        if isinstance(c, RandomEffectModel)
+    ])
+
+    compile_events: list = []
+
+    def listener(event, **kwargs):
+        if "compile" in event:
+            compile_events.append(event)
+
+    session = TelemetrySession("chaos-rebuild")
+    set_net_plan(None)
+    fleet = ServingFleet(
+        model, replicas=2, request_spec=spec, backend="subprocess",
+        max_batch=64, max_delay_s=0.001, telemetry=session,
+        admission=AdmissionPolicy(safety=2.0), table_dtype=table_dtype,
+        table_capacity_factor=1,
+    ).warmup()
+    fleet.supervise(SupervisorPolicy(
+        probe_interval_s=0.2, probe_deadline_s=60.0, lease_s=30.0,
+    ))
+    live = traffic_for(7, requests=max(40, n_requests)).items
+    stop = _threading.Event()
+    served: list = []
+    errors: list = []
+
+    def client(tid):
+        i = tid
+        while not stop.is_set():
+            req = live[i % len(live)].request
+            try:
+                served.append((req, fleet.score(req)))
+            except Exception as e:  # noqa: BLE001 — audited below
+                errors.append(e)
+            i += 1
+            _time.sleep(0.02)
+
+    threads = [
+        _threading.Thread(target=client, args=(t,), daemon=True)
+        for t in range(2)
+    ]
+    jax.monitoring.register_event_listener(listener)
+    try:
+        for t in threads:
+            t.start()
+        _time.sleep(0.3)
+        rebuilt = fleet.rollout_with_rebuild(grown)
+        _time.sleep(0.5)  # post-cutover traffic rides the new tables
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        monitoring_src._unregister_event_listener_by_callback(listener)
+    try:
+        if not rebuilt:
+            raise AssertionError(
+                "rebuild leg: the grown model fit the old tables — the "
+                "growth did not cross the capacity boundary"
+            )
+        if errors:
+            raise AssertionError(
+                f"rebuild leg: {len(errors)} shed/lost request(s) during "
+                f"the background rebuild (first: {errors[0]!r})"
+            )
+        if compile_events:
+            raise AssertionError(
+                f"rebuild leg: {len(compile_events)} parent-side compile "
+                f"event(s) (first: {compile_events[0]}) — the surviving "
+                "path recompiled"
+            )
+        if counter_sum(session, "serving.fleet_rebuilds") != 1:
+            raise AssertionError("rebuild leg: fleet_rebuilds != 1")
+        # Post-cutover responses during the window must match ONE of the
+        # two published models (old before the atomic cut, grown after).
+        for req, scores in served[:: max(1, len(served) // 64)]:
+            worst = min(
+                float(np.abs(np.asarray(scores, np.float64)
+                             - host_score_request(m, req)).max())
+                for m in (model, grown)
+            )
+            if worst > parity_bound:
+                raise AssertionError(
+                    f"rebuild leg: mixed-model response ({worst:.2e})"
+                )
+        # The grown entities actually serve from the rebuilt tables.
+        from photon_tpu.serving.supervisor import probe_request_for
+        probe = probe_request_for(grown, spec, rows=4, seed=9)
+        got = fleet.score(probe)
+        want = host_score_request(grown, probe)
+        if float(np.abs(np.asarray(got, np.float64) - want).max()) \
+                > parity_bound:
+            raise AssertionError(
+                "rebuild leg: grown-vocabulary probe parity broke"
+            )
+        cells["rebuild"] = {
+            "served_during_rebuild": len(served),
+            "rebuilds": int(counter_sum(
+                session, "serving.replica_rebuilds"
+            )),
+        }
+    finally:
+        fleet.close()
+
+    _emit("game_fleet_chaos_matrix", float(len(cells)), "cells passed", {
+        "backend": "subprocess",
+        "table_dtype": table_dtype,
+        "platform": platform,
+        **{f"{cell}_{k}": (round(v, 8) if isinstance(v, float) else v)
+           for cell, info in cells.items() for k, v in info.items()},
+    })
+
+
 def _tenant_clone(model, seed: int):
     """A tenant model for the multi-model arena bench: SAME coordinate
     structure and entity vocabulary as ``model`` (one arena layout hosts
@@ -3097,6 +3549,15 @@ def main() -> None:
             # parity-gated at the codec's declared bound.
             modes["fleet"] = lambda: _bench_fleet(
                 table_dtype=flag_value("--table-dtype")
+            )
+        if mode == "fleet" and "--chaos-matrix" in sys.argv[3:]:
+            # ``--mode fleet --chaos-matrix``: the ISSUE 19 partition-
+            # tolerance sweep — five deterministic network-fault cells
+            # (lease-tolerated partition, lease expiry, zombie fencing,
+            # duplicate frames, slow replica) plus the capacity-boundary
+            # background-rebuild leg, each with in-bench acceptance.
+            modes["fleet"] = lambda: _bench_fleet_chaos_matrix(
+                table_dtype=flag_value("--table-dtype") or "f32"
             )
         if mode == "fleet" and flag_value("--models"):
             # ``--mode fleet --models N``: the ISSUE 18 multi-model arena

@@ -254,6 +254,37 @@ def entity_index_for(raw_keys: np.ndarray, vocab_keys: np.ndarray) -> np.ndarray
     return np.where(found, pos, -1).astype(np.int32)
 
 
+#: Missing-id marker for int64 entity columns (the common Avro id dtype;
+#: string columns use "", narrower int columns use their OWN dtype's min —
+#: ``missing_key`` resolves per dtype, so the marker can never wrap to a
+#: valid id on a narrow column).
+MISSING_INT64 = np.int64(np.iinfo(np.int64).min)
+
+
+def missing_key(dtype):
+    """The missing-id fill value for an entity column of ``dtype``: the
+    dtype's OWN minimum for signed ints (int64 -> :data:`MISSING_INT64`),
+    its maximum for unsigned ints (0 is a real id), "" for strings."""
+    dt = np.dtype(dtype)
+    if dt.kind == "i":
+        return dt.type(np.iinfo(dt).min)
+    if dt.kind == "u":
+        return dt.type(np.iinfo(dt).max)
+    return ""
+
+
+def missing_mask(values: np.ndarray) -> np.ndarray:
+    """Bool mask of rows carrying the missing-id marker (the marker is
+    dtype-relative — see :func:`missing_key`)."""
+    # host-sync: id columns are host numpy by construction (ingest side).
+    v = np.asarray(values)
+    if len(v) == 0:
+        return np.zeros(0, bool)
+    if v.dtype.kind in "iu":
+        return v == missing_key(v.dtype)
+    return v == ""
+
+
 def keys_match(keys, ref, ref_array: Optional[np.ndarray] = None) -> bool:
     """Is ``keys`` the same vocabulary as ``ref``?  Identity first — a model
     trained in THIS run carries the dataset's own keys object, so the O(E)
@@ -274,6 +305,7 @@ def build_random_effect_dataset(
     active_row_cap: Optional[int] = None,
     seed: int = 0,
     vocab: Optional[np.ndarray] = None,
+    missing_marker="auto",
 ) -> RandomEffectDataset:
     """Group rows by entity and pack them into row-capacity buckets.
 
@@ -282,6 +314,15 @@ def build_random_effect_dataset(
     SURVEY.md §2.6).  ``vocab`` pins the entity vocabulary (e.g. when
     bucketing validation data against a training vocabulary); by default the
     vocabulary is the sorted unique keys present in ``data``.
+
+    ``missing_marker`` keeps missing-id rows OUT of the vocabulary: rows
+    carrying the marker map to per-row entity index -1 (zero margin, no
+    bin membership) instead of materializing a marker "entity" that trains
+    its own random effect.  ``"auto"`` resolves the dtype-relative marker
+    via :func:`missing_key` — the value ``merge_append`` fills when an
+    append batch omits the id column — so a cold rebuild over a merged
+    dataset reproduces the incremental path's semantics.  Pass ``None``
+    to disable, or an explicit value to override.
     """
     if entity_column not in data.id_columns:
         raise KeyError(
@@ -291,8 +332,18 @@ def build_random_effect_dataset(
     shard = data.shard(shard_name)
     raw = data.id_columns[entity_column]
 
+    if isinstance(missing_marker, str) and missing_marker == "auto":
+        marker = missing_key(raw.dtype) if raw.dtype.kind in "iuUS" else None
+    else:
+        marker = missing_marker
+
     if vocab is None:
         keys = np.unique(raw)
+        if marker is not None:
+            try:
+                keys = keys[keys != keys.dtype.type(marker)]
+            except (ValueError, OverflowError, TypeError):
+                pass  # marker not representable in this dtype: nothing to drop
     else:
         # entity_index_for requires a sorted unique vocabulary; normalize the
         # caller's array (index = position in the SORTED keys, everywhere).

@@ -34,36 +34,14 @@ from photon_tpu.game.data import (
     SparseShard,
     entity_index_for,
 )
-
-#: Missing-id marker for int64 entity columns (the common Avro id dtype;
-#: string columns use "", narrower int columns use their OWN dtype's min —
-#: ``missing_key`` resolves per dtype, so the marker can never wrap to a
-#: valid id on a narrow column).
-MISSING_INT64 = np.int64(np.iinfo(np.int64).min)
-
-
-def missing_key(dtype):
-    """The missing-id fill value for an entity column of ``dtype``: the
-    dtype's OWN minimum for signed ints (int64 -> :data:`MISSING_INT64`),
-    its maximum for unsigned ints (0 is a real id), "" for strings."""
-    dt = np.dtype(dtype)
-    if dt.kind == "i":
-        return dt.type(np.iinfo(dt).min)
-    if dt.kind == "u":
-        return dt.type(np.iinfo(dt).max)
-    return ""
-
-
-def missing_mask(values: np.ndarray) -> np.ndarray:
-    """Bool mask of rows carrying the missing-id marker (the marker is
-    dtype-relative — see :func:`missing_key`)."""
-    # host-sync: id columns are host numpy by construction (ingest side).
-    v = np.asarray(values)
-    if len(v) == 0:
-        return np.zeros(0, bool)
-    if v.dtype.kind in "iu":
-        return v == missing_key(v.dtype)
-    return v == ""
+# Canonical marker definitions live next to the dataset builders now (the
+# cold-rebuild path resolves them too — ISSUE 19 satellite); re-exported
+# here for the established import path.
+from photon_tpu.game.data import (  # noqa: F401
+    MISSING_INT64,
+    missing_key,
+    missing_mask,
+)
 
 
 def _to_base_layout(base: Shard, b: Shard) -> Shard:

@@ -36,6 +36,8 @@ from photon_tpu.serving.router import (
     AdmissionPolicy,
     FleetRouter,
     ScorerReplica,
+    host_score_request,
+    parity_worst,
 )
 from photon_tpu.serving.scorer import (
     DEFAULT_MAX_BATCH,
@@ -46,6 +48,37 @@ from photon_tpu.serving.scorer import (
     request_spec_for_model,
 )
 from photon_tpu.serving.batcher import DEFAULT_MAX_DELAY_S
+
+
+class ReplicaRebuildError(RuntimeError):
+    """A background-rebuild replacement failed its canary parity probe;
+    the replacement was retired and the fleet is untouched."""
+
+
+#: The capacity-plan refusal markers: a ``swap_model`` that cannot fit
+#: the new model in the serving tables' headroom raises with ONE of
+#: these texts (the scorer's plan comparison, or ``serving_table``'s
+#: vocabulary-vs-capacity check underneath it) — and both survive the
+#: subprocess boundary (the child's refusal travels back inside a typed
+#: error frame's message).
+CAPACITY_REFUSAL_MARKERS = (
+    "requires a new GameScorer",
+    "rebuild the scorer instead of hot-swapping",
+)
+
+
+def is_capacity_refusal(exc: BaseException) -> bool:
+    """Does this exception chain carry the capacity-plan refusal?  Walks
+    ``__cause__``/``__context__`` so a refusal wrapped by the transport
+    (TransportError) or a retry layer still matches."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        text = str(exc)
+        if any(marker in text for marker in CAPACITY_REFUSAL_MARKERS):
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False
 
 
 def _replica_meshes(n_replicas: int, mesh, devices) -> List[object]:
@@ -132,6 +165,15 @@ class ServingFleet:
             raise ValueError("models= needs at least one hosted model")
         self.model = model
         self.backend = backend
+        # Rebuild inputs (ISSUE 19): a zero-downtime background rebuild
+        # re-spawns replicas at a larger table_capacity_factor, so the
+        # fleet remembers the construction shape it built them from.
+        self._table_capacity_factor = int(table_capacity_factor)
+        self._request_spec_cfg = request_spec
+        self._buckets = buckets
+        self._max_batch = int(max_batch)
+        self._min_bucket = int(min_bucket)
+        self._replica_mesh_list: List[object] = []
         # Fleet-wide gather-table storage tier (ISSUE 17): every replica
         # serves the same dtype, and the canary/probe parity gates default
         # to the tier's measured bound (lowp.parity_tol_for).
@@ -204,6 +246,7 @@ class ServingFleet:
                 raise
         else:
             meshes = _replica_meshes(int(replicas), mesh, devices)
+            self._replica_mesh_list = list(meshes)
             for i in range(int(replicas)):
                 if self.models:
                     from photon_tpu.serving.arena import MultiModelScorer
@@ -404,6 +447,214 @@ class ServingFleet:
                     self._previous_model = previous_model
                 self._stamp_served_version()
 
+    def rollout_with_rebuild(self, model, **kwargs) -> bool:
+        """Rollout that survives the capacity boundary (ISSUE 19): try
+        the in-place staggered rollout first (zero recompiles when the
+        grown model still fits the serving tables' headroom); when the
+        canary swap REFUSES for capacity (the amortized-doubling plan is
+        exhausted — ``is_capacity_refusal``), fall through to a
+        zero-downtime background :meth:`rebuild` at doubled capacity.
+        Returns True when a rebuild was needed, False when the plain
+        rollout sufficed."""
+        try:
+            self.rollout(model, **kwargs)
+            return False
+        except BaseException as e:
+            if not is_capacity_refusal(e):
+                raise
+        self.rebuild(
+            model=model,
+            probe_requests=kwargs.get("probe_requests"),
+            parity_tol=kwargs.get("parity_tol"),
+        )
+        return True
+
+    def rebuild(self, model=None, table_capacity_factor: Optional[int] = None,
+                parity_tol: Optional[float] = None,
+                probe_requests: Optional[List[ScoringRequest]] = None) -> None:
+        """Zero-downtime background replica rebuild (ISSUE 19 tentpole).
+
+        For each replica: build a REPLACEMENT backend at
+        ``table_capacity_factor`` (default: double the current factor)
+        while the old backend keeps serving, warm it, canary the FIRST
+        replacement with mirrored traffic against the host oracle, then
+        atomically cut the serving path over (new submissions to the
+        replacement, the old batcher drains against the old backend —
+        zero shed, zero lost) and bump the router generation so any
+        answer the retired backend still produces is fenced.  Replicas
+        after the canary cut over without re-probing (same artifact,
+        same parity surface).
+
+        A canary parity failure retires the replacement and raises
+        :class:`ReplicaRebuildError` with the fleet untouched.  A
+        NON-canary replacement that fails to spawn is declared unhealthy
+        (the supervisor heals it — at the new factor) rather than
+        aborting a half-cut-over fleet.
+
+        ``model=None`` rebuilds on the currently served model (a pure
+        capacity grow); passing a model publishes it with the same
+        version discipline as :meth:`rollout`."""
+        if self.models:
+            raise RuntimeError(
+                "rebuild currently supports single-model fleets (a "
+                "multi-model arena grows per-slice via add_model)"
+            )
+        if parity_tol is None:
+            from photon_tpu.game.lowp import parity_tol_for
+
+            parity_tol = parity_tol_for(self.table_dtype)
+        factor = (
+            int(table_capacity_factor) if table_capacity_factor
+            else max(1, self._table_capacity_factor) * 2
+        )
+        with self._publish_lock:
+            with self._model_lock:
+                previous = self.model
+                published = model is not None and model is not self.model
+                if published:
+                    self.model = model
+                    self._model_version += 1
+                target = self.model
+            try:
+                self._rebuild_replicas(
+                    target, factor, float(parity_tol), probe_requests
+                )
+            except BaseException:
+                with self._model_lock:
+                    if published:
+                        self.model = previous
+                        # Monotonic, like rollout's abort path: the
+                        # restore is itself a new published state.
+                        self._model_version += 1
+                raise
+            self._table_capacity_factor = factor
+            with self._model_lock:
+                if published:
+                    self._previous_model = previous
+                self._stamp_served_version()
+        self.telemetry.counter("serving.fleet_rebuilds").inc()
+
+    def _rebuild_replicas(self, model, factor: int, parity_tol: float,
+                          probe_requests) -> None:
+        live = [r for r in self.replicas if r.alive and not r.quarantined]
+        if not live:
+            raise RuntimeError("rebuild aborted: every replica is dead")
+        probes = self._rebuild_probes(model, probe_requests)
+        canary = True
+        for replica in live:
+            try:
+                proc, scorer = self._build_replacement(replica, model, factor)
+            except BaseException as e:
+                if canary:
+                    raise
+                # Post-canary spawn failure: don't abort a half-cut-over
+                # fleet — declare and let the supervisor heal at the new
+                # factor (the replica's stored factor is updated first).
+                if hasattr(replica, "_table_capacity_factor"):
+                    replica._table_capacity_factor = factor
+                self.router.mark_unhealthy(
+                    replica, "rebuild", f"replacement spawn failed: {e}"
+                )
+                replica.abandon_pending(
+                    RuntimeError(f"replica {replica.replica_id} rebuild "
+                                 f"replacement failed: {e}")
+                )
+                continue
+            if canary:
+                # Mirrored-traffic canary BEFORE the replacement takes any
+                # caller traffic: probe responses never reach callers.
+                try:
+                    for req in probes:
+                        worst = parity_worst(
+                            scorer.score_batch(req),
+                            host_score_request(model, req),
+                        )
+                        if worst > parity_tol:
+                            raise ReplicaRebuildError(
+                                f"replacement for {replica.replica_id} "
+                                f"failed its canary parity probe (max "
+                                f"|delta| {worst:.2e} > {parity_tol:g})"
+                            )
+                except BaseException:
+                    self._retire_replacement(proc, scorer)
+                    raise
+                canary = False
+            self._mark_rebuild(replica.replica_id, "cutover")
+            if proc is not None:
+                replica.cutover_to(scorer, proc=proc,
+                                   table_capacity_factor=factor)
+            else:
+                replica.cutover_to(scorer)
+            self.router.cutover(replica)
+
+    def _rebuild_probes(self, model,
+                        probe_requests) -> List[ScoringRequest]:
+        """The canary's traffic sample: explicit probes, else the
+        router's mirror of recent requests, else one synthetic
+        known-answer probe.  Per-row-routed mirrors (model id arrays) are
+        dropped — they have no single host oracle."""
+        probes = (
+            list(probe_requests) if probe_requests
+            else self.router.recent_requests()
+        )
+        probes = [
+            p for p in probes
+            if getattr(p, "model", None) is None
+            or isinstance(p.model, str)
+        ]
+        if not probes:
+            from photon_tpu.serving.supervisor import probe_request_for
+
+            spec = None
+            for replica in self.replicas:
+                spec = getattr(replica.scorer, "request_spec", None)
+                if spec:
+                    break
+            if not spec:
+                spec = request_spec_for_model(model)
+            probes = [probe_request_for(model, spec)]
+        return probes
+
+    def _build_replacement(self, replica, model, factor: int):
+        """``(proc_or_None, warmed scorer)`` at the new capacity factor —
+        the old backend serves untouched while this builds."""
+        build = getattr(replica, "build_replacement", None)
+        if build is not None:  # subprocess replica: a fresh child
+            return build(model, factor)
+        idx = self.replicas.index(replica)
+        meshes = self._replica_mesh_list
+        scorer = GameScorer(
+            model,
+            mesh=meshes[idx] if idx < len(meshes) else None,
+            request_spec=self._request_spec_cfg,
+            buckets=self._buckets,
+            max_batch=self._max_batch,
+            min_bucket=self._min_bucket,
+            telemetry=self.telemetry,
+            table_capacity_factor=factor,
+            table_dtype=self.table_dtype,
+        ).warmup()
+        return None, scorer
+
+    def _retire_replacement(self, proc, scorer) -> None:
+        disconnect = getattr(scorer, "disconnect", None)
+        if disconnect is not None:
+            try:
+                disconnect()
+            except OSError:
+                pass
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — reap is best-effort
+                pass
+
+    def _mark_rebuild(self, replica_id: str, phase: str) -> None:
+        self.telemetry.counter(
+            "serving.rebuild_phase", replica=replica_id, phase=phase
+        ).inc()
+
     def _stamp_served_version(self) -> None:
         """Thread replicas: mirror the fleet's monotonic model version onto
         each live replica (subprocess replicas carry their child artifact
@@ -524,6 +775,8 @@ class ServingFleet:
         for replica in self.replicas:
             if hasattr(replica, "span_sink"):
                 replica.span_sink = observer.collector.merge_remote
+        if getattr(observer.policy, "admission_guard", False):
+            observer.attach_admission_guard(self.router)
         self.observer = observer
         if start:
             observer.start()

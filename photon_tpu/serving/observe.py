@@ -82,7 +82,14 @@ class ObservePolicy:
     ``window_s`` — the live plane's sliding window (QPS/p50/p99 horizon).
     ``poll_interval_s`` — child span/snapshot pull cadence.
     ``http_port`` — bind the live HTTP plane here (None = no server;
-    0 = ephemeral port, read it back from ``observer.http_address``)."""
+    0 = ephemeral port, read it back from ``observer.http_address``).
+    ``admission_guard`` — close the SLO→admission loop (ISSUE 19
+    satellite / ROADMAP observability edge (b)): while any burn alert is
+    active, the router's overload projection is multiplied by
+    ``admission_tighten`` (sheds start earlier, queues drain); when
+    every alert clears, admission relaxes back to 1.0.  Opt-in: the
+    guard actuates the serving path, so attaching it is a deliberate
+    control-loop decision, not a side effect of observing."""
 
     sample_rate: float = 1.0
     trace_capacity: int = 512
@@ -91,6 +98,8 @@ class ObservePolicy:
     poll_interval_s: float = 0.5
     http_port: Optional[int] = None
     http_host: str = "127.0.0.1"
+    admission_guard: bool = False
+    admission_tighten: float = 4.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -182,11 +191,21 @@ class SloMonitor:
             return 0.0
         return (bad / total) / max(slo.budget, 1e-9)
 
+    def alerting(self) -> bool:
+        """True while ANY SLO is in alert state — what a control-loop
+        subscriber (the admission guard) checks before relaxing."""
+        with self._lock:
+            return bool(self._alerting)
+
     def evaluate(self) -> List[dict]:
         """One evaluation pass; returns the alerts that FIRED this pass
-        (entering alert state — a continuing alert is not re-fired)."""
+        (entering alert state — a continuing alert is not re-fired).
+        Subscribers additionally see CLEAR transitions (an alert leaving
+        alert state) as events with ``"cleared": True`` — the edge a
+        control loop needs to relax whatever it tightened."""
         now = self.clock()
         fired = []
+        cleared = []
         with self._lock:
             for slo in self.slos:
                 events = self._events[slo.name]
@@ -212,12 +231,19 @@ class SloMonitor:
                     self.alerts.append(alert)
                     fired.append(alert)
                     self.telemetry.counter("slo.alerts", slo=slo.name).inc()
-                elif not alerting:
+                elif not alerting and slo.name in self._alerting:
                     self._alerting.discard(slo.name)
-        for alert in fired:
+                    cleared.append({
+                        "t": time.time(), "slo": slo.name, "cleared": True,
+                        "fast_burn": fast, "slow_burn": slow,
+                    })
+                    self.telemetry.counter(
+                        "slo.alert_clears", slo=slo.name
+                    ).inc()
+        for event in fired + cleared:
             for cb in self._subscribers:
                 try:
-                    cb(alert)
+                    cb(event)
                 except Exception:  # noqa: BLE001 — observe-only: a bad
                     # subscriber must not take down the monitor.
                     pass
@@ -281,6 +307,36 @@ class FleetObserver:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._http: Optional[MetricsPlane] = None
+
+    # -- SLO -> admission feedback -------------------------------------------
+    def attach_admission_guard(self, router, tighten: Optional[float] = None
+                               ) -> None:
+        """Close the loop from the SLO burn-rate monitor to the router's
+        admission controller: while any multiwindow alert is live the
+        router's ``burn_safety`` multiplier is raised to ``tighten``
+        (projected waits look ``tighten``× worse, so the controller sheds
+        earlier and protects the deadline SLO); when the last alert
+        clears the multiplier relaxes back to 1.0.  Opt-in via
+        ``ObservePolicy.admission_guard`` because it actuates the serving
+        path rather than just observing it."""
+        factor = float(tighten if tighten is not None
+                       else self.policy.admission_tighten)
+
+        def _on_slo_event(event: dict) -> None:
+            if event.get("cleared"):
+                # Relax only once every alert has cleared — one SLO
+                # recovering while another still burns keeps the guard up.
+                if self.slo_monitor.alerting():
+                    return
+                if router.burn_safety != 1.0:
+                    router.burn_safety = 1.0
+                    self.telemetry.counter("serving.admission_relaxed").inc()
+            else:
+                if router.burn_safety != factor:
+                    router.burn_safety = factor
+                    self.telemetry.counter("serving.admission_tightened").inc()
+
+        self.slo_monitor.subscribe(_on_slo_event)
 
     # -- trace origination (router + client hooks) ---------------------------
     def maybe_start_span(self, request, name: str = "serving.request",

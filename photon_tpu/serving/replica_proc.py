@@ -42,6 +42,7 @@ published version.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import socket
@@ -59,6 +60,7 @@ from photon_tpu.fault.injection import (
     consume_hang_injection,
     fault_point,
 )
+from photon_tpu.serving.netfault import maybe_shim
 from photon_tpu.serving.router import (
     ReplicaDeadError,
     ScorerReplica,
@@ -78,8 +80,10 @@ from photon_tpu.serving.transport import (
     read_frame,
     unpack_control,
     unpack_request_ex,
+    unpack_request_hx,
     unpack_response_ex,
     write_frame,
+    _decode_response,
     _pack,
     _unpack,
 )
@@ -283,7 +287,8 @@ class _ChildService:
     edge (e))."""
 
     def __init__(self, replica_id: str, scorer, version: int,
-                 telemetry=None, flight_path: Optional[str] = None):
+                 telemetry=None, flight_path: Optional[str] = None,
+                 generation: int = 0):
         from collections import deque
 
         from photon_tpu.telemetry import NULL_SESSION
@@ -291,6 +296,13 @@ class _ChildService:
         self.replica_id = replica_id
         self.scorer = scorer
         self.version = version
+        # Membership generation (ISSUE 19): seeded from the spawn config,
+        # then ratcheted to the max stamp seen on any inbound frame — the
+        # child adopts the parent's view and ECHOES its own on every
+        # response, so a zombie (a child whose lease expired while a
+        # newer generation took over) keeps answering with a stale stamp
+        # the parent's exchange loop fences.
+        self.generation = int(generation)
         self.telemetry = telemetry or NULL_SESSION
         self.lock = threading.Lock()
         # Observability: the crash flight recorder (flushed to
@@ -326,7 +338,10 @@ class _ChildService:
         compute → egress) shipped back inline on the response header."""
         self.flight.note_frame("in", "score", len(payload))
         self.maybe_fault()
-        request, _, _ = unpack_request_ex(payload)
+        request, _, seq, rheader = unpack_request_hx(payload)
+        gen = rheader.get("gen")
+        if gen is not None:
+            self.generation = max(self.generation, int(gen))
         ctx = trace_of(request)
         span = None
         if ctx is not None:
@@ -343,15 +358,19 @@ class _ChildService:
             scores = self.scorer.score_batch(request)
             if span is not None:
                 span.event("compute_end")
-        except BaseException:
+        except BaseException as e:
             if span is not None:
                 span.finish(status="error")
                 self.flight.note_span(span, "close")
                 with self._spans_lock:
                     self._pending_spans.append(span.to_dict())
-            raise
+            # Echo ``seq`` on the error frame: the parent's seq-matching
+            # exchange loop would FENCE a seq-less reply and resend until
+            # its deadline — a scoring failure must settle the exchange
+            # that caused it, not starve it (ISSUE 19).
+            return pack_error(f"{type(e).__name__}: {e}", seq=seq)
         self.latency_hist.observe(time.monotonic() - t0)
-        meta = {"version": self.version}
+        meta = {"version": self.version, "gen": self.generation}
         if span is not None:
             span.event("egress")
             span.attrs["rows"] = request.num_rows
@@ -359,7 +378,7 @@ class _ChildService:
             span.finish()
             self.flight.note_span(span, "close")
             meta["spans"] = [span.to_dict()] + self._drain_spans()
-        return pack_scores(scores, meta=meta)
+        return pack_scores(scores, seq=seq, meta=meta)
 
     def serving_counters(self) -> list:
         """This child's scorer-level ``serving.*`` counters as JSON-ready
@@ -395,14 +414,26 @@ class _ChildService:
             except (OSError, TransportError):
                 return
             kind = payload_kind(payload)
+            # Control frames echo the caller's ``seq`` (and the pong its
+            # generation): the parent's exchange loops discard stale
+            # replies left in the pipe by a timed-out earlier exchange —
+            # without the echo, a late pong could satisfy the WRONG ping
+            # and poison the clock-offset estimate (ISSUE 19).
+            seq = None
             try:
                 if kind == "score":
                     out = self._score_frame(payload)
                 elif kind == "ping":
                     self.maybe_fault()
+                    header = unpack_control(payload)
+                    seq = header.get("seq")
+                    gen = header.get("gen")
+                    if gen is not None:
+                        self.generation = max(self.generation, int(gen))
                     out = pack_control(
                         "pong", version=self.version, pid=os.getpid(),
                         compilations=self.scorer.compilations,
+                        seq=seq, gen=self.generation,
                         # Clock-offset estimation: the child's wall clock,
                         # sampled mid-exchange — the parent subtracts the
                         # RTT midpoint to estimate this host's skew and
@@ -414,17 +445,22 @@ class _ChildService:
                     # advisory telemetry, not a liveness probe — the
                     # injected crash/hang sites stay on the frames whose
                     # failure semantics the supervisor tests pin.
+                    seq = unpack_control(payload).get("seq")
                     out = pack_control(
                         "stats", version=self.version,
                         counters=self.serving_counters(),
                         hist=self.latency_hist.snapshot(),
+                        seq=seq,
                     )
                 elif kind == "spans":
                     # Drain completed-but-unshipped spans (error paths) —
                     # advisory like stats, so NOT behind maybe_fault.
-                    out = pack_control("spans", spans=self._drain_spans())
+                    seq = unpack_control(payload).get("seq")
+                    out = pack_control("spans", spans=self._drain_spans(),
+                                       seq=seq)
                 elif kind == "swap":
                     header = unpack_control(payload)
+                    seq = header.get("seq")
                     model, version = load_model_artifact(header["path"])
                     model_id = header.get("model_id")
                     with self.lock:
@@ -435,9 +471,10 @@ class _ChildService:
                             # slice; every other hosted model is untouched.
                             self.scorer.swap_model(model, model_id=model_id)
                         self.version = version
-                    out = pack_control("ok", version=version)
+                    out = pack_control("ok", version=version, seq=seq)
                 elif kind == "shutdown":
-                    out = pack_control("ok")
+                    seq = unpack_control(payload).get("seq")
+                    out = pack_control("ok", seq=seq)
                     try:
                         write_frame(sock, out)
                     except OSError:
@@ -447,7 +484,7 @@ class _ChildService:
                 else:
                     out = pack_error(f"unknown frame kind {kind!r}")
             except BaseException as e:  # surfaced as a typed frame
-                out = pack_error(f"{type(e).__name__}: {e}")
+                out = pack_error(f"{type(e).__name__}: {e}", seq=seq)
             try:
                 write_frame(sock, out)
             except OSError:
@@ -529,7 +566,8 @@ def _child_main(argv=None) -> None:
         ).warmup()
     service = _ChildService(cfg["replica_id"], scorer, version,
                             telemetry=session,
-                            flight_path=cfg.get("flight_path"))
+                            flight_path=cfg.get("flight_path"),
+                            generation=int(cfg.get("generation", 0)))
 
     class _Handler(socketserver.BaseRequestHandler):
         def handle(self):  # noqa: D102 — per-connection loop
@@ -602,11 +640,17 @@ class _RemoteScorer:
                  buckets, max_batch: int, min_bucket: int,
                  port: int, compilations: int, telemetry=None,
                  timeout_s: float = 300.0, span_sink=None,
-                 table_dtype: str = "f32", models: Optional[Dict] = None):
+                 table_dtype: str = "f32", models: Optional[Dict] = None,
+                 generation: int = 0):
         from photon_tpu.telemetry import NULL_SESSION
 
         self.replica_id = replica_id
         self.model = model
+        # Membership generation (ISSUE 19): stamped on every request and
+        # ping; the child echoes the stamp on responses, and a response
+        # whose stamp disagrees is FENCED — a zombie child (dead-declared
+        # but still answering) cannot satisfy a live exchange.
+        self.generation = int(generation)
         # Multi-model arena child: the hosted tenant map (id -> model),
         # mirrored parent-side so a respawn can rebuild the same arena and
         # a per-tenant rollout can read the old slice for rollback.
@@ -641,14 +685,51 @@ class _RemoteScorer:
         # one stale base and double-count into the parent registry.
         self._stats_seen: Dict[tuple, float] = {}
         self._stats_lock = threading.Lock()
-        self._data = self._connect(port, timeout_s)
-        self._ctrl = self._connect(port, timeout_s)
+        # Exchange bookkeeping (ISSUE 19): every request/ping carries a
+        # process-unique seq the child echoes; on a per-attempt timeout
+        # the exchange RESENDS (the frame may have been black-holed by a
+        # partition) until ``resend_deadline_s``, fencing any stale-seq
+        # replies a prior timed-out attempt left in the pipe.  A dropped
+        # CONNECTION (vs. dropped frame) gets one silent reconnect per
+        # exchange — rejoin-within-lease, not death.
+        self._seq = itertools.count(1)
+        self._port = int(port)
+        self._timeout_s = float(timeout_s)
+        self._closed = False
+        self.exchange_timeout_s = 30.0
+        self.resend_deadline_s = float(timeout_s)
+        self._data = self._connect(port, timeout_s, "data")
+        self._ctrl = self._connect(port, timeout_s, "ctrl")
 
-    @staticmethod
-    def _connect(port: int, timeout_s: float) -> socket.socket:
+    def _connect(self, port: int, timeout_s: float, chan: str):
         sock = socket.create_connection(("127.0.0.1", port),
                                         timeout=timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Chaos seam: an installed NetFaultPlan wraps this socket so every
+        # partition/duplicate/reorder scenario is reproducible (ISSUE 19).
+        return maybe_shim(sock, f"{self.replica_id}:{chan}")
+
+    def _reconnect(self, chan: str):
+        """Silent rejoin within the lease window: a dropped control or
+        data connection is NOT death — dial the same child again and let
+        the caller resend.  Refused (child actually gone) raises, which
+        the exchange surfaces as :class:`ReplicaDeadError`."""
+        if self._closed:
+            raise ConnectionError(
+                f"replica {self.replica_id} scorer is disconnected"
+            )
+        old = self._data if chan == "data" else self._ctrl
+        try:
+            old.close()
+        except OSError:
+            pass
+        sock = self._connect(self._port, self._timeout_s, chan)
+        if chan == "data":
+            self._data = sock
+        else:
+            self._ctrl = sock
+        self.telemetry.counter("serving.replica_reconnects",
+                               replica=self.replica_id, chan=chan).inc()
         return sock
 
     # -- GameScorer surface ---------------------------------------------------
@@ -666,12 +747,12 @@ class _RemoteScorer:
         return self  # the child AOT-warmed its ladder at boot
 
     def score_batch(self, request) -> np.ndarray:
-        payload = pack_request(request)
+        seq = next(self._seq)
+        payload = pack_request(request, seq=seq, gen=self.generation)
         try:
             with self._data_lock:
-                write_frame(self._data, payload)
-                scores, header = unpack_response_ex(read_frame(self._data))
-        except OSError as e:
+                scores, header = self._exchange_scores(payload, seq)
+        except (socket.timeout, OSError) as e:
             raise ReplicaDeadError(
                 f"replica {self.replica_id} child connection lost: {e}"
             ) from e
@@ -682,6 +763,71 @@ class _RemoteScorer:
             except Exception:  # noqa: BLE001 — span delivery is advisory
                 pass
         return scores
+
+    def _exchange_scores(self, payload: bytes, seq: int):
+        """One at-least-once scoring exchange with fencing (ISSUE 19):
+        send, then read until a response matching ``seq`` AND the current
+        generation arrives.  A per-attempt ``exchange_timeout_s`` silence
+        means the frame (either direction) may be black-holed — resend
+        until ``resend_deadline_s``.  Duplicated/stale-seq replies are
+        discarded and counted; a matching reply stamped with a STALE
+        generation raises :class:`ReplicaDeadError` (the zombie fence —
+        the router reroutes, exactly-once preserved).  Duplicate sends
+        are safe: the child may score a request twice, but only ONE reply
+        per seq ever settles the exchange."""
+        deadline = time.monotonic() + self.resend_deadline_s
+        reconnected = False
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ConnectionError(
+                    f"no matching response for seq {seq} within "
+                    f"{self.resend_deadline_s:g}s"
+                )
+            try:
+                self._data.settimeout(
+                    min(self.exchange_timeout_s, max(remaining, 0.05))
+                )
+                write_frame(self._data, payload)
+                while True:
+                    rseq, scores, exc, header = _decode_response(
+                        read_frame(self._data)
+                    )
+                    if rseq is None:
+                        if exc is not None:
+                            raise exc  # seq-less child failure: backstop
+                        continue
+                    if int(rseq) != seq:
+                        self.telemetry.counter(
+                            "serving.fenced_responses",
+                            replica=self.replica_id, reason="stale_seq",
+                        ).inc()
+                        continue
+                    rgen = header.get("gen")
+                    if rgen is not None and int(rgen) != int(self.generation):
+                        self.telemetry.counter(
+                            "serving.fenced_responses",
+                            replica=self.replica_id, reason="stale_gen",
+                        ).inc()
+                        raise ReplicaDeadError(
+                            f"replica {self.replica_id} answered from stale "
+                            f"generation {rgen} (current {self.generation}) "
+                            f"— response fenced"
+                        )
+                    if exc is not None:
+                        raise exc
+                    return scores, header
+            except socket.timeout:
+                self.telemetry.counter(
+                    "serving.exchange_resends", replica=self.replica_id
+                ).inc()
+                continue
+            except OSError:
+                if reconnected or self._closed:
+                    raise
+                reconnected = True
+                self._reconnect("data")
+                continue
 
     def model_for(self, model_id: str):
         """The hosted model behind one tenant id (multi-model children):
@@ -703,9 +849,7 @@ class _RemoteScorer:
         frame = {"path": path, "version": version}
         if model_id is not None:
             frame["model_id"] = model_id
-        with self._ctrl_lock:
-            write_frame(self._ctrl, pack_control("swap", **frame))
-            header = unpack_control(read_frame(self._ctrl))
+        header = self._ctrl_exchange("swap", **frame)
         if header.get("kind") != "ok":
             raise TransportError(
                 f"swap refused: unexpected reply {header.get('kind')!r}"
@@ -718,26 +862,82 @@ class _RemoteScorer:
                 self.models[next(iter(self.models))] = model
         self.version = version
 
+    def _ctrl_exchange(self, kind: str, **fields) -> dict:
+        """One seq-tagged control exchange: send, then read until the
+        reply echoes our seq (discarding stale replies a timed-out
+        earlier exchange left in the pipe — counted as fenced)."""
+        seq = next(self._seq)
+        with self._ctrl_lock:
+            write_frame(self._ctrl, pack_control(kind, seq=seq, **fields))
+            while True:
+                header = unpack_control(read_frame(self._ctrl))
+                if header.get("seq") in (None, seq):
+                    return header
+                self.telemetry.counter(
+                    "serving.fenced_responses",
+                    replica=self.replica_id, reason="stale_ctrl",
+                ).inc()
+
     # -- supervision ----------------------------------------------------------
-    def ping(self, deadline_s: float) -> dict:
-        """Liveness ping frame with a hard deadline: the exchange runs
-        under the watchdog's ``call_with_timeout``, so a wedged child
-        surfaces as a retriable stall timeout — the probe-timeout path the
-        supervisor treats exactly like a crash.
+    def ping(self, deadline_s: float, gen: Optional[int] = None) -> dict:
+        """Liveness ping — the LEASE RENEWAL exchange (ISSUE 19).  The
+        ping carries a ``seq`` (stale pongs from timed-out earlier probes
+        are fenced, not mistaken for this renewal) and the membership
+        generation stamp the child adopts; the deadline rides the socket
+        (so a silent partition surfaces as ``socket.timeout`` promptly
+        and RELEASES the control lock — the next probe after heal can
+        renew), with the watchdog's ``call_with_timeout`` as the backstop
+        for a wedged write.  A dropped control connection gets one silent
+        reconnect — rejoin within the lease, not death.
 
         Each pong doubles as a clock-offset sample: the child echoes its
         wall clock, and ``child_time - (t_send + t_recv)/2`` estimates
         this child's skew (the RTT-midpoint trick — symmetric-path NTP).
         An EWMA smooths jitter; the offset de-skews child span timestamps
-        before trace merge, so a skewed host cannot misorder hops."""
+        before trace merge, so a skewed host cannot misorder hops.  The
+        pong also refreshes ``compilations`` — the fleet-level recompile
+        ledger stays honest across swaps without an extra frame."""
         from photon_tpu.fault.watchdog import call_with_timeout
+
+        seq = next(self._seq)
+        stamp = self.generation if gen is None else int(gen)
 
         def exchange():
             with self._ctrl_lock:
-                t_send = time.time()
-                write_frame(self._ctrl, pack_control("ping"))
-                header = unpack_control(read_frame(self._ctrl))
-                t_recv = time.time()
+                deadline = time.monotonic() + deadline_s
+                reconnected = False
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise socket.timeout(
+                            f"ping seq {seq} unanswered within "
+                            f"{deadline_s:g}s"
+                        )
+                    try:
+                        self._ctrl.settimeout(max(remaining, 0.05))
+                        t_send = time.time()
+                        write_frame(
+                            self._ctrl,
+                            pack_control("ping", seq=seq, gen=stamp),
+                        )
+                        while True:
+                            header = unpack_control(read_frame(self._ctrl))
+                            if header.get("seq") in (None, seq):
+                                break
+                            self.telemetry.counter(
+                                "serving.fenced_responses",
+                                replica=self.replica_id,
+                                reason="stale_pong",
+                            ).inc()
+                        t_recv = time.time()
+                        break
+                    except socket.timeout:
+                        raise
+                    except OSError:
+                        if reconnected or self._closed:
+                            raise
+                        reconnected = True
+                        self._reconnect("ctrl")
             child_time = header.get("child_time")
             if isinstance(child_time, (int, float)):
                 sample = float(child_time) - (t_send + t_recv) / 2.0
@@ -745,10 +945,13 @@ class _RemoteScorer:
                     sample if self.clock_offset_s == 0.0
                     else 0.8 * self.clock_offset_s + 0.2 * sample
                 )
+            comps = header.get("compilations")
+            if comps is not None:
+                self.compilations = int(comps)
             return header
 
         return call_with_timeout(
-            exchange, deadline_s, site=f"replica:{self.replica_id}:ping"
+            exchange, deadline_s + 1.0, site=f"replica:{self.replica_id}:ping"
         )
 
     def stats(self, deadline_s: float = 5.0) -> list:
@@ -758,13 +961,9 @@ class _RemoteScorer:
         supervisor's stats pass."""
         from photon_tpu.fault.watchdog import call_with_timeout
 
-        def exchange():
-            with self._ctrl_lock:
-                write_frame(self._ctrl, pack_control("stats"))
-                return unpack_control(read_frame(self._ctrl))
-
         header = call_with_timeout(
-            exchange, deadline_s, site=f"replica:{self.replica_id}:stats"
+            lambda: self._ctrl_exchange("stats"),
+            deadline_s, site=f"replica:{self.replica_id}:stats"
         )
         self.last_hist_snapshot = header.get("hist") or self.last_hist_snapshot
         return header.get("counters", [])
@@ -775,28 +974,23 @@ class _RemoteScorer:
         control exchange."""
         from photon_tpu.fault.watchdog import call_with_timeout
 
-        def exchange():
-            with self._ctrl_lock:
-                write_frame(self._ctrl, pack_control("spans"))
-                return unpack_control(read_frame(self._ctrl))
-
         header = call_with_timeout(
-            exchange, deadline_s, site=f"replica:{self.replica_id}:spans"
+            lambda: self._ctrl_exchange("spans"),
+            deadline_s, site=f"replica:{self.replica_id}:spans"
         )
         return header.get("spans", [])
 
     def shutdown(self, deadline_s: float = 5.0) -> None:
         from photon_tpu.fault.watchdog import call_with_timeout
 
-        def exchange():
-            with self._ctrl_lock:
-                write_frame(self._ctrl, pack_control("shutdown"))
-                return unpack_control(read_frame(self._ctrl))
-
-        call_with_timeout(exchange, deadline_s,
+        call_with_timeout(lambda: self._ctrl_exchange("shutdown"),
+                          deadline_s,
                           site=f"replica:{self.replica_id}:shutdown")
 
     def disconnect(self) -> None:
+        # Latch first: a batcher thread mid-exchange must NOT dial the
+        # (possibly respawned-on-the-same-port) child back after teardown.
+        self._closed = True
         for sock in (self._data, self._ctrl):
             try:
                 sock.close()
@@ -864,6 +1058,54 @@ class SubprocessReplica(ScorerReplica):
         """Spawn one child on the current shared artifact and connect —
         the ``replica:spawn`` fault site (retriable: the supervisor backs
         off and retries a failed spawn)."""
+        proc, scorer = self._launch_child(
+            model, self._table_capacity_factor, telemetry=telemetry,
+            generation=getattr(self, "generation", 0),
+        )
+        self._proc = proc
+        return scorer
+
+    def build_replacement(self, model,
+                          table_capacity_factor: int) -> Tuple:
+        """Spawn (and warm) a REPLACEMENT child at a new capacity factor
+        while the current child keeps serving — the background half of a
+        zero-downtime rebuild (ISSUE 19).  Returns ``(proc, scorer)``;
+        nothing on this replica changes until :meth:`cutover_to`.  The
+        replacement is born into generation+1, the stamp the router's
+        cutover publishes — any answer the OLD child still produces after
+        cutover carries the stale generation and is fenced."""
+        return self._launch_child(
+            model, int(table_capacity_factor), telemetry=self.telemetry,
+            generation=getattr(self, "generation", 0) + 1,
+        )
+
+    def cutover_to(self, scorer, proc=None,
+                   table_capacity_factor: Optional[int] = None) -> None:
+        """Atomically swap serving to a replacement child: new
+        submissions flow to the new scorer immediately, the OLD batcher
+        drains its queued work against the old child (zero shed), then
+        the old child is retired."""
+        old_proc = self._proc
+        old_scorer = self.scorer
+        if table_capacity_factor is not None:
+            self._table_capacity_factor = int(table_capacity_factor)
+        if proc is not None:
+            self._proc = proc
+        super().cutover_to(scorer)  # swaps batcher + drains the old one
+        try:
+            old_scorer.shutdown(deadline_s=5.0)
+        except Exception:  # noqa: BLE001 — retirement is best-effort
+            pass
+        old_scorer.disconnect()
+        if old_proc is not None and old_proc.poll() is None:
+            old_proc.kill()
+            try:
+                old_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def _launch_child(self, model, table_capacity_factor: int,
+                      telemetry=None, generation: int = 0) -> Tuple:
         fault_point("replica:spawn", replica=self._replica_id)
         model_paths = None
         if self._models:
@@ -896,11 +1138,12 @@ class SubprocessReplica(ScorerReplica):
             "buckets": list(self._buckets) if self._buckets else None,
             "max_batch": self._cfg_max_batch,
             "min_bucket": self._min_bucket,
-            "table_capacity_factor": self._table_capacity_factor,
+            "table_capacity_factor": int(table_capacity_factor),
             "table_dtype": self._table_dtype,
             "flight_path": self.flight_path,
             "models": model_paths,
             "reserve_rows": self._reserve_rows,
+            "generation": int(generation),
         }
         env = dict(os.environ)
         env.update(self.child_env)
@@ -943,14 +1186,14 @@ class SubprocessReplica(ScorerReplica):
             os.unlink(ready_path)
         except OSError:
             pass
-        self._proc = proc
-        return _RemoteScorer(
+        return proc, _RemoteScorer(
             self._replica_id, model, version, self._store,
             self._request_spec, self._buckets, self._cfg_max_batch,
             self._min_bucket, port=int(ready["port"]),
             compilations=int(ready.get("compilations", 0)),
             telemetry=telemetry, span_sink=self._deliver_spans,
             table_dtype=self._table_dtype, models=self._models,
+            generation=int(generation),
         )
 
     def _deliver_spans(self, spans: list) -> None:
@@ -1000,8 +1243,8 @@ class SubprocessReplica(ScorerReplica):
         self.scorer = self._spawn(model, telemetry=self.telemetry)
         self.attach_fresh_batcher()
 
-    def ping(self, deadline_s: float) -> dict:
-        return self.scorer.ping(deadline_s)
+    def ping(self, deadline_s: float, **kw) -> dict:
+        return self.scorer.ping(deadline_s, **kw)
 
     def pull_spans(self, deadline_s: float = 5.0) -> list:
         spans = self.scorer.pull_spans(deadline_s)

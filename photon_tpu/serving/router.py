@@ -316,7 +316,32 @@ class ScorerReplica:
         ) * self.row_seconds
 
     def submit(self, request: ScoringRequest) -> Future:
-        return self.batcher.submit(request)
+        try:
+            return self.batcher.submit(request)
+        except RuntimeError:
+            # A background-rebuild cutover can swap the batcher between
+            # our read and the enqueue; the fresh batcher takes the
+            # request — retry once instead of surfacing a phantom death.
+            return self.batcher.submit(request)
+
+    def cutover_to(self, scorer) -> None:
+        """Zero-downtime serving-path cutover (ISSUE 19): swap in a
+        replacement scorer and a fresh batcher so NEW submissions flow to
+        the replacement immediately, then drain the old batcher — its
+        ``_KillableScorer`` holds the OLD scorer reference, so everything
+        already queued completes against the old backend.  Nothing is
+        shed, nothing is lost; the router's generation bump
+        (:meth:`FleetRouter.cutover`) fences any answer the retired
+        backend produces after this point."""
+        old_batcher = self.batcher
+        self.scorer = scorer
+        self.batcher = RequestBatcher(
+            _KillableScorer(self, scorer),
+            max_batch=self._max_batch,
+            max_delay_s=self._max_delay_s,
+            telemetry=self.telemetry,
+        )
+        old_batcher.close()
 
     # -- supervision ---------------------------------------------------------
     def poll_exit(self) -> Optional[int]:
@@ -469,6 +494,14 @@ class FleetRouter:
         # live-metrics window + SLO monitor.  None costs one attribute read
         # per request — the untraced hot path stays untraced.
         self.observer = None
+        # SLO-driven admission tightening (ISSUE 19 satellite): the
+        # observer's burn-rate guard raises this above 1.0 while an SLO
+        # budget is burning — the overload projection pads out, sheds
+        # start earlier, queues drain — and relaxes it back to 1.0 when
+        # the alert clears.  Mutable attribute (AdmissionPolicy is
+        # frozen) so the control loop can actuate without republishing
+        # policy.
+        self.burn_safety = 1.0
         self._lock = threading.Lock()
         # Live per-tenant in-flight row counts (tenant = model id) — the
         # per-tenant admission budget's book; entries release exactly once
@@ -560,7 +593,8 @@ class FleetRouter:
             if now >= deadline_at:
                 self._shed("deadline", "deadline already expired at arrival",
                            span=span, rows=rows, model=tenant)
-            wait = replica.projected_wait_s(rows) * self.admission.safety
+            wait = (replica.projected_wait_s(rows)
+                    * self.admission.safety * self.burn_safety)
             if now + wait > deadline_at:
                 self._shed(
                     "overload",
@@ -791,8 +825,33 @@ class FleetRouter:
             replica.row_seconds = None
             replica.rejoining = False
             replica.alive = True
+            # Sync the backend's membership stamp (ISSUE 19): frames the
+            # revived replica sends from here on carry the new generation,
+            # and any answer still in flight from the OLD incarnation is
+            # fenced by the exchange loop's stale-generation check.
+            scorer = replica.scorer
+            if hasattr(scorer, "generation"):
+                scorer.generation = replica.generation
         self.telemetry.counter(
             "serving.replica_resurrections", replica=replica.replica_id
+        ).inc()
+
+    def cutover(self, replica: ScorerReplica) -> None:
+        """Publish a background-rebuild cutover (ISSUE 19): bump the
+        replica's membership generation (fencing the retired backend —
+        a zombie that keeps answering carries the old stamp) and reset
+        its pace EWMA so the rebuilt backend re-measures like a cold one.
+        The serving-path swap itself happened in
+        :meth:`ScorerReplica.cutover_to`; this is the router-visible
+        half — together they are the atomic generation-bump cutover."""
+        with self._lock:
+            replica.generation += 1
+            replica.row_seconds = None
+            scorer = replica.scorer
+            if hasattr(scorer, "generation"):
+                scorer.generation = replica.generation
+        self.telemetry.counter(
+            "serving.replica_rebuilds", replica=replica.replica_id
         ).inc()
 
     def recent_requests(self) -> List[ScoringRequest]:

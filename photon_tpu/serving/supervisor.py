@@ -81,6 +81,11 @@ class SupervisorPolicy:
     probe; a probe that misses it declares the replica hung.
     ``hang_timeout_s`` — heartbeat age past which a replica WITH pending
     work is declared hung even between probes.
+    ``lease_s`` — the membership lease (ISSUE 19): a replica stays a
+    member as long as SOME ping succeeded within the last ``lease_s``
+    seconds.  Ping failures inside the window are tolerated misses
+    (transient partition / dropped connection — the replica rejoins
+    silently); only lease expiry declares death (cause ``"lease"``).
     ``parity_tol`` — known-answer and rejoin probes vs the host oracle.
     ``respawn_base_s``/``respawn_max_s``/``respawn_jitter`` — the capped
     exponential backoff between resurrection attempts (the
@@ -98,6 +103,7 @@ class SupervisorPolicy:
     probe_deadline_s: float = 5.0
     probe_rows: int = 2
     hang_timeout_s: float = 5.0
+    lease_s: float = 15.0
     parity_tol: float = 1e-3
     respawn_base_s: float = 0.05
     respawn_max_s: float = 2.0
@@ -174,6 +180,10 @@ class ReplicaSupervisor:
             jitter=self.policy.respawn_jitter,
         )
         self._noted: set = set()  # (replica_id, generation) deaths recorded
+        # Per-replica lease expiry instants (ISSUE 19): renewed by every
+        # successful ping, popped on death so a rejoined replica starts a
+        # fresh lease.
+        self._leases: Dict[str, float] = {}
         self._deaths: Dict[str, deque] = {}
         self._attempts: Dict[str, Tuple[int, float]] = {}  # id -> (n, at)
         # Per probed tenant: model_id (None = single-model fleet) ->
@@ -336,17 +346,53 @@ class ReplicaSupervisor:
                           f"no scoring progress for {age:.1f}s with "
                           f"{replica.pending_rows()} rows pending")
             return
-        # 3. Liveness ping with a deadline (subprocess control channel).
+        # 3. Liveness ping (subprocess control channel) under LEASE
+        # semantics (ISSUE 19): a successful ping RENEWS the replica's
+        # time-bounded lease; a failed one inside the lease window is a
+        # MISS — tolerated, because over a real network a dropped control
+        # connection or a transient partition is indistinguishable from
+        # death at single-probe granularity, and a false declaration
+        # spawns a twin of a live replica (the double-serve the
+        # generation fence then has to catch).  Only lease EXPIRY — no
+        # successful renewal for ``lease_s`` — declares.  A genuinely
+        # wedged child is still caught promptly by step 2 (stale
+        # heartbeat with work pending) and a hard-exited one by step 1;
+        # the lease only governs the silent-network signal.
         ping = getattr(replica, "ping", None)
         if ping is not None:
+            rid = replica.replica_id
+            now = self.clock()
+            expires = self._leases.get(rid)
+            if expires is None:
+                expires = now + self.policy.lease_s
+                self._leases[rid] = expires
             try:
-                ping(self.policy.probe_deadline_s)
-            except IOStallTimeoutError as e:
-                self._declare(replica, "hang", f"ping deadline missed: {e}")
+                ping(self.policy.probe_deadline_s, gen=replica.generation)
+            except (IOStallTimeoutError, OSError, RuntimeError) as e:
+                now = self.clock()
+                if now < expires:
+                    self.telemetry.counter(
+                        "serving.lease_probe_misses", replica=rid
+                    ).inc()
+                    self.telemetry.gauge(
+                        "serving.lease_remaining_s", replica=rid
+                    ).set(expires - now)
+                    self._mark(rid, "lease-miss")
+                    # Skip the score probe too: it would ride the same
+                    # partitioned link and turn one miss into a deadline
+                    # pile-up.  Re-probe next pass.
+                    return
+                self._leases.pop(rid, None)
+                self._declare(
+                    replica, "lease",
+                    f"lease expired ({self.policy.lease_s:g}s without a "
+                    f"successful renewal): {e}",
+                )
                 return
-            except (OSError, RuntimeError) as e:
-                self._declare(replica, "crash", f"ping failed: {e}")
-                return
+            self._leases[rid] = self.clock() + self.policy.lease_s
+            self.telemetry.gauge(
+                "serving.lease_remaining_s", replica=rid
+            ).set(self.policy.lease_s)
         # 4. Known-answer score probe vs the host oracle (rotated across
         # hosted tenants on a multi-model fleet).
         model, model_id, version = self._probe_target()
@@ -430,6 +476,7 @@ class ReplicaSupervisor:
             return
         self._noted.add(key)
         rid = replica.replica_id
+        self._leases.pop(rid, None)  # a rejoin starts a fresh lease
         cause = replica.death_cause or "error"
         # Idempotent router-side accounting: a death latched by the scoring
         # proxy outside any router dispatch (e.g. a probe submitted straight
@@ -528,6 +575,9 @@ class ReplicaSupervisor:
                     replica.scorer.swap_model(current)
             self.router.revive(replica)
             self._attempts.pop(rid, None)
+            # A rejoined member starts a fresh lease: the misses that led
+            # to its death must not count against the new incarnation.
+            self._leases[rid] = self.clock() + self.policy.lease_s
             self._mark(rid, "rejoined")
             if self.logger is not None:
                 self.logger.info("supervisor: replica %s rejoined the "

@@ -153,13 +153,17 @@ def unpack_control(payload: bytes) -> dict:
 
 def pack_request(request: ScoringRequest,
                  deadline_s: Optional[float] = None,
-                 seq: Optional[int] = None) -> bytes:
+                 seq: Optional[int] = None,
+                 gen: Optional[int] = None) -> bytes:
     """One scoring request as a wire payload.  Array order is pinned
     (sorted shard names, then sorted id columns, then offset) so the same
     request always produces the same bytes.  ``seq`` tags the frame for
     the PIPELINED client mode: the server scores tagged requests
     concurrently and echoes the tag on each response, so one connection
-    can carry open-loop offered load instead of a serial exchange."""
+    can carry open-loop offered load instead of a serial exchange.
+    ``gen`` stamps the sender's membership generation (ISSUE 19): the
+    replica child adopts the max it has seen and echoes it on responses,
+    so a parent can fence answers produced by a stale generation."""
     entries = []
     for shard in sorted(request.features):
         leaf = request.features[shard]
@@ -190,6 +194,8 @@ def pack_request(request: ScoringRequest,
         header["model"] = model
     if seq is not None:
         header["seq"] = int(seq)
+    if gen is not None:
+        header["gen"] = int(gen)
     ctx = trace_of(request)
     if ctx is not None:
         # Distributed-trace propagation: the context rides the frame header
@@ -198,11 +204,13 @@ def pack_request(request: ScoringRequest,
     return _pack(header)
 
 
-def unpack_request_ex(
+def unpack_request_hx(
     payload: bytes,
-) -> Tuple[ScoringRequest, Optional[float], Optional[int]]:
-    """Decode a request frame to ``(request, deadline_s, seq)`` —
-    ``seq`` is None for plain serial-exchange clients."""
+) -> Tuple[ScoringRequest, Optional[float], Optional[int], dict]:
+    """Decode a request frame to ``(request, deadline_s, seq, header)``
+    — the header-retaining variant for receivers that need the frame's
+    membership stamp (``header["gen"]``, ISSUE 19) besides the request
+    itself.  ``seq`` is None for plain serial-exchange clients."""
     header, arrays = _unpack(payload)
     if header.get("kind") != "score":
         raise TransportError(f"unexpected request kind {header.get('kind')!r}")
@@ -239,7 +247,17 @@ def unpack_request_ex(
         request,
         None if deadline_ms is None else deadline_ms / 1e3,
         header.get("seq"),
+        header,
     )
+
+
+def unpack_request_ex(
+    payload: bytes,
+) -> Tuple[ScoringRequest, Optional[float], Optional[int]]:
+    """Decode a request frame to ``(request, deadline_s, seq)`` —
+    ``seq`` is None for plain serial-exchange clients."""
+    request, deadline_s, seq, _ = unpack_request_hx(payload)
+    return request, deadline_s, seq
 
 
 def unpack_request(payload: bytes) -> Tuple[ScoringRequest, Optional[float]]:

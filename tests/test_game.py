@@ -103,6 +103,51 @@ def test_entity_index_for_unseen_keys():
     assert idx[1] == -1
 
 
+def test_missing_marker_rows_stay_out_of_cold_rebuild_vocab():
+    """A cold rebuild over a merged dataset must reproduce the incremental
+    path's missing-id semantics (ISSUE 19 satellite): rows whose id column
+    carries the dtype-relative missing marker map to per-row entity index
+    -1 — zero margin, no bin membership — instead of materializing a
+    marker "entity" that trains its own random effect."""
+    from photon_tpu.game.data import missing_key
+
+    data = _game_dataset()
+    raw = data.id_columns["userId"].copy()
+    marker = missing_key(raw.dtype)
+    absent = np.zeros(len(raw), bool)
+    absent[::7] = True
+    raw[absent] = marker
+    marked = GameDataset.create(
+        label=data.label,
+        shards=dict(data.shards),
+        id_columns={"userId": raw},
+        weight=data.weight,
+    )
+    ds = build_random_effect_dataset(marked, "userId", "per_entity")
+    assert marker not in ds.keys
+    assert (ds.entity_idx_per_row[absent] == -1).all()
+    assert (ds.entity_idx_per_row[~absent] >= 0).all()
+    # Every bucket row belongs to a REAL entity: the marked rows carry no
+    # bin membership anywhere.
+    covered = np.concatenate([
+        b.row_index[b.row_weight > 0] for b in ds.buckets
+    ])
+    assert not np.intersect1d(covered, np.nonzero(absent)[0]).size
+    # An explicit vocabulary is the caller's verbatim choice: not filtered.
+    pinned = build_random_effect_dataset(
+        marked, "userId", "per_entity",
+        vocab=np.concatenate([np.unique(raw)]),
+    )
+    assert marker in pinned.keys
+    # Disabling the hook restores the historical behavior (the marker
+    # becomes an ordinary entity).
+    legacy = build_random_effect_dataset(
+        marked, "userId", "per_entity", missing_marker=None,
+    )
+    assert marker in legacy.keys
+    assert (legacy.entity_idx_per_row >= 0).all()
+
+
 # ---------------------------------------------------------------------------
 # Batched (vmapped) random-effect solves vs sequential per-entity solves
 # ---------------------------------------------------------------------------

@@ -1,0 +1,518 @@
+"""Partition-tolerant fleet supervision (ISSUE 19): lease/generation
+membership, deterministic network fault injection, zero-downtime rebuild.
+
+The contracts pinned here:
+
+- every fault-shim primitive (drop, duplicate, delay, reorder, throttle,
+  partition-then-heal) preserves response-SET equality with a clean run —
+  the seq/resend exchange loses nothing and double-serves nothing;
+- a dropped control/data connection inside the lease window is a
+  tolerated miss: the replica rejoins SILENTLY on reconnect (zero
+  declared deaths, ``serving.replica_reconnects`` counts the rejoin);
+- a partition that outlives the lease declares death with cause
+  ``"lease"`` — and only then;
+- a zombie replica (generation ratcheted past the parent's) is fenced:
+  its answers raise :class:`ReplicaDeadError` and count
+  ``serving.fenced_responses{reason=stale_gen}``, never reach a caller;
+- duplicated frames are fenced by seq (``reason=stale_seq``) — exactly
+  once survives;
+- an injected child clock skew is measured off the ping RTT
+  (``clock_offset_s``) and child span timestamps are shifted back onto
+  the parent's clock before trace merge;
+- capacity-exceeding growth triggers the zero-downtime background
+  rebuild: replacement at doubled capacity, canary parity gate, atomic
+  generation-bumped cutover; a canary failure aborts with the fleet
+  untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.synthetic import make_game_dataset
+from photon_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_tpu.models.glm import Coefficients, model_for_task
+from photon_tpu.serving import (
+    ReplicaDeadError,
+    ServingFleet,
+    SupervisorPolicy,
+    build_requests,
+    host_score_request,
+    request_spec_for_dataset,
+)
+from photon_tpu.serving.fleet import ReplicaRebuildError, is_capacity_refusal
+from photon_tpu.serving.netfault import (
+    LinkRule,
+    NetFaultPlan,
+    partition,
+    set_net_plan,
+)
+from photon_tpu.serving.supervisor import ReplicaSupervisor
+from photon_tpu.telemetry import TelemetrySession
+from photon_tpu.telemetry.distributed import (
+    TraceContext,
+    attach_trace,
+    new_trace_id,
+    shift_span_times,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_net_plan():
+    set_net_plan(None)
+    yield
+    set_net_plan(None)
+
+
+def _fixture(seed=3, n_entities=40, fixed_dim=6, random_dim=4):
+    data, _ = make_game_dataset(
+        n_entities, 4, fixed_dim, random_dim, seed=seed
+    )
+    rng = np.random.default_rng(seed)
+    keys = np.unique(data.id_columns["re0"])
+    model = GameModel(
+        coordinates={
+            "fixed": FixedEffectModel(
+                model_for_task("logistic_regression", Coefficients(
+                    rng.standard_normal(fixed_dim).astype(np.float32)
+                )),
+                "global",
+            ),
+            "per_entity": RandomEffectModel(
+                table=rng.standard_normal(
+                    (len(keys), random_dim)
+                ).astype(np.float32),
+                keys=keys, entity_column="re0", shard_name="re0",
+                task_type="logistic_regression",
+            ),
+        },
+        task_type="logistic_regression",
+    )
+    return model, data
+
+
+def _counter(session, name, **labels):
+    return sum(
+        m["value"] for m in session.registry.snapshot()["counters"]
+        if m["name"] == name
+        and all(str(m["labels"].get(k)) == str(v) for k, v in labels.items())
+    )
+
+
+def _rewire(fleet):
+    """Force every replica's next exchange through a silent reconnect —
+    the redial passes ``maybe_shim``, so a just-installed (or just
+    cleared) fault plan takes effect on a LIVE fleet."""
+    for replica in fleet.replicas:
+        for chan in ("_data", "_ctrl"):
+            try:
+                getattr(replica.scorer, chan).close()
+            except OSError:
+                pass
+
+
+def _grown(model, extra=None):
+    """The capacity-crossing model: the per-entity vocabulary grown past
+    the factor-1 headroom (capacity = factor * (num_entities + 1))."""
+    pe = model.coordinates["per_entity"]
+    ks = np.asarray(pe.keys)
+    n_new = extra if extra is not None else len(ks) + 4
+    new = ks.max() + np.arange(1, n_new, dtype=ks.dtype)
+    grown_pe = pe.with_entities(np.unique(np.concatenate([ks, new])))
+    return GameModel(
+        coordinates={"fixed": model.coordinates["fixed"],
+                     "per_entity": grown_pe},
+        task_type=model.task_type,
+    )
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """One subprocess-backed replica shared across the protocol tests
+    (child spawn is the expensive part; every test restores the clean
+    state it found — plan cleared, generation re-synced)."""
+    set_net_plan(None)
+    model, data = _fixture(seed=3)
+    session = TelemetrySession("netfault-rig")
+    fleet = ServingFleet(
+        model, replicas=1, backend="subprocess",
+        request_spec=request_spec_for_dataset(model, data),
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+    ).warmup()
+    # Short per-attempt silence: black-holed frames resend quickly, so
+    # the faulted cells finish in test time.
+    fleet.replicas[0].scorer.exchange_timeout_s = 0.25
+    requests = build_requests(data, model, [4, 9, 2, 7, 3, 5])
+    clean = [
+        np.asarray(fleet.score(r), np.float64)  # host-side test oracle
+        for r in requests
+    ]
+    yield types.SimpleNamespace(
+        model=model, data=data, session=session, fleet=fleet,
+        requests=requests, clean=clean,
+    )
+    set_net_plan(None)
+    fleet.close()
+
+
+# -- deterministic fault injection (satellite: property-style replay) ---------
+
+def test_every_fault_primitive_preserves_response_set(rig):
+    """Seeded property test: the same traffic trace replayed through
+    every FaultPlan primitive yields the exact response set of the clean
+    run — zero lost futures, zero double-served rows, no corruption."""
+    cells = {
+        "drop": NetFaultPlan(
+            [LinkRule(link="r0:data", direction="both", drop_p=0.3)], seed=5
+        ),
+        "duplicate": NetFaultPlan(
+            [LinkRule(link="r0:data", direction="both", dup_p=1.0)], seed=6
+        ),
+        "delay": NetFaultPlan(
+            [LinkRule(link="r0:data", direction="both", delay_s=0.02)], seed=7
+        ),
+        "reorder": NetFaultPlan(
+            [LinkRule(link="r0:data", direction="both",
+                      dup_p=0.5, reorder_p=0.7)], seed=8
+        ),
+        "throttle": NetFaultPlan(
+            [LinkRule(link="r0:data", direction="both",
+                      rate_bytes_per_s=2e6)], seed=9
+        ),
+        "partition_heal": NetFaultPlan(
+            [partition("r0:data", 0.0, 0.6)], seed=10
+        ),
+    }
+    expect_events = {
+        "drop": "dropped", "duplicate": "duplicated",
+        "reorder": "reordered", "throttle": "throttled",
+        "partition_heal": "partitioned",
+    }
+    for name, plan in cells.items():
+        set_net_plan(plan)
+        _rewire(rig.fleet)
+        got = [np.asarray(rig.fleet.score(r), np.float64)
+               for r in rig.requests]
+        for g, c in zip(got, rig.clean):
+            np.testing.assert_allclose(g, c, rtol=0, atol=1e-9,
+                                       err_msg=f"cell {name}")
+        if name in expect_events:
+            assert plan.total(expect_events[name]) > 0, (
+                f"cell {name} never exercised its fault: {plan.counters}"
+            )
+    set_net_plan(None)
+    _rewire(rig.fleet)
+    # The faulted cells resent black-holed frames and fenced the stale
+    # replies those resends raced — the exactly-once machinery actually
+    # ran; it did not just get lucky with a quiet wire.
+    assert _counter(rig.session, "serving.exchange_resends") > 0
+    assert _counter(rig.session, "serving.fenced_responses",
+                    reason="stale_seq") > 0
+    assert _counter(rig.session, "serving.replica_deaths") == 0
+
+
+def test_dropped_connection_rejoins_silently_within_lease(rig):
+    """A dropped connection is NOT a death: the next exchange redials,
+    the replica rejoins silently, and the only trace is the
+    ``serving.replica_reconnects`` counter."""
+    before = _counter(rig.session, "serving.replica_reconnects")
+    _rewire(rig.fleet)
+    got = np.asarray(rig.fleet.score(rig.requests[0]), np.float64)
+    np.testing.assert_allclose(got, rig.clean[0], rtol=0, atol=1e-9)
+    pong = rig.fleet.replicas[0].ping(10.0)
+    assert pong.get("kind") == "pong"
+    assert _counter(rig.session, "serving.replica_reconnects") > before
+    assert _counter(rig.session, "serving.replica_deaths") == 0
+
+
+def test_generation_fence_rejects_zombie_replica(rig):
+    """A replica whose child has ratcheted PAST the parent's generation
+    (the parent is the zombie: a newer incarnation owns the id) must not
+    serve — its answers raise and are counted, never returned."""
+    r0 = rig.fleet.replicas[0]
+    before = _counter(rig.session, "serving.fenced_responses",
+                      reason="stale_gen")
+    # Ratchet the child three generations ahead (what a rebuilt/cutover
+    # sibling's frames do), then score from the stale parent handle.
+    r0.scorer.ping(10.0, gen=r0.generation + 3)
+    with pytest.raises(ReplicaDeadError):
+        r0.scorer.score_batch(rig.requests[0])
+    assert _counter(rig.session, "serving.fenced_responses",
+                    reason="stale_gen") > before
+    # Re-sync the handle onto the current generation: service resumes.
+    r0.generation += 3
+    r0.scorer.generation = r0.generation
+    got = np.asarray(r0.scorer.score_batch(rig.requests[0]), np.float64)
+    np.testing.assert_allclose(got, rig.clean[0], rtol=0, atol=1e-9)
+
+
+def test_partition_heals_within_lease_without_false_death(rig):
+    """The tier-1 chaos smoke (one matrix cell): a transient partition
+    shorter than the lease produces probe MISSES, never a declaration —
+    and service resumes through the healed link with zero resurrections
+    (there was nothing to resurrect)."""
+    sup = rig.fleet.supervise(
+        SupervisorPolicy(probe_interval_s=10.0, probe_deadline_s=0.3,
+                         hang_timeout_s=1e9, lease_s=30.0,
+                         respawn_base_s=0.0, respawn_jitter=0.0),
+        start=False,
+    )
+    sup.check_once()  # healthy pass establishes + renews the lease
+    misses0 = _counter(rig.session, "serving.lease_probe_misses")
+    plan = NetFaultPlan([partition("r0:*", 0.0, 0.6)], seed=21)
+    set_net_plan(plan)
+    _rewire(rig.fleet)
+    sup.check_once()  # ping blocks probe_deadline_s, then misses
+    assert rig.fleet.replicas[0].alive, "declared dead inside the lease"
+    assert _counter(rig.session, "serving.lease_probe_misses") > misses0
+    assert _counter(rig.session, "serving.replica_deaths") == 0
+    time.sleep(0.45)  # the partition window closes (0.3s already spent)
+    sup.check_once()  # renewal through the healed link
+    assert rig.fleet.replicas[0].alive
+    assert _counter(rig.session, "serving.replica_deaths") == 0
+    assert _counter(rig.session, "serving.replica_resurrections") == 0
+    set_net_plan(None)
+    _rewire(rig.fleet)
+    got = np.asarray(rig.fleet.score(rig.requests[1]), np.float64)
+    np.testing.assert_allclose(got, rig.clean[1], rtol=0, atol=1e-9)
+
+
+def test_skewed_child_clock_measured_and_spans_deskewed(rig):
+    """A child whose self-reported clock runs 30s ahead (injected via the
+    fault shim's skew rewrite) is measured off the ping RTT midpoint, and
+    its span timestamps land back on the parent's clock before merge."""
+    r0 = rig.fleet.replicas[0]
+    plan = NetFaultPlan(
+        [LinkRule(link="r0:*", direction="recv", skew_s=30.0)], seed=11
+    )
+    set_net_plan(plan)
+    _rewire(rig.fleet)
+    # The offset is an EWMA that earlier (unskewed) pings seeded near 0;
+    # enough renewals converge it onto the injected skew.
+    for _ in range(15):
+        r0.ping(10.0)
+    assert plan.total("skewed") >= 15
+    assert 25.0 < r0.scorer.clock_offset_s < 35.0
+    # A traced request's child span crosses the same skewed link; the
+    # replica's span delivery subtracts the measured offset.
+    collected = []
+    r0.span_sink = collected.extend
+    try:
+        req = build_requests(rig.data, rig.model, [4])[0]
+        attach_trace(req, TraceContext(new_trace_id(), "aaaa0001", True))
+        r0.scorer.score_batch(req)
+        spans = collected + r0.pull_spans(10.0)
+    finally:
+        r0.span_sink = None
+    assert spans, "traced request produced no child spans"
+    now = time.time()
+    for span in spans:
+        assert abs(float(span["start"]) - now) < 15.0, (
+            f"span still on the skewed clock: {span['start']} vs {now}"
+        )
+    set_net_plan(None)
+    _rewire(rig.fleet)
+
+
+def test_shift_span_times_shifts_starts_and_events_only():
+    spans = [{
+        "start": 130.0, "duration_s": 0.5, "name": "score",
+        "events": [{"t": 130.2, "name": "batch"}],
+    }]
+    shifted = shift_span_times(spans, 30.0)
+    assert shifted[0]["start"] == pytest.approx(100.0)
+    assert shifted[0]["events"][0]["t"] == pytest.approx(100.2)
+    assert shifted[0]["duration_s"] == 0.5  # durations are clock-free
+    assert shift_span_times(spans, 0.0) is spans  # no-op fast path
+
+
+# -- lease expiry --------------------------------------------------------------
+
+def test_partition_past_lease_declares_death_with_cause_lease():
+    """Only lease EXPIRY declares: under a permanent partition the
+    supervisor tolerates misses while the lease runs, then declares with
+    cause ``"lease"`` — driven by a fake clock, so the verdict is exact,
+    not timing-dependent."""
+    model, data = _fixture(seed=5)
+    session = TelemetrySession("netfault-lease")
+    fleet = ServingFleet(
+        model, replicas=1, backend="subprocess",
+        request_spec=request_spec_for_dataset(model, data),
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+    ).warmup()
+    clock = types.SimpleNamespace(t=1000.0)
+    try:
+        sup = ReplicaSupervisor(
+            fleet,
+            SupervisorPolicy(probe_interval_s=10.0, probe_deadline_s=0.3,
+                             hang_timeout_s=1e9, lease_s=5.0,
+                             resurrect=False),
+            telemetry=session, clock=lambda: clock.t,
+        )
+        r0 = fleet.replicas[0]
+        sup.check_once()  # healthy: lease established and renewed
+        set_net_plan(NetFaultPlan([partition("r0:*", 0.0, None)], seed=1))
+        _rewire(fleet)
+        clock.t += 1.0
+        sup.check_once()
+        assert r0.alive, "declared dead inside the lease window"
+        assert _counter(session, "serving.lease_probe_misses",
+                        replica="r0") >= 1
+        clock.t += 10.0  # past the 5s lease
+        sup.check_once()
+        assert not r0.alive, "lease expiry did not declare"
+        assert _counter(session, "serving.replica_deaths",
+                        cause="lease") == 1
+        # No false-positive resurrection: supervision was detect-only.
+        assert _counter(session, "serving.replica_resurrections") == 0
+    finally:
+        set_net_plan(None)
+        fleet.close()
+
+
+# -- zero-downtime background rebuild ------------------------------------------
+
+def test_rollout_with_rebuild_crosses_capacity_boundary():
+    """Growth past the serving tables' headroom refuses the in-place
+    swap (``is_capacity_refusal``) and falls through to the background
+    rebuild: doubled capacity, canary parity gate, atomic cutover — and
+    the grown vocabulary serves correctly afterwards."""
+    model, data = _fixture(seed=3)
+    session = TelemetrySession("netfault-rebuild")
+    fleet = ServingFleet(
+        model, replicas=2,
+        request_spec=request_spec_for_dataset(model, data),
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+        table_capacity_factor=1,
+    ).warmup()
+    try:
+        requests = build_requests(data, model, [4, 9, 2])
+        for r in requests:
+            fleet.score(r)
+        grown = _grown(model)
+        rebuilt = fleet.rollout_with_rebuild(
+            grown, probe_requests=requests[:2]
+        )
+        assert rebuilt, "capacity-crossing growth did not rebuild"
+        for r in requests:
+            got = np.asarray(fleet.score(r), np.float64)
+            want = host_score_request(grown, r)
+            assert np.abs(got - want).max() < 1e-3
+        current, version = fleet.current_model()
+        assert current is grown
+        assert _counter(session, "serving.fleet_rebuilds") == 1
+        assert _counter(session, "serving.replica_rebuilds") == 2
+        # The SAME model fits now: the next rollout is the in-place path.
+        assert fleet.rollout_with_rebuild(grown) is False
+        assert _counter(session, "serving.fleet_rebuilds") == 1
+        assert fleet.current_model()[1] > version  # rollouts stay monotonic
+    finally:
+        fleet.close()
+
+
+def test_rebuild_canary_failure_restores_fleet():
+    """A replacement that fails its canary parity gate is retired and the
+    rebuild aborts with :class:`ReplicaRebuildError` — the fleet keeps
+    serving the OLD model, fully healthy."""
+    model, data = _fixture(seed=3)
+    session = TelemetrySession("netfault-canary")
+    fleet = ServingFleet(
+        model, replicas=2,
+        request_spec=request_spec_for_dataset(model, data),
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+        table_capacity_factor=1,
+    ).warmup()
+    try:
+        requests = build_requests(data, model, [4, 9, 2])
+        clean = [np.asarray(fleet.score(r), np.float64) for r in requests]
+        grown = _grown(model)
+        with pytest.raises(ReplicaRebuildError):
+            # parity_tol=-1.0: an impossible gate — every canary fails.
+            fleet.rebuild(grown, parity_tol=-1.0,
+                          probe_requests=requests[:2])
+        current, _ = fleet.current_model()
+        assert current is model, "aborted rebuild left the grown model"
+        for r, c in zip(requests, clean):
+            got = np.asarray(fleet.score(r), np.float64)
+            np.testing.assert_allclose(got, c, rtol=0, atol=1e-9)
+        assert all(r.alive for r in fleet.replicas)
+        assert _counter(session, "serving.fleet_rebuilds") == 0
+    finally:
+        fleet.close()
+
+
+def test_capacity_refusal_detector_matches_both_refusal_sites():
+    refusals = (
+        RuntimeError("grown vocabulary requires a new GameScorer"),
+        ValueError("capacity growth is a layout-shape change — rebuild "
+                   "the scorer instead of hot-swapping"),
+    )
+    for exc in refusals:
+        assert is_capacity_refusal(exc)
+    wrapped = RuntimeError("swap failed")
+    wrapped.__cause__ = refusals[0]
+    assert is_capacity_refusal(wrapped)
+    assert not is_capacity_refusal(RuntimeError("unrelated failure"))
+
+
+def test_subprocess_rebuild_replaces_child_under_live_traffic():
+    """The subprocess flavor: the replacement is a fresh CHILD PROCESS at
+    doubled capacity, born into generation+1; cutover retires the old
+    child and live traffic sees zero sheds and zero lost futures."""
+    model, data = _fixture(seed=7)
+    session = TelemetrySession("netfault-subproc-rebuild")
+    fleet = ServingFleet(
+        model, replicas=1, backend="subprocess",
+        request_spec=request_spec_for_dataset(model, data),
+        max_batch=16, max_delay_s=0.001, telemetry=session,
+        table_capacity_factor=1,
+    ).warmup()
+    try:
+        requests = build_requests(data, model, [4, 9, 2, 7])
+        for r in requests:
+            fleet.score(r)
+        grown = _grown(model)
+        old_pid = fleet.replicas[0].child_pid
+        old_gen = fleet.replicas[0].generation
+        errors, stop = [], threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    fleet.score(requests[0])
+                except Exception as e:  # noqa: BLE001 — audited below
+                    errors.append(e)
+                time.sleep(0.02)
+
+        t = threading.Thread(target=client)
+        t.start()
+        try:
+            rebuilt = fleet.rollout_with_rebuild(
+                grown, probe_requests=requests[:2]
+            )
+        finally:
+            stop.set()
+            t.join()
+        assert rebuilt
+        assert not errors, f"live traffic failed during rebuild: {errors}"
+        assert fleet.replicas[0].child_pid != old_pid
+        assert fleet.replicas[0].generation == old_gen + 1
+        for r in requests:
+            got = np.asarray(fleet.score(r), np.float64)
+            want = host_score_request(grown, r)
+            assert np.abs(got - want).max() < 1e-3
+        # The retired child's generation is fenced out by construction:
+        # the router's cutover bumped the stamp the child echoes.
+        pong = fleet.replicas[0].ping(10.0)
+        assert pong.get("gen") == fleet.replicas[0].generation
+    finally:
+        fleet.close()

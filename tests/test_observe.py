@@ -663,3 +663,83 @@ def test_report_renders_fleet_traces_slos_section():
     )
     # A report without the payload renders no section.
     assert "Fleet traces" not in render_markdown(session.build_report())
+
+
+# -- SLO-driven admission guard (ISSUE 19 satellite) ---------------------------
+
+def _counter_total(session, name, **labels):
+    total = 0
+    for m in session.registry.snapshot()["counters"]:
+        if m["name"] != name:
+            continue
+        if labels and any(
+            str(m["labels"].get(k)) != str(v) for k, v in labels.items()
+        ):
+            continue
+        total += m["value"]
+    return total
+
+
+def test_admission_guard_tightens_on_alert_and_relaxes_on_clear():
+    """The guard closes the SLO→admission loop: a burn-rate alert raises
+    the router's ``burn_safety`` multiplier to ``admission_tighten``; the
+    CLEAR edge — and only with no other SLO still alerting — relaxes it
+    back to 1.0.  Exactly one counter tick per edge."""
+    clock = types.SimpleNamespace(t=0.0)
+    session = TelemetrySession("test-guard")
+    model, data = _fixture(seed=7)
+    fleet, observer = _observed_fleet(model, data, session, replicas=1)
+    try:
+        monitor = SloMonitor(
+            [Slo("p99_latency", "latency", objective=0.1, budget=0.01,
+                 fast_window_s=5.0, slow_window_s=60.0,
+                 fast_burn=14.0, slow_burn=2.0)],
+            telemetry=session, clock=lambda: clock.t,
+        )
+        observer.slo_monitor = monitor
+        observer.attach_admission_guard(fleet.router, tighten=8.0)
+        assert fleet.router.burn_safety == 1.0
+        # Injected latency cliff: every request blows the objective.
+        for _ in range(50):
+            monitor.observe_request("ok", 0.5)
+            clock.t += 0.05
+        monitor.evaluate()
+        assert fleet.router.burn_safety == 8.0
+        assert _counter_total(session, "serving.admission_tightened") == 1
+        monitor.evaluate()  # continuing alert: no re-tighten tick
+        assert _counter_total(session, "serving.admission_tightened") == 1
+        # Heal: healthy traffic drains both windows → CLEAR → relax.
+        for _ in range(200):
+            monitor.observe_request("ok", 0.01)
+            clock.t += 0.5
+        monitor.evaluate()
+        assert fleet.router.burn_safety == 1.0
+        assert _counter_total(session, "serving.admission_relaxed") == 1
+    finally:
+        fleet.close()
+
+
+def test_admission_guard_shed_rises_under_alert_and_recovers():
+    """Behavioral half of the guard: while tightened, the overload
+    projection sheds a deadline that sails through at safety 1; after the
+    relax edge the same request is admitted again."""
+    model, data = _fixture(seed=7)
+    session = TelemetrySession("test-guard-shed")
+    fleet, observer = _observed_fleet(model, data, session, replicas=1)
+    try:
+        reqs = build_requests(data, model, [3, 5, 8])
+        for r in reqs:
+            fleet.score(r)  # measure per-row service time
+        shed0 = _counter_total(session, "serving.shed", reason="overload")
+        fleet.router.burn_safety = 1e9  # what a fired alert installs
+        with pytest.raises(RequestShedError):
+            fleet.score(reqs[0], deadline_s=0.25)
+        assert _counter_total(
+            session, "serving.shed", reason="overload"
+        ) > shed0
+        fleet.router.burn_safety = 1.0  # the clear edge relaxes
+        got = np.asarray(fleet.score(reqs[0], deadline_s=0.25), np.float64)
+        want = host_score_request(model, reqs[0])
+        assert np.abs(got - want).max() < 1e-3
+    finally:
+        fleet.close()

@@ -113,6 +113,13 @@ DEFAULT_FILES = (
     # np.asarray site must carry its sanction — an extra d2h here would
     # tax EVERY tenant's request, not just one model's.
     "photon_tpu/serving/arena.py",
+    # Partition-tolerant supervision (ISSUE 19): the lease ledger, the
+    # seq/generation exchange, and the network-fault shim are pure host
+    # wire/bookkeeping code — a d2h anywhere in them would put device
+    # latency inside the lease/ping/fencing paths whose TIMING is the
+    # contract under test.
+    "photon_tpu/serving/netfault.py",
+    "photon_tpu/serving/supervisor.py",
 )
 
 SYNC_PATTERN = re.compile(
